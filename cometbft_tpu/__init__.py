@@ -1,0 +1,35 @@
+"""cometbft_tpu — a from-scratch, TPU-native BFT state-machine-replication framework.
+
+Capability set of CometBFT (Tendermint consensus, ABCI 2.0, gossip p2p,
+mempool, block/state sync, light client, evidence, WAL crash recovery, RPC),
+re-designed TPU-first: the host side is an asyncio actor system; the dense
+compute — Ed25519/sr25519 vote and commit signature verification — runs as
+batched JAX/Pallas kernels on TPU behind a pluggable `crypto.BatchVerifier`
+boundary with a CPU fallback.
+
+Package map (see SURVEY.md §2 for the reference inventory each maps to):
+  utils/      small codecs (hand-rolled protobuf writer for canonical bytes)
+  libs/       support runtime: service lifecycle, log, events, pubsub, bits, ...
+  crypto/     key interfaces, ed25519/sr25519/secp256k1, tmhash, merkle, batch
+  ops/        JAX device kernels: fe25519 limb field arith, curve ops, sha512
+  parallel/   device mesh sharding of signature mega-batches (shard_map/ICI)
+  models/     flagship jittable programs (batched commit verifier)
+  types/      domain model: blocks, votes, commits, validator sets, evidence
+  abci/       application interface (17 methods), clients, kvstore example
+  proxy/      4-connection ABCI multiplexing
+  mempool/    CheckTx-gated tx pool + gossip
+  state/      State snapshot + BlockExecutor + stores + indexing
+  store/      block persistence over KV backends
+  consensus/  Tendermint state machine, WAL, replay, reactor
+  privval/    validator key custody (file signer, double-sign guard)
+  p2p/        encrypted multiplexed TCP stack, switch, PEX
+  blocksync/  fast-sync block pool streaming commits through the TPU path
+  statesync/  snapshot bootstrap
+  evidence/   Byzantine-fault proofs
+  light/      light client with bisection
+  rpc/        JSON-RPC HTTP/WS server + clients
+  node/       dependency-injection root
+  cmd/        CLI
+"""
+
+from cometbft_tpu.version import CMTSemVer as __version__  # noqa: F401

@@ -1,0 +1,82 @@
+"""Crypto plugin boundary (reference: crypto/crypto.go:22-53).
+
+PubKey / PrivKey / BatchVerifier are the seams the rest of the framework
+programs against; concrete schemes (ed25519, sr25519, secp256k1) register
+here, and `crypto.batch` picks a batch verifier by key type AND configured
+backend ("cpu" | "tpu" | "auto") — the north-star plugin point
+(reference: crypto/batch/batch.go:11-32).
+
+Batch-first design difference from the reference: BatchVerifier.add() is
+cheap staging only; verify() is the sync point and returns BOTH the overall
+bool and a per-signature validity mask (the reference falls back to serial
+re-verification to pinpoint bad signatures — types/validation.go:266; on TPU
+the mask is free, it's the kernel's lane output).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+# Address: 20-byte truncated SHA-256 of the pubkey bytes
+# (reference: crypto/crypto.go:8-17, crypto/tmhash).
+ADDRESS_SIZE = 20
+
+
+class PubKey(ABC):
+    @abstractmethod
+    def address(self) -> bytes: ...
+
+    @abstractmethod
+    def bytes_(self) -> bytes: ...
+
+    @abstractmethod
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool: ...
+
+    @abstractmethod
+    def type_(self) -> str: ...
+
+    def __eq__(self, other: object) -> bool:
+        return (isinstance(other, PubKey) and self.type_() == other.type_()
+                and self.bytes_() == other.bytes_())
+
+    def __hash__(self) -> int:
+        return hash((self.type_(), self.bytes_()))
+
+
+class PrivKey(ABC):
+    @abstractmethod
+    def bytes_(self) -> bytes: ...
+
+    @abstractmethod
+    def sign(self, msg: bytes) -> bytes: ...
+
+    @abstractmethod
+    def pub_key(self) -> PubKey: ...
+
+    @abstractmethod
+    def type_(self) -> str: ...
+
+
+class BatchVerifier(ABC):
+    """Accumulate (pubkey, msg, sig) triples; verify once.
+
+    add() validates shapes and stages host-side; verify() flushes to the
+    backend (device batch or CPU loop) and returns (all_valid, per_sig_mask).
+    """
+
+    @abstractmethod
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None: ...
+
+    @abstractmethod
+    def verify(self) -> tuple[bool, list[bool]]: ...
+
+    @abstractmethod
+    def count(self) -> int: ...
+
+
+class ErrInvalidKey(Exception):
+    pass
+
+
+class ErrInvalidSignature(Exception):
+    pass

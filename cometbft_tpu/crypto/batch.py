@@ -1,0 +1,87 @@
+"""Batch-verifier dispatch (reference: crypto/batch/batch.go:11-32).
+
+The reference keys verifier creation on pubkey *type*; this framework adds the
+backend dimension — "cpu" (OpenSSL loop), "tpu" (JAX/Pallas device kernel),
+or "auto" (tpu when an accelerator is present, else cpu). The chosen backend
+is process-global, set once from config (config.crypto.backend) at node boot.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from cometbft_tpu import crypto
+from cometbft_tpu.crypto import ed25519
+
+_BACKEND = "auto"
+_tpu_available: Optional[bool] = None
+
+# key type -> backend name -> factory
+_REGISTRY: dict[str, dict[str, Callable[[], crypto.BatchVerifier]]] = {}
+
+
+def register(key_type: str, backend: str,
+             factory: Callable[[], crypto.BatchVerifier]) -> None:
+    _REGISTRY.setdefault(key_type, {})[backend] = factory
+
+
+def set_backend(backend: str) -> None:
+    global _BACKEND
+    if backend not in ("auto", "cpu", "tpu"):
+        raise ValueError(f"unknown crypto backend {backend!r}")
+    _BACKEND = backend
+
+
+def get_backend() -> str:
+    return _BACKEND
+
+
+def _device_present() -> bool:
+    global _tpu_available
+    if _tpu_available is None:
+        try:
+            import jax
+
+            _tpu_available = any(d.platform != "cpu" for d in jax.devices())
+        except Exception:  # noqa: BLE001 - no jax / no device: fall back
+            _tpu_available = False
+    return _tpu_available
+
+
+def resolve_backend() -> str:
+    if _BACKEND == "auto":
+        return "tpu" if _device_present() else "cpu"
+    return _BACKEND
+
+
+def supports_batch_verifier(pub_key: crypto.PubKey | None) -> bool:
+    """reference: crypto/batch/batch.go:26-32 — secp256k1 has no batch path."""
+    return pub_key is not None and pub_key.type_() in _REGISTRY
+
+
+def create_batch_verifier(pub_key: crypto.PubKey) -> crypto.BatchVerifier:
+    """Create a verifier for this key type on the configured backend.
+    Raises ErrInvalidKey for unbatchable key types (caller falls back to
+    serial verification, as the reference does)."""
+    backends = _REGISTRY.get(pub_key.type_())
+    if not backends:
+        raise crypto.ErrInvalidKey(
+            f"key type {pub_key.type_()!r} has no batch verifier")
+    backend = resolve_backend()
+    factory = backends.get(backend) or backends["cpu"]
+    try:
+        return factory()
+    except Exception:  # noqa: BLE001 - device backend unavailable/broken
+        if backend == "cpu":
+            raise
+        return backends["cpu"]()
+
+
+def _tpu_ed25519_factory() -> crypto.BatchVerifier:
+    from cometbft_tpu.ops.batch_verifier import TPUBatchVerifier
+
+    return TPUBatchVerifier()
+
+
+register(ed25519.KEY_TYPE, "cpu", ed25519.CPUBatchVerifier)
+register(ed25519.KEY_TYPE, "tpu", _tpu_ed25519_factory)

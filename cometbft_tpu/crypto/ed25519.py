@@ -1,0 +1,139 @@
+"""Ed25519 keys (reference: crypto/ed25519/ed25519.go).
+
+Signing and the production single-verify fast path are OpenSSL-backed (the
+`cryptography` package); batch verification routes through crypto/batch to
+either the TPU kernel (ops/) or a CPU fallback. Key type string, sizes and
+address derivation mirror the reference.
+
+Verification-semantics note: the reference verifies under ZIP-215
+(ed25519.go:37-42). OpenSSL's verify is cofactorless-strict; the two agree on
+all signatures produced by honest signers and on random forgeries, and differ
+only on adversarial edge-case encodings (non-canonical points, small-order
+components). verify_signature therefore first tries OpenSSL and, only on
+rejection, re-checks under the pure ZIP-215 oracle so that accept/reject
+behavior is exactly ZIP-215 while the hot path stays native-speed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+
+from cometbft_tpu import crypto
+from cometbft_tpu.crypto import ed25519_math, tmhash
+
+KEY_TYPE = "ed25519"
+PUB_KEY_SIZE = 32
+PRIV_KEY_SIZE = 64  # seed || pubkey, matching Go's ed25519.PrivateKey layout
+SIGNATURE_SIZE = 64
+SEED_SIZE = 32
+
+
+class PubKey(crypto.PubKey):
+    __slots__ = ("_bytes", "_openssl")
+
+    def __init__(self, data: bytes):
+        if len(data) != PUB_KEY_SIZE:
+            raise crypto.ErrInvalidKey(f"ed25519 pubkey must be {PUB_KEY_SIZE} bytes")
+        self._bytes = bytes(data)
+        self._openssl: Ed25519PublicKey | None = None
+
+    def address(self) -> bytes:
+        return tmhash.sum_truncated(self._bytes)
+
+    def bytes_(self) -> bytes:
+        return self._bytes
+
+    def type_(self) -> str:
+        return KEY_TYPE
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        try:
+            if self._openssl is None:
+                self._openssl = Ed25519PublicKey.from_public_bytes(self._bytes)
+            self._openssl.verify(sig, msg)
+            return True
+        except (InvalidSignature, ValueError):
+            # OpenSSL rejected: re-check under ZIP-215, which accepts a
+            # superset (non-canonical R/A encodings, cofactored equation).
+            # The S >= L pre-filter is free and final under both semantics,
+            # so ~15/16 of random garbage never reaches the slow oracle.
+            # Residual cost: a crafted canonical-looking bad sig costs ~1 ms
+            # of Python bignum math; consensus callers ban the sending peer
+            # on the first invalid signature, bounding the amplification.
+            # Roadmap: native C++ ZIP-215 verifier removes the gap entirely.
+            if int.from_bytes(sig[32:], "little") >= ed25519_math.L:
+                return False
+            return ed25519_math.verify_zip215(self._bytes, msg, sig)
+
+    def __repr__(self) -> str:
+        return f"PubKeyEd25519{{{self._bytes.hex().upper()}}}"
+
+
+class PrivKey(crypto.PrivKey):
+    __slots__ = ("_seed", "_pub", "_openssl")
+
+    def __init__(self, data: bytes):
+        # Accept 32-byte seed or 64-byte seed||pub (Go layout).
+        if len(data) == SEED_SIZE:
+            seed = bytes(data)
+        elif len(data) == PRIV_KEY_SIZE:
+            seed = bytes(data[:SEED_SIZE])
+        else:
+            raise crypto.ErrInvalidKey("ed25519 privkey must be 32 or 64 bytes")
+        self._seed = seed
+        self._openssl = Ed25519PrivateKey.from_private_bytes(seed)
+        pub = self._openssl.public_key().public_bytes_raw()
+        self._pub = PubKey(pub)
+
+    def bytes_(self) -> bytes:
+        return self._seed + self._pub.bytes_()
+
+    def sign(self, msg: bytes) -> bytes:
+        return self._openssl.sign(msg)
+
+    def pub_key(self) -> PubKey:
+        return self._pub
+
+    def type_(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key() -> PrivKey:
+    return PrivKey(secrets.token_bytes(SEED_SIZE))
+
+
+def gen_priv_key_from_secret(secret: bytes) -> PrivKey:
+    """Deterministic key from a secret (reference: GenPrivKeyFromSecret,
+    ed25519.go:162-170 — seed = SHA256(secret)). Testing only."""
+    return PrivKey(hashlib.sha256(secret).digest())
+
+
+class CPUBatchVerifier(crypto.BatchVerifier):
+    """CPU fallback: OpenSSL per-signature loop with ZIP-215 re-check on
+    rejection. Matches reference BatchVerifier semantics (all-or-mask)."""
+
+    def __init__(self) -> None:
+        self._items: list[tuple[PubKey, bytes, bytes]] = []
+
+    def add(self, pub_key: crypto.PubKey, msg: bytes, sig: bytes) -> None:
+        if not isinstance(pub_key, PubKey):
+            raise crypto.ErrInvalidKey("ed25519 batch verifier got non-ed25519 key")
+        if len(sig) != SIGNATURE_SIZE:
+            raise crypto.ErrInvalidSignature("bad signature length")
+        self._items.append((pub_key, msg, sig))
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        mask = [pk.verify_signature(msg, sig) for pk, msg, sig in self._items]
+        return all(mask), mask
+
+    def count(self) -> int:
+        return len(self._items)

@@ -1,0 +1,22 @@
+"""SHA-256 hashing (reference: crypto/tmhash/hash.go).
+
+sum() is the 32-byte block/tx hash; sum_truncated() is the 20-byte prefix
+used for validator addresses.
+"""
+
+import hashlib
+
+SIZE = 32
+TRUNCATED_SIZE = 20
+
+
+def sum_(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def sum_truncated(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()[:TRUNCATED_SIZE]
+
+
+def new():
+    return hashlib.sha256()

@@ -1,0 +1,6 @@
+"""Support runtime (reference: libs/ — SURVEY.md §2.5).
+
+Host-side, idiomatic asyncio equivalents of the reference's 25 support
+packages: service lifecycle, structured logging, event switch, pubsub,
+bit arrays, WAL file groups, rate limiting, protoio framing.
+"""

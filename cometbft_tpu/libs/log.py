@@ -1,0 +1,131 @@
+"""Structured logfmt/JSON logger (reference: libs/log).
+
+Module-scoped child loggers via with_fields(); lazy value rendering so hot
+paths (vote ingestion) pay nothing when the level is filtered — the analog of
+the reference's log.NewLazySprintf (consensus/state.go:1654).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import sys
+import threading
+import time
+from typing import Any, Callable, Optional, TextIO
+
+DEBUG, INFO, WARN, ERROR, NONE = 0, 1, 2, 3, 4
+_LEVEL_NAMES = {DEBUG: "debug", INFO: "info", WARN: "warn", ERROR: "error"}
+_NAME_LEVELS = {v: k for k, v in _LEVEL_NAMES.items()} | {"none": NONE}
+
+
+def parse_level(name: str) -> int:
+    try:
+        return _NAME_LEVELS[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown log level {name!r}") from None
+
+
+class Lazy:
+    """Defers fn() until the record is actually emitted."""
+
+    __slots__ = ("fn",)
+
+    def __init__(self, fn: Callable[[], Any]):
+        self.fn = fn
+
+    def __str__(self) -> str:
+        return str(self.fn())
+
+
+def lazy_hex(b: bytes) -> Lazy:
+    return Lazy(lambda: b.hex().upper())
+
+
+def _fmt_value(v: Any) -> str:
+    if isinstance(v, Lazy):
+        v = str(v)
+    if isinstance(v, bytes):
+        v = v.hex().upper()
+    s = str(v)
+    if any(c in ' ="' or ord(c) < 0x20 or c == "\x7f" for c in s):
+        return json.dumps(s)
+    return s
+
+
+class Logger:
+    """logfmt (default) or JSON lines to a stream."""
+
+    def __init__(self, stream: Optional[TextIO] = None, level: int = INFO,
+                 fields: tuple = (), fmt: str = "logfmt"):
+        self._stream = stream if stream is not None else sys.stderr
+        self.level = level
+        self._fields = fields
+        self._fmt = fmt
+        self._lock = threading.Lock()
+
+    def with_fields(self, **kv: Any) -> "Logger":
+        child = Logger(self._stream, self.level, self._fields + tuple(kv.items()), self._fmt)
+        child._lock = self._lock
+        return child
+
+    # alias matching the reference's logger.With(...)
+    with_ = with_fields
+
+    def _emit(self, level: int, msg: str, kv: dict) -> None:
+        if level < self.level:
+            return
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime())
+        items = self._fields + tuple(kv.items())
+        if self._fmt == "json":
+            rec = {"level": _LEVEL_NAMES[level], "ts": ts, "msg": msg}
+            for k, v in items:
+                if isinstance(v, bytes):
+                    v = v.hex().upper()
+                elif isinstance(v, Lazy):
+                    v = str(v)
+                rec[k] = v
+            line = json.dumps(rec, default=str)
+        else:
+            buf = io.StringIO()
+            buf.write(f"{_LEVEL_NAMES[level][0].upper()}[{ts}] {msg}")
+            for k, v in items:
+                buf.write(f" {k}={_fmt_value(v)}")
+            line = buf.getvalue()
+        with self._lock:
+            self._stream.write(line + "\n")
+
+    def debug(self, msg: str, **kv: Any) -> None:
+        self._emit(DEBUG, msg, kv)
+
+    def info(self, msg: str, **kv: Any) -> None:
+        self._emit(INFO, msg, kv)
+
+    def warn(self, msg: str, **kv: Any) -> None:
+        self._emit(WARN, msg, kv)
+
+    def error(self, msg: str, **kv: Any) -> None:
+        self._emit(ERROR, msg, kv)
+
+
+class _NopLogger(Logger):
+    def __init__(self) -> None:
+        super().__init__(stream=io.StringIO(), level=NONE)
+
+    def _emit(self, level: int, msg: str, kv: dict) -> None:
+        pass
+
+
+_NOP = _NopLogger()
+
+
+def nop() -> Logger:
+    return _NOP
+
+
+def default(level: int = INFO, fmt: str = "logfmt") -> Logger:
+    return Logger(sys.stderr, level, (), fmt)
+
+
+def test_logger() -> Logger:
+    return Logger(sys.stdout, DEBUG)

@@ -1,0 +1,1 @@
+"""Small shared codecs and helpers (no domain logic)."""

@@ -1,0 +1,21 @@
+"""Version constants.
+
+Mirrors the reference's version package (version/version.go:1-21): a semver
+core version plus protocol versions for the block and p2p wire formats and the
+ABCI application interface.
+"""
+
+# Framework semver.
+CMTSemVer = "0.1.0-tpu"
+
+# ABCI application-protocol semver (reference: version/version.go ABCIVersion).
+ABCIVersion = "2.0.0"
+
+# Block protocol version (reference: version/version.go BlockProtocol = 11).
+BlockProtocol = 11
+
+# P2P protocol version (reference: version/version.go P2PProtocol = 8).
+P2PProtocol = 8
+
+# TPU crypto-backend version (new in this framework).
+TPUCryptoBackend = 1

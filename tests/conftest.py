@@ -1,0 +1,24 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh BEFORE any jax import so sharding
+tests (parallel/) exercise real multi-device compilation without TPU hardware,
+per the multi-chip test strategy in SURVEY.md §5.7/§2.3.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def jax_cpu_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual cpu devices, got {devs}"
+    return devs

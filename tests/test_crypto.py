@@ -1,0 +1,216 @@
+"""Crypto foundation tests: tmhash, merkle (RFC6962 vectors), ed25519
+(RFC 8032 vectors + ZIP-215 oracle consistency), batch dispatch."""
+
+import hashlib
+import secrets
+
+import pytest
+
+from cometbft_tpu import crypto
+from cometbft_tpu.crypto import batch, ed25519, ed25519_math, merkle, tmhash
+
+# RFC 8032 §7.1 test vectors (seed, pubkey, msg, sig)
+RFC8032_VECTORS = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+class TestTmhash:
+    def test_sum(self):
+        assert tmhash.sum_(b"") == hashlib.sha256(b"").digest()
+        assert len(tmhash.sum_truncated(b"abc")) == 20
+        assert tmhash.sum_truncated(b"abc") == tmhash.sum_(b"abc")[:20]
+
+
+class TestMerkle:
+    def test_rfc6962_vectors(self):
+        # reference: crypto/merkle/rfc6962_test.go:26-78
+        assert merkle.hash_from_byte_slices([]).hex() == (
+            "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855")
+        assert merkle.leaf_hash(b"").hex() == (
+            "6e340b9cffb37a989ca544e6bb780a2c78901d3fb33738768511a30617afa01d")
+        assert merkle.leaf_hash(b"L123456").hex() == (
+            "395aa064aa4c29f7010acfe3f25db9485bbd4b91897b6ad7ad547639252b4d56")
+        assert merkle.inner_hash(b"N123", b"N456").hex() == (
+            "aa217fe888e47007fa15edab33c2b492a722cb106c64667fc2b044444de66bbb")
+
+    def test_split_point(self):
+        for n, want in [(2, 1), (3, 2), (4, 2), (5, 4), (10, 8), (20, 16), (100, 64)]:
+            assert merkle.get_split_point(n) == want
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 9, 100])
+    def test_proofs(self, n):
+        items = [bytes([i]) * (i % 5 + 1) for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        for i, proof in enumerate(proofs):
+            assert proof.total == n and proof.index == i
+            assert proof.verify(root, items[i])
+            assert not proof.verify(root, items[i] + b"x")
+            if n > 1:
+                assert not proof.verify(bytes(32), items[i])
+
+
+class TestEd25519Math:
+    def test_rfc8032_sign_and_verify(self):
+        for seed_h, pub_h, msg_h, sig_h in RFC8032_VECTORS:
+            seed, pub = bytes.fromhex(seed_h), bytes.fromhex(pub_h)
+            msg, sig = bytes.fromhex(msg_h), bytes.fromhex(sig_h)
+            assert ed25519_math.public_key_from_seed(seed) == pub
+            assert ed25519_math.sign(seed, msg) == sig
+            assert ed25519_math.verify_zip215(pub, msg, sig)
+            # wrong message / corrupted sig rejected
+            assert not ed25519_math.verify_zip215(pub, msg + b"x", sig)
+            bad = bytearray(sig)
+            bad[0] ^= 1
+            assert not ed25519_math.verify_zip215(pub, msg, bytes(bad))
+
+    def test_s_out_of_range_rejected(self):
+        seed = bytes(32)
+        pub = ed25519_math.public_key_from_seed(seed)
+        sig = ed25519_math.sign(seed, b"hi")
+        s = int.from_bytes(sig[32:], "little")
+        bad = sig[:32] + (s + ed25519_math.L).to_bytes(32, "little")
+        assert not ed25519_math.verify_zip215(pub, b"hi", bad)
+
+    def test_noncanonical_y_accepted(self):
+        # ZIP-215: an encoding with y >= p decompresses (reduced mod p);
+        # strict decompression rejects it.
+        y = ed25519_math.P + 3  # y=3 non-canonical; fits in 255 bits
+        enc = y.to_bytes(32, "little")
+        strict = ed25519_math.point_decompress_canonical(enc)
+        permissive = ed25519_math.point_decompress_zip215(enc)
+        canonical3 = ed25519_math.point_decompress_zip215((3).to_bytes(32, "little"))
+        if canonical3 is None:
+            assert permissive is None
+        else:
+            assert permissive is not None
+            assert ed25519_math.point_equal(permissive, canonical3)
+        assert strict is None
+
+    def test_group_ops(self):
+        B = ed25519_math.B_POINT
+        two_b = ed25519_math.point_add(B, B)
+        assert ed25519_math.point_equal(two_b, ed25519_math.point_double(B))
+        assert ed25519_math.point_equal(ed25519_math.scalar_mult(2, B), two_b)
+        # [L]B == identity
+        assert ed25519_math.is_identity(ed25519_math.scalar_mult(ed25519_math.L, B))
+        # k1*B + k2*(2B) == (k1 + 2*k2)*B
+        got = ed25519_math.double_scalar_mult(5, B, 7, two_b)
+        assert ed25519_math.point_equal(got, ed25519_math.scalar_mult(19, B))
+        # compress/decompress roundtrip
+        p = ed25519_math.scalar_mult(12345, B)
+        enc = ed25519_math.point_compress(p)
+        assert ed25519_math.point_equal(
+            ed25519_math.point_decompress_canonical(enc), p)
+
+    def test_batch_verify(self):
+        n = 8
+        seeds = [secrets.token_bytes(32) for _ in range(n)]
+        pubs = [ed25519_math.public_key_from_seed(s) for s in seeds]
+        msgs = [b"msg%d" % i for i in range(n)]
+        sigs = [ed25519_math.sign(s, m) for s, m in zip(seeds, msgs)]
+        ok, mask = ed25519_math.batch_verify_zip215(pubs, msgs, sigs)
+        assert ok and mask == [True] * n
+        # corrupt one signature: overall fails, mask pinpoints it
+        sigs[3] = sigs[3][:32] + bytes(32)
+        ok, mask = ed25519_math.batch_verify_zip215(pubs, msgs, sigs)
+        assert not ok
+        assert mask == [i != 3 for i in range(n)]
+
+
+class TestEd25519Keys:
+    def test_sign_verify(self):
+        priv = ed25519.gen_priv_key()
+        msg = b"hello consensus"
+        sig = priv.sign(msg)
+        pub = priv.pub_key()
+        assert pub.verify_signature(msg, sig)
+        assert not pub.verify_signature(msg + b"!", sig)
+        assert not pub.verify_signature(msg, bytes(64))
+        assert len(pub.address()) == crypto.ADDRESS_SIZE
+        assert pub.address() == tmhash.sum_truncated(pub.bytes_())
+
+    def test_openssl_matches_oracle(self):
+        priv = ed25519.gen_priv_key()
+        seed = priv.bytes_()[:32]
+        assert ed25519_math.public_key_from_seed(seed) == priv.pub_key().bytes_()
+        sig = priv.sign(b"x")
+        assert sig == ed25519_math.sign(seed, b"x")
+
+    def test_deterministic_from_secret(self):
+        a = ed25519.gen_priv_key_from_secret(b"val-0")
+        b = ed25519.gen_priv_key_from_secret(b"val-0")
+        c = ed25519.gen_priv_key_from_secret(b"val-1")
+        assert a.bytes_() == b.bytes_() != c.bytes_()
+
+    def test_priv_key_roundtrip(self):
+        priv = ed25519.gen_priv_key()
+        again = ed25519.PrivKey(priv.bytes_())
+        assert again.pub_key() == priv.pub_key()
+
+    def test_rfc8032_vectors_through_keys(self):
+        for seed_h, pub_h, msg_h, sig_h in RFC8032_VECTORS:
+            priv = ed25519.PrivKey(bytes.fromhex(seed_h))
+            assert priv.pub_key().bytes_() == bytes.fromhex(pub_h)
+            assert priv.sign(bytes.fromhex(msg_h)) == bytes.fromhex(sig_h)
+            assert priv.pub_key().verify_signature(
+                bytes.fromhex(msg_h), bytes.fromhex(sig_h))
+
+
+class TestBatchDispatch:
+    def test_cpu_batch(self):
+        batch.set_backend("cpu")
+        try:
+            priv = ed25519.gen_priv_key()
+            assert batch.supports_batch_verifier(priv.pub_key())
+            bv = batch.create_batch_verifier(priv.pub_key())
+            for i in range(4):
+                bv.add(priv.pub_key(), b"m%d" % i, priv.sign(b"m%d" % i))
+            assert bv.count() == 4
+            ok, mask = bv.verify()
+            assert ok and mask == [True] * 4
+        finally:
+            batch.set_backend("auto")
+
+    def test_bad_sig_mask(self):
+        batch.set_backend("cpu")
+        try:
+            priv = ed25519.gen_priv_key()
+            bv = batch.create_batch_verifier(priv.pub_key())
+            bv.add(priv.pub_key(), b"a", priv.sign(b"a"))
+            bv.add(priv.pub_key(), b"b", priv.sign(b"WRONG"))
+            ok, mask = bv.verify()
+            assert not ok and mask == [True, False]
+        finally:
+            batch.set_backend("auto")
+
+    def test_add_rejects_malformed(self):
+        batch.set_backend("cpu")
+        try:
+            priv = ed25519.gen_priv_key()
+            bv = batch.create_batch_verifier(priv.pub_key())
+            with pytest.raises(crypto.ErrInvalidSignature):
+                bv.add(priv.pub_key(), b"m", b"short")
+        finally:
+            batch.set_backend("auto")

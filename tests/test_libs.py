@@ -1,0 +1,179 @@
+"""Tests for utils/ codecs and libs/ support runtime."""
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.libs import bits, events, service
+from cometbft_tpu.utils import cmttime
+from cometbft_tpu.utils import protobuf as pb
+
+
+class TestProtobuf:
+    def test_uvarint_roundtrip(self):
+        for v in [0, 1, 127, 128, 300, 2**32, 2**63, 2**64 - 1]:
+            enc = pb.encode_uvarint(v)
+            dec, pos = pb.decode_uvarint(enc)
+            assert dec == v and pos == len(enc)
+
+    def test_varint_i64_negative(self):
+        # protobuf int64: negatives are 10-byte two's complement varints
+        enc = pb.encode_varint_i64(-1)
+        assert len(enc) == 10
+        v, _ = pb.decode_varint_i64(enc)
+        assert v == -1
+
+    def test_against_google_protobuf(self):
+        # cross-check our writer against the real protobuf runtime using
+        # the well-known Timestamp message
+        from google.protobuf.timestamp_pb2 import Timestamp
+
+        ts = Timestamp(seconds=1700000000, nanos=123456789)
+        assert pb.timestamp_bytes(1700000000, 123456789) == ts.SerializeToString()
+        ts = Timestamp(seconds=-62135596800, nanos=0)  # Go zero time
+        assert pb.timestamp_bytes(-62135596800, 0) == ts.SerializeToString()
+
+    def test_writer_field_encoding(self):
+        w = pb.Writer()
+        w.uvarint(1, 2)           # type = PrecommitType
+        w.sfixed64(2, 5)          # height
+        out = w.output()
+        assert out == bytes([0x08, 0x02, 0x11]) + (5).to_bytes(8, "little")
+
+    def test_omit_zero(self):
+        w = pb.Writer()
+        w.uvarint(1, 0).sfixed64(2, 0).bytes(3, b"").string(4, "")
+        assert w.output() == b""
+        w2 = pb.Writer()
+        w2.message(2, b"", always=True)
+        assert w2.output() == bytes([0x12, 0x00])
+
+    def test_delimited(self):
+        body = b"hello"
+        framed = pb.marshal_delimited(body)
+        out, pos = pb.unmarshal_delimited(framed)
+        assert out == body and pos == len(framed)
+
+    def test_reader(self):
+        w = pb.Writer()
+        w.uvarint(1, 42).bytes(2, b"abc").sfixed64(3, -7).string(5, "xyz")
+        r = pb.Reader(w.output())
+        f, wire = r.read_tag()
+        assert (f, wire) == (1, 0) and r.read_uvarint() == 42
+        f, wire = r.read_tag()
+        assert (f, wire) == (2, 2) and r.read_bytes() == b"abc"
+        f, wire = r.read_tag()
+        assert (f, wire) == (3, 1) and r.read_sfixed64() == -7
+        f, wire = r.read_tag()
+        assert (f, wire) == (5, 2) and r.read_string() == "xyz"
+        assert r.at_end()
+
+
+class TestTime:
+    def test_rfc3339(self):
+        ts = cmttime.Timestamp(1700000000, 123450000)
+        assert ts.rfc3339() == "2023-11-14T22:13:20.12345Z"
+        assert cmttime.Timestamp(1700000000, 0).rfc3339() == "2023-11-14T22:13:20Z"
+
+    def test_normalize(self):
+        ts = cmttime.Timestamp(0, 2_500_000_000)
+        assert ts.seconds == 2 and ts.nanos == 500_000_000
+
+    def test_ordering(self):
+        assert cmttime.Timestamp(1, 0) < cmttime.Timestamp(1, 1) < cmttime.Timestamp(2, 0)
+
+
+class TestBitArray:
+    def test_basic(self):
+        ba = bits.BitArray(10)
+        assert ba.size() == 10 and ba.is_empty() and not ba.is_full()
+        ba.set_index(3, True)
+        ba.set_index(9, True)
+        assert ba.get_index(3) and ba.get_index(9) and not ba.get_index(4)
+        assert ba.get_true_indices() == [3, 9]
+        assert ba.num_true() == 2
+        assert not ba.get_index(100)  # out of range → False, no panic
+
+    def test_ops(self):
+        a = bits.BitArray.from_bools([True, False, True, False])
+        b = bits.BitArray.from_bools([True, True, False, False])
+        assert a.or_(b).get_true_indices() == [0, 1, 2]
+        assert a.and_(b).get_true_indices() == [0]
+        assert a.sub(b).get_true_indices() == [2]
+        assert a.not_().get_true_indices() == [1, 3]
+
+    def test_full(self):
+        ba = bits.BitArray.from_bools([True] * 9)
+        assert ba.is_full()
+        ba.set_index(8, False)
+        assert not ba.is_full()
+
+    def test_bytes_roundtrip(self):
+        a = bits.BitArray.from_bools([True, False, True, True, False, True, False, False, True])
+        b = bits.BitArray.from_bytes(a.size(), a.to_bytes())
+        assert a == b
+
+    def test_tail_masking(self):
+        ba = bits.BitArray.from_bytes(3, b"\xff")
+        assert ba.get_true_indices() == [0, 1, 2]
+        assert ba.not_().is_empty()
+
+
+class TestService:
+    def test_lifecycle(self):
+        async def run():
+            calls = []
+
+            class S(service.BaseService):
+                async def on_start(self):
+                    calls.append("start")
+
+                async def on_stop(self):
+                    calls.append("stop")
+
+            s = S("test")
+            await s.start()
+            assert s.is_running
+            with pytest.raises(service.AlreadyStartedError):
+                await s.start()
+            await s.stop()
+            await s.stop()  # idempotent
+            assert calls == ["start", "stop"]
+            assert not s.is_running
+            with pytest.raises(service.AlreadyStoppedError):
+                await s.start()
+            s.reset()
+            await s.start()
+            assert s.is_running
+            await s.stop()
+
+        asyncio.run(run())
+
+    def test_wait(self):
+        async def run():
+            s = service.BaseService("w")
+            await s.start()
+
+            async def stopper():
+                await asyncio.sleep(0.01)
+                await s.stop()
+
+            t = asyncio.get_running_loop().create_task(stopper())
+            await asyncio.wait_for(s.wait(), 1.0)
+            await t
+
+        asyncio.run(run())
+
+
+class TestEvents:
+    def test_fire(self):
+        sw = events.EventSwitch()
+        got = []
+        sw.add_listener("l1", "vote", got.append)
+        sw.add_listener("l2", "vote", lambda d: got.append(("l2", d)))
+        sw.fire_event("vote", 1)
+        assert got == [1, ("l2", 1)]
+        sw.remove_listener("l2")
+        sw.fire_event("vote", 2)
+        assert got == [1, ("l2", 1), 2]
+        sw.fire_event("other", 3)  # no listeners: no-op
