@@ -1,0 +1,40 @@
+"""crypto.BatchVerifier backed by the TPU kernel (the `tpu` backend that
+crypto/batch registers — reference seam: crypto/batch/batch.go:11-32,
+crypto/ed25519/ed25519.go:208-241)."""
+
+from __future__ import annotations
+
+from cometbft_tpu import crypto
+from cometbft_tpu.ops import ed25519_kernel
+
+SIGNATURE_SIZE = 64
+PUB_KEY_SIZE = 32
+
+
+class TPUBatchVerifier(crypto.BatchVerifier):
+    """add() stages host-side (cheap); verify() is the device sync point.
+    Returns (all_valid, per-lane mask) — mask is the kernel's lane output,
+    not a serial re-check."""
+
+    def __init__(self, cache: ed25519_kernel.PubKeyCache | None = None):
+        self._pubs: list[bytes] = []
+        self._msgs: list[bytes] = []
+        self._sigs: list[bytes] = []
+        self._cache = cache
+
+    def add(self, pub_key: crypto.PubKey, msg: bytes, sig: bytes) -> None:
+        if pub_key.type_() != "ed25519":
+            raise crypto.ErrInvalidKey("tpu batch verifier requires ed25519 keys")
+        if len(sig) != SIGNATURE_SIZE:
+            raise crypto.ErrInvalidSignature("bad signature length")
+        self._pubs.append(pub_key.bytes_())
+        self._msgs.append(bytes(msg))
+        self._sigs.append(bytes(sig))
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        return ed25519_kernel.verify_batch(
+            self._pubs, self._msgs, self._sigs, cache=self._cache
+        )
+
+    def count(self) -> int:
+        return len(self._sigs)

@@ -1,0 +1,157 @@
+"""edwards25519 point operations on TPU vector lanes.
+
+A point is a tuple (X, Y, Z, T) of extended homogeneous coordinates, each a
+(..., 20) carried limb array (field.py); one lane = one point. All formulas
+are complete/unified (add-2008-hwcd-3 for a=-1, dbl-2008-hwcd) — branch-free
+by construction, exactly what lockstep SIMD lanes need: no special-casing of
+identity or equal points, so adversarial inputs (small-order points,
+non-canonical encodings; ZIP-215 territory) take the same instruction path
+as honest ones.
+
+Semantics mirror the Python oracle (crypto/ed25519_math.py), which mirrors
+curve25519-voi's ZIP-215 mode used by the reference
+(crypto/ed25519/ed25519.go:37-42).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.crypto import ed25519_math as oracle
+from cometbft_tpu.ops import field as F
+
+
+class Point(NamedTuple):
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+    t: jnp.ndarray
+
+
+# Base point as limb constants, shape (20,), broadcastable over batches.
+B_X = F._const(oracle.B_POINT[0])
+B_Y = F._const(oracle.B_POINT[1])
+B_T = F._const(oracle.B_POINT[3])
+
+
+def identity(shape: tuple[int, ...]) -> Point:
+    """(0 : 1 : 1 : 0) broadcast to batch shape + (20,)."""
+    zero = jnp.zeros(shape + (F.NLIMBS,), dtype=jnp.int32)
+    one = jnp.broadcast_to(F.ONE, shape + (F.NLIMBS,)).astype(jnp.int32)
+    return Point(zero, one, one, zero)
+
+
+def base_point(shape: tuple[int, ...]) -> Point:
+    bx = jnp.broadcast_to(B_X, shape + (F.NLIMBS,)).astype(jnp.int32)
+    by = jnp.broadcast_to(B_Y, shape + (F.NLIMBS,)).astype(jnp.int32)
+    bt = jnp.broadcast_to(B_T, shape + (F.NLIMBS,)).astype(jnp.int32)
+    one = jnp.broadcast_to(F.ONE, shape + (F.NLIMBS,)).astype(jnp.int32)
+    return Point(bx, by, one, bt)
+
+
+def add(p: Point, q: Point) -> Point:
+    """add-2008-hwcd-3 (unified, a=-1). ~9 field muls."""
+    a = F.mul(F.sub(p.y, p.x), F.sub(q.y, q.x))
+    b = F.mul(F.add(p.y, p.x), F.add(q.y, q.x))
+    c = F.mul(F.mul(p.t, F.D2), q.t)
+    zz = F.mul(p.z, q.z)
+    d = F.add(zz, zz)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add(d, c)
+    h = F.add(b, a)
+    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def double(p: Point) -> Point:
+    """dbl-2008-hwcd. 4 squarings + 4 muls."""
+    a = F.sq(p.x)
+    b = F.sq(p.y)
+    zz = F.sq(p.z)
+    c = F.add(zz, zz)
+    h = F.add(a, b)
+    e = F.sub(h, F.sq(F.add(p.x, p.y)))
+    g = F.sub(a, b)
+    f = F.add(c, g)
+    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def neg(p: Point) -> Point:
+    return Point(F.neg(p.x), p.y, p.z, F.neg(p.t))
+
+
+def mul_by_cofactor(p: Point) -> Point:
+    return double(double(double(p)))
+
+
+def is_identity(p: Point) -> jnp.ndarray:
+    """(...,) bool: projective identity — X == 0 and Y == Z mod p."""
+    return F.is_zero(p.x) & F.is_zero(F.sub(p.y, p.z))
+
+
+def decompress_zip215(y_limbs: jnp.ndarray, sign: jnp.ndarray) -> tuple[jnp.ndarray, Point]:
+    """ZIP-215 decompression: y taken mod p (non-canonical encodings
+    accepted — the field ops are redundant mod p so no explicit reduction is
+    needed), x recovered per RFC 8032 5.1.3. Returns (ok mask, point); on
+    ok == False the point coords are garbage and the caller must mask.
+    Oracle: ed25519_math.point_decompress_zip215."""
+    y = y_limbs
+    yy = F.sq(y)
+    u = F.sub(yy, jnp.broadcast_to(F.ONE, yy.shape).astype(jnp.int32))
+    v = F.add(F.mul(F.D, yy), jnp.broadcast_to(F.ONE, yy.shape).astype(jnp.int32))
+    v3 = F.mul(F.sq(v), v)
+    v7 = F.mul(F.sq(v3), v)
+    x = F.mul(F.mul(u, v3), F.pow22523(F.mul(u, v7)))
+    vxx = F.mul(v, F.sq(x))
+    root1 = F.is_zero(F.sub(vxx, u))       # v*x^2 == u
+    root2 = F.is_zero(F.add(vxx, u))       # v*x^2 == -u -> x *= sqrt(-1)
+    x = jnp.where(root1[..., None], x, F.mul(x, F.SQRT_M1))
+    ok = root1 | root2
+    xc = F.canonicalize(x)
+    x_zero = jnp.all(xc == 0, axis=-1)
+    ok = ok & ~(x_zero & (sign == 1))      # x=0 with sign bit set: reject
+    flip = (xc[..., 0] & 1) != sign
+    x = jnp.where(flip[..., None], F.neg(x), x)
+    one = jnp.broadcast_to(F.ONE, y.shape).astype(jnp.int32)
+    return ok, Point(x, y, one, F.mul(x, y))
+
+
+def straus_base_and_point(
+    s_bits: jnp.ndarray, k_bits: jnp.ndarray, a: Point
+) -> Point:
+    """[s]B + [k]A by interleaved (Straus) double-scalar multiplication with
+    the shared 4-entry table {O, B, A, B+A} — the same shape as the oracle's
+    double_scalar_mult, vectorized: every lane runs the same 253 iterations
+    (scalars < 2^253: s < L enforced host-side, k = H mod L), selecting its
+    table entry branch-free per bit pair.
+
+    s_bits/k_bits: (..., 253) int32 in {0,1}, little-endian bit order.
+    """
+    batch_shape = s_bits.shape[:-1]
+    nbits = s_bits.shape[-1]
+    t0 = identity(batch_shape)
+    t1 = base_point(batch_shape)
+    t2 = a
+    t3 = add(t1, a)
+
+    def select(b_s: jnp.ndarray, b_k: jnp.ndarray) -> Point:
+        bs = b_s[..., None]
+        bk = b_k[..., None]
+        coords = []
+        for c0, c1, c2, c3 in zip(t0, t1, t2, t3):
+            lo = jnp.where(bs == 1, c1, c0)
+            hi = jnp.where(bs == 1, c3, c2)
+            coords.append(jnp.where(bk == 1, hi, lo))
+        return Point(*coords)
+
+    def body(it: jnp.ndarray, acc: Point) -> Point:
+        i = nbits - 1 - it
+        acc = double(acc)
+        b_s = jax.lax.dynamic_index_in_dim(s_bits, i, axis=-1, keepdims=False)
+        b_k = jax.lax.dynamic_index_in_dim(k_bits, i, axis=-1, keepdims=False)
+        return add(acc, select(b_s, b_k))
+
+    return jax.lax.fori_loop(0, nbits, body, identity(batch_shape))

@@ -1,0 +1,205 @@
+"""GF(2^255 - 19) arithmetic on TPU vector lanes.
+
+Representation: radix-2^13, 20 limbs (260 bits), little-endian, int32.
+Chosen so every intermediate of a schoolbook 20x20 limb convolution fits
+signed int32 — the TPU VPU's native integer width (no int64, no widening
+multiply): carried limbs are <= 2^13 + eps, so each product is < 2^26 and a
+20-term column sum is < 2^31. All ops are elementwise over arbitrary leading
+batch dims: one TPU lane = one field element = one signature being verified.
+
+Invariant ("carried"): limbs in [0, 2^13 + 16]. add/sub/mul/sq take and
+return carried values. Values are redundant mod p (anywhere in [0, ~2^260));
+canonicalize() produces the unique representative in [0, p) for comparisons,
+parity checks, and re-compression.
+
+Reference seam: this replaces the 64-bit limb arithmetic inside
+curve25519-voi that the Go reference leans on (crypto/ed25519/ed25519.go:37);
+the design here is TPU-native, not a translation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cometbft_tpu.ops import limbs as L
+
+RADIX = L.RADIX
+NLIMBS = L.NLIMBS
+MASK = L.MASK
+
+P = 2**255 - 19
+# 2^260 mod p = 2^5 * 19: the fold multiplier for carry-out of limb 19.
+FOLD = 19 << (NLIMBS * RADIX - 255)  # 608
+
+# d, 2d, sqrt(-1) as limb constants.
+_D_INT = (-121665 * pow(121666, P - 2, P)) % P
+_SQRT_M1_INT = pow(2, (P - 1) // 4, P)
+
+
+def _const(x: int) -> jnp.ndarray:
+    return jnp.asarray(L.int_to_limbs(x), dtype=jnp.int32)
+
+
+def _const_loose(x: int) -> jnp.ndarray:
+    """Constant whose top limb may exceed 13 bits (used for the subtraction
+    bias M = 33p, which is 261 bits)."""
+    out = np.zeros(NLIMBS, dtype=np.int64)
+    for i in range(NLIMBS - 1):
+        out[i] = x & MASK
+        x >>= RADIX
+    out[NLIMBS - 1] = x
+    assert x < 2**15
+    return jnp.asarray(out, dtype=jnp.int32)
+
+
+P_LIMBS = _const(P)
+D = _const(_D_INT)
+D2 = _const((2 * _D_INT) % P)
+SQRT_M1 = _const(_SQRT_M1_INT)
+ONE = _const(1)
+# Subtraction bias: smallest multiple of p that dominates any carried value
+# (carried max ~ 2^260 + 2^251 < 33p), keeping a + M - b positive.
+M_SUB = _const_loose(33 * P)
+
+
+def zeros_like(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.zeros_like(a)
+
+
+def _chain(limbs_list: list[jnp.ndarray]) -> tuple[list[jnp.ndarray], jnp.ndarray]:
+    """One sequential carry pass. Arithmetic right-shift handles negative
+    intermediates (from sub) correctly: v>>13 floors, v&MASK is nonneg."""
+    out = []
+    c = jnp.zeros_like(limbs_list[0])
+    for v in limbs_list:
+        v = v + c
+        c = v >> RADIX
+        out.append(v & MASK)
+    return out, c
+
+
+def weak_carry(x: jnp.ndarray) -> jnp.ndarray:
+    """Reduce limbs to carried range. Two full passes + top fold: handles
+    any input with |limb| < ~2^30 (covers post-convolution magnitudes)."""
+    l = [x[..., i] for i in range(NLIMBS)]
+    l, c = _chain(l)
+    l[0] = l[0] + c * FOLD
+    l, c = _chain(l)
+    l[0] = l[0] + c * FOLD  # c <= 1 here
+    c2 = l[0] >> RADIX
+    l[0] = l[0] & MASK
+    l[1] = l[1] + c2
+    return jnp.stack(l, axis=-1)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return weak_carry(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return weak_carry(a + M_SUB - b)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return weak_carry(M_SUB - a)
+
+
+def _conv_reduce(conv: list[jnp.ndarray]) -> jnp.ndarray:
+    """Carry the 39-column product convolution, fold 2^260 = FOLD, carry."""
+    conv, c = _chain(conv)  # each column <= 8191, carry-out < 2^18
+    lo = conv[:NLIMBS]
+    hi = conv[NLIMBS:] + [c]
+    out = [lo[i] + FOLD * hi[i] for i in range(NLIMBS)]
+    return weak_carry(jnp.stack(out, axis=-1))
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    al = [a[..., i] for i in range(NLIMBS)]
+    bl = [b[..., i] for i in range(NLIMBS)]
+    conv: list = [None] * (2 * NLIMBS - 1)
+    for i in range(NLIMBS):
+        for j in range(NLIMBS):
+            t = al[i] * bl[j]
+            k = i + j
+            conv[k] = t if conv[k] is None else conv[k] + t
+    return _conv_reduce(conv)
+
+
+def sq(a: jnp.ndarray) -> jnp.ndarray:
+    al = [a[..., i] for i in range(NLIMBS)]
+    conv: list = [None] * (2 * NLIMBS - 1)
+    for i in range(NLIMBS):
+        t = al[i] * al[i]
+        conv[2 * i] = t if conv[2 * i] is None else conv[2 * i] + t
+        for j in range(i + 1, NLIMBS):
+            t = 2 * (al[i] * al[j])
+            k = i + j
+            conv[k] = t if conv[k] is None else conv[k] + t
+    return _conv_reduce(conv)
+
+
+def _sqn(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """x^(2^n) via n squarings. Uses fori_loop so the HLO stays small for
+    the long runs inside the inversion/sqrt addition chains."""
+    if n <= 4:
+        for _ in range(n):
+            x = sq(x)
+        return x
+    return jax.lax.fori_loop(0, n, lambda _, v: sq(v), x)
+
+
+def pow22523(z: jnp.ndarray) -> jnp.ndarray:
+    """z^((p-5)/8) = z^(2^252 - 3) — the exponentiation at the heart of
+    modular sqrt / point decompression. Standard ref10 addition chain
+    (254 squarings + 11 multiplies), expressed with fori_loop squaring runs."""
+    z2 = sq(z)
+    z9 = mul(_sqn(z2, 2), z)
+    z11 = mul(z9, z2)
+    z_5_0 = mul(sq(z11), z9)  # 2^5 - 2^0
+    z_10_0 = mul(_sqn(z_5_0, 5), z_5_0)
+    z_20_0 = mul(_sqn(z_10_0, 10), z_10_0)
+    z_40_0 = mul(_sqn(z_20_0, 20), z_20_0)
+    z_50_0 = mul(_sqn(z_40_0, 10), z_10_0)
+    z_100_0 = mul(_sqn(z_50_0, 50), z_50_0)
+    z_200_0 = mul(_sqn(z_100_0, 100), z_100_0)
+    z_250_0 = mul(_sqn(z_200_0, 50), z_50_0)
+    return mul(_sqn(z_250_0, 2), z)
+
+
+def canonicalize(x: jnp.ndarray) -> jnp.ndarray:
+    """Unique representative mod p, limbs canonical, value in [0, p)."""
+    x = weak_carry(x)
+    l = [x[..., i] for i in range(NLIMBS)]
+    for _ in range(2):  # fold bits >= 255: 2^255 = 19 mod p
+        hi = l[NLIMBS - 1] >> (255 - (NLIMBS - 1) * RADIX)
+        l[NLIMBS - 1] = l[NLIMBS - 1] & ((1 << (255 - (NLIMBS - 1) * RADIX)) - 1)
+        l[0] = l[0] + 19 * hi
+        l, c = _chain(l)
+        l[0] = l[0] + c * FOLD  # c == 0 in fact; keep for safety
+    # value now < 2^255 + 19 < 2p: one conditional subtract of p.
+    pl = [P_LIMBS[i] for i in range(NLIMBS)]
+    borrow = jnp.zeros_like(l[0])
+    sub_l = []
+    for i in range(NLIMBS):
+        v = l[i] - pl[i] - borrow
+        borrow = (v < 0).astype(jnp.int32)
+        sub_l.append(v + (borrow << RADIX))
+    ge_p = borrow == 0
+    out = [jnp.where(ge_p, sub_l[i], l[i]) for i in range(NLIMBS)]
+    return jnp.stack(out, axis=-1)
+
+
+def is_zero(x: jnp.ndarray) -> jnp.ndarray:
+    """(..., 20) -> (...,) bool: x == 0 mod p."""
+    return jnp.all(canonicalize(x) == 0, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return is_zero(sub(a, b))
+
+
+def parity(x: jnp.ndarray) -> jnp.ndarray:
+    """LSB of the canonical representative (the compressed sign bit)."""
+    return canonicalize(x)[..., 0] & 1

@@ -1,0 +1,28 @@
+"""Domain model: blocks, votes, commits, validator sets, evidence.
+
+Mirrors the semantics of the reference's types/ package (SURVEY.md §2.1):
+canonical protobuf sign-bytes are byte-compatible (types/canonical.go),
+commit verification runs over the batch-first crypto boundary
+(types/validation.go), and VoteSet accumulates signatures toward device-side
+batches. The internal architecture is this framework's own.
+"""
+
+from cometbft_tpu.types.basic import (  # noqa: F401
+    BlockID,
+    BlockIDFlag,
+    PartSetHeader,
+    SignedMsgType,
+    MAX_VOTES_COUNT,
+)
+from cometbft_tpu.types.validator import Validator, ValidatorSet  # noqa: F401
+from cometbft_tpu.types.vote import Vote  # noqa: F401
+from cometbft_tpu.types.commit import Commit, CommitSig, ExtendedCommit, ExtendedCommitSig  # noqa: F401
+from cometbft_tpu.types.proposal import Proposal  # noqa: F401
+from cometbft_tpu.types.validation import (  # noqa: F401
+    verify_commit,
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
+from cometbft_tpu.types.vote_set import VoteSet  # noqa: F401
+from cometbft_tpu.types.block import Block, Data, EvidenceData, Header  # noqa: F401
+from cometbft_tpu.types.part_set import Part, PartSet  # noqa: F401

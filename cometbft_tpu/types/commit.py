@@ -1,0 +1,235 @@
+"""Commit and CommitSig (reference: types/block.go:574-900).
+
+A Commit is the +2/3 precommit aggregate persisted in every block's
+LastCommit; each CommitSig records one validator's precommit (or absence).
+Commit.vote_sign_bytes reconstructs the exact canonical bytes each validator
+signed — the input rows of the TPU verification batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from cometbft_tpu import crypto
+from cometbft_tpu.crypto import merkle
+from cometbft_tpu.types.basic import BlockID, BlockIDFlag, SignedMsgType
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.utils import cmttime
+from cometbft_tpu.utils import protobuf as pb
+
+MAX_COMMIT_OVERHEAD_BYTES = 94
+MAX_COMMIT_SIG_BYTES = 109
+
+
+@dataclass
+class CommitSig:
+    """types/block.go:586-600."""
+
+    block_id_flag: BlockIDFlag
+    validator_address: bytes = b""
+    timestamp: cmttime.Timestamp = field(default_factory=cmttime.Timestamp.zero)
+    signature: bytes = b""
+
+    @classmethod
+    def absent(cls) -> "CommitSig":
+        return cls(block_id_flag=BlockIDFlag.ABSENT)
+
+    def for_block(self) -> bool:
+        return self.block_id_flag == BlockIDFlag.COMMIT
+
+    def block_id(self, commit_block_id: BlockID) -> BlockID:
+        """types/block.go:632-645."""
+        if self.block_id_flag == BlockIDFlag.COMMIT:
+            return commit_block_id
+        return BlockID()
+
+    def validate_basic(self) -> None:
+        if self.block_id_flag not in (BlockIDFlag.ABSENT, BlockIDFlag.COMMIT, BlockIDFlag.NIL):
+            raise ValueError(f"unknown BlockIDFlag: {self.block_id_flag}")
+        if self.block_id_flag == BlockIDFlag.ABSENT:
+            if self.validator_address:
+                raise ValueError("validator address is present for absent CommitSig")
+            if not self.timestamp.is_zero():
+                raise ValueError("time is present for absent CommitSig")
+            if self.signature:
+                raise ValueError("signature is present for absent CommitSig")
+        else:
+            if len(self.validator_address) != crypto.ADDRESS_SIZE:
+                raise ValueError("expected ValidatorAddress size to be 20 bytes")
+            if not self.signature:
+                raise ValueError("signature is missing")
+            if len(self.signature) > 64:
+                raise ValueError("signature is too big")
+
+    def to_proto(self) -> bytes:
+        w = pb.Writer()
+        w.uvarint(1, int(self.block_id_flag))
+        w.bytes(2, self.validator_address)
+        w.message(3, pb.timestamp_bytes(self.timestamp.seconds, self.timestamp.nanos), always=True)
+        w.bytes(4, self.signature)
+        return w.output()
+
+    @classmethod
+    def from_proto(cls, data: bytes) -> "CommitSig":
+        r = pb.Reader(data)
+        cs = cls(block_id_flag=BlockIDFlag.ABSENT)
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1:
+                cs.block_id_flag = BlockIDFlag(r.read_uvarint())
+            elif f == 2:
+                cs.validator_address = r.read_bytes()
+            elif f == 3:
+                tr = r.read_message()
+                secs = nanos = 0
+                while not tr.at_end():
+                    tf, tw = tr.read_tag()
+                    if tf == 1:
+                        secs = tr.read_varint_i64()
+                    elif tf == 2:
+                        nanos = tr.read_varint_i64()
+                    else:
+                        tr.skip(tw)
+                cs.timestamp = cmttime.Timestamp(secs, nanos)
+            elif f == 4:
+                cs.signature = r.read_bytes()
+            else:
+                r.skip(w)
+        return cs
+
+
+@dataclass
+class Commit:
+    """types/block.go:700-760."""
+
+    height: int
+    round_: int
+    block_id: BlockID
+    signatures: list[CommitSig]
+    _hash: bytes | None = field(default=None, repr=False, compare=False)
+
+    def size(self) -> int:
+        return len(self.signatures)
+
+    def get_vote(self, val_idx: int) -> Vote:
+        """Reconstruct the precommit Vote for signature val_idx
+        (types/block.go:857-869)."""
+        cs = self.signatures[val_idx]
+        return Vote(
+            type_=SignedMsgType.PRECOMMIT,
+            height=self.height,
+            round_=self.round_,
+            block_id=cs.block_id(self.block_id),
+            timestamp=cs.timestamp,
+            validator_address=cs.validator_address,
+            validator_index=val_idx,
+            signature=cs.signature,
+        )
+
+    def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
+        """types/block.go:880-883 — the batch-verification row builder."""
+        return self.get_vote(val_idx).sign_bytes(chain_id)
+
+    def hash(self) -> bytes:
+        """Merkle root over CommitSig protos (types/block.go Commit.Hash)."""
+        if self._hash is None:
+            self._hash = merkle.hash_from_byte_slices(
+                [cs.to_proto() for cs in self.signatures]
+            )
+        return self._hash
+
+    def validate_basic(self) -> None:
+        if self.height < 0:
+            raise ValueError("negative Height")
+        if self.round_ < 0:
+            raise ValueError("negative Round")
+        if self.height >= 1:
+            if self.block_id.is_nil():
+                raise ValueError("commit cannot be for nil block")
+            if not self.signatures:
+                raise ValueError("no signatures in commit")
+            for cs in self.signatures:
+                cs.validate_basic()
+
+    def to_proto(self) -> bytes:
+        w = pb.Writer()
+        w.varint_i64(1, self.height)
+        w.varint_i64(2, self.round_)
+        w.message(3, self.block_id.to_proto(), always=True)
+        for cs in self.signatures:
+            w.message(4, cs.to_proto(), always=True)
+        return w.output()
+
+    @classmethod
+    def from_proto(cls, data: bytes) -> "Commit":
+        r = pb.Reader(data)
+        c = cls(height=0, round_=0, block_id=BlockID(), signatures=[])
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1:
+                c.height = r.read_varint_i64()
+            elif f == 2:
+                c.round_ = r.read_varint_i64()
+            elif f == 3:
+                c.block_id = BlockID.from_proto(r.read_bytes())
+            elif f == 4:
+                c.signatures.append(CommitSig.from_proto(r.read_bytes()))
+            else:
+                r.skip(w)
+        return c
+
+
+@dataclass
+class ExtendedCommitSig:
+    """CommitSig + vote-extension data (types/block.go:741-800, ABCI 2.0)."""
+
+    commit_sig: CommitSig
+    extension: bytes = b""
+    extension_signature: bytes = b""
+
+    def validate_basic(self) -> None:
+        self.commit_sig.validate_basic()
+        if self.commit_sig.block_id_flag == BlockIDFlag.COMMIT:
+            return
+        if self.extension:
+            raise ValueError("vote extension is present for non-commit CommitSig")
+        if self.extension_signature:
+            raise ValueError("vote extension signature is present for non-commit CommitSig")
+
+
+@dataclass
+class ExtendedCommit:
+    """types/block.go:708-856: a commit carrying vote extensions, stored for
+    the latest height to rebuild LastCommit precommits (for PrepareProposal)."""
+
+    height: int
+    round_: int
+    block_id: BlockID
+    extended_signatures: list[ExtendedCommitSig]
+
+    def to_commit(self) -> Commit:
+        return Commit(
+            height=self.height,
+            round_=self.round_,
+            block_id=self.block_id,
+            signatures=[e.commit_sig for e in self.extended_signatures],
+        )
+
+    def size(self) -> int:
+        return len(self.extended_signatures)
+
+    def get_extended_vote(self, val_idx: int) -> Vote:
+        e = self.extended_signatures[val_idx]
+        v = self.to_commit().get_vote(val_idx)
+        v.extension = e.extension
+        v.extension_signature = e.extension_signature
+        return v
+
+    def ensure_extensions(self, required: bool) -> None:
+        """types/block.go:765-785."""
+        for e in self.extended_signatures:
+            cs = e.commit_sig
+            if required and cs.block_id_flag == BlockIDFlag.COMMIT and not e.extension_signature:
+                raise ValueError("vote extension signature is missing")
+            if cs.block_id_flag != BlockIDFlag.COMMIT and (e.extension or e.extension_signature):
+                raise ValueError("non-commit vote carries extension data")

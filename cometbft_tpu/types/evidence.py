@@ -1,0 +1,237 @@
+"""Byzantine evidence types (reference: types/evidence.go).
+
+DuplicateVoteEvidence — equivocation: two signed votes for the same
+height/round/type but different blocks. LightClientAttackEvidence — a
+conflicting light block trace. Verification lives in evidence/verify.py
+(pool-side); here are the types, hashing, and ABCI conversion.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from cometbft_tpu.crypto import tmhash
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.utils import cmttime
+from cometbft_tpu.utils import protobuf as pb
+
+
+class Evidence:
+    """types/evidence.go Evidence interface."""
+
+    def abci(self) -> list[dict]:
+        raise NotImplementedError
+
+    def bytes_(self) -> bytes:
+        raise NotImplementedError
+
+    def hash(self) -> bytes:
+        return tmhash.sum(self.bytes_())
+
+    def height(self) -> int:
+        raise NotImplementedError
+
+    def time(self) -> cmttime.Timestamp:
+        raise NotImplementedError
+
+    def validate_basic(self) -> None:
+        raise NotImplementedError
+
+    def string(self) -> str:
+        raise NotImplementedError
+
+
+@dataclass
+class DuplicateVoteEvidence(Evidence):
+    """types/evidence.go:53-71."""
+
+    vote_a: Vote
+    vote_b: Vote
+    total_voting_power: int = 0
+    validator_power: int = 0
+    timestamp: cmttime.Timestamp = field(default_factory=cmttime.Timestamp.zero)
+
+    @classmethod
+    def new(
+        cls,
+        vote1: Vote,
+        vote2: Vote,
+        block_time: cmttime.Timestamp,
+        val_set: ValidatorSet,
+    ) -> "DuplicateVoteEvidence":
+        """types/evidence.go NewDuplicateVoteEvidence: orders votes by
+        BlockID key, fills powers from the valset."""
+        if vote1 is None or vote2 is None or val_set is None:
+            raise ValueError("missing vote or validator set")
+        _, val = val_set.get_by_address(vote1.validator_address)
+        if val is None:
+            raise ValueError("validator is not in validator set")
+        if vote1.block_id.key() < vote2.block_id.key():
+            vote_a, vote_b = vote1, vote2
+        else:
+            vote_a, vote_b = vote2, vote1
+        return cls(
+            vote_a=vote_a,
+            vote_b=vote_b,
+            total_voting_power=val_set.total_voting_power(),
+            validator_power=val.voting_power,
+            timestamp=block_time,
+        )
+
+    def abci(self) -> list[dict]:
+        return [
+            {
+                "type": "DUPLICATE_VOTE",
+                "validator_address": self.vote_a.validator_address,
+                "validator_power": self.validator_power,
+                "height": self.vote_a.height,
+                "time": self.timestamp,
+                "total_voting_power": self.total_voting_power,
+            }
+        ]
+
+    def bytes_(self) -> bytes:
+        return self.to_proto()
+
+    def height(self) -> int:
+        return self.vote_a.height
+
+    def time(self) -> cmttime.Timestamp:
+        return self.timestamp
+
+    def validate_basic(self) -> None:
+        if self.vote_a is None or self.vote_b is None:
+            raise ValueError("empty duplicate vote evidence")
+        self.vote_a.validate_basic()
+        self.vote_b.validate_basic()
+        if self.vote_a.block_id.key() >= self.vote_b.block_id.key():
+            raise ValueError("duplicate votes in invalid order")
+
+    def string(self) -> str:
+        return f"DuplicateVoteEvidence{{VoteA: {self.vote_a}, VoteB: {self.vote_b}}}"
+
+    def to_proto(self) -> bytes:
+        w = pb.Writer()
+        w.message(1, self.vote_a.to_proto(), always=True)
+        w.message(2, self.vote_b.to_proto(), always=True)
+        w.varint_i64(3, self.total_voting_power)
+        w.varint_i64(4, self.validator_power)
+        w.message(
+            5, pb.timestamp_bytes(self.timestamp.seconds, self.timestamp.nanos), always=True
+        )
+        return w.output()
+
+    @classmethod
+    def from_proto(cls, data: bytes) -> "DuplicateVoteEvidence":
+        r = pb.Reader(data)
+        ev = cls(vote_a=None, vote_b=None)  # type: ignore[arg-type]
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1:
+                ev.vote_a = Vote.from_proto(r.read_bytes())
+            elif f == 2:
+                ev.vote_b = Vote.from_proto(r.read_bytes())
+            elif f == 3:
+                ev.total_voting_power = r.read_varint_i64()
+            elif f == 4:
+                ev.validator_power = r.read_varint_i64()
+            elif f == 5:
+                tr = r.read_message()
+                secs = nanos = 0
+                while not tr.at_end():
+                    tf, tw = tr.read_tag()
+                    if tf == 1:
+                        secs = tr.read_varint_i64()
+                    elif tf == 2:
+                        nanos = tr.read_varint_i64()
+                    else:
+                        tr.skip(tw)
+                ev.timestamp = cmttime.Timestamp(secs, nanos)
+            else:
+                r.skip(w)
+        return ev
+
+
+@dataclass
+class LightClientAttackEvidence(Evidence):
+    """types/evidence.go:203-260. Carries the conflicting light block and the
+    common height; byzantine validators filled in by the evidence pool."""
+
+    conflicting_block: "object"  # light.LightBlock (avoid circular import)
+    common_height: int
+    byzantine_validators: list[Validator] = field(default_factory=list)
+    total_voting_power: int = 0
+    timestamp: cmttime.Timestamp = field(default_factory=cmttime.Timestamp.zero)
+
+    def abci(self) -> list[dict]:
+        return [
+            {
+                "type": "LIGHT_CLIENT_ATTACK",
+                "validator_address": v.address,
+                "validator_power": v.voting_power,
+                "height": self.height(),
+                "time": self.timestamp,
+                "total_voting_power": self.total_voting_power,
+            }
+            for v in self.byzantine_validators
+        ]
+
+    def bytes_(self) -> bytes:
+        w = pb.Writer()
+        # structural encoding: conflicting block header hash + common height
+        sh = self.conflicting_block.signed_header if self.conflicting_block else None
+        w.bytes(1, sh.header.hash() if sh else b"")
+        w.varint_i64(2, self.common_height)
+        w.varint_i64(3, self.total_voting_power)
+        w.message(
+            4, pb.timestamp_bytes(self.timestamp.seconds, self.timestamp.nanos), always=True
+        )
+        return w.output()
+
+    def height(self) -> int:
+        return self.common_height
+
+    def time(self) -> cmttime.Timestamp:
+        return self.timestamp
+
+    def validate_basic(self) -> None:
+        if self.conflicting_block is None:
+            raise ValueError("conflicting block is nil")
+        if self.common_height <= 0:
+            raise ValueError("negative or zero common height")
+
+    def string(self) -> str:
+        return f"LightClientAttackEvidence{{CommonHeight: {self.common_height}}}"
+
+
+def evidence_list_to_proto(evs: list[Evidence]) -> bytes:
+    """tendermint.types.EvidenceList: repeated oneof-wrapped evidence."""
+    w = pb.Writer()
+    for ev in evs:
+        inner = pb.Writer()
+        if isinstance(ev, DuplicateVoteEvidence):
+            inner.message(1, ev.to_proto(), always=True)
+        else:
+            raise ValueError(f"unsupported evidence type for wire: {type(ev)}")
+        w.message(1, inner.output(), always=True)
+    return w.output()
+
+
+def evidence_list_from_proto(data: bytes) -> list[Evidence]:
+    out: list[Evidence] = []
+    r = pb.Reader(data)
+    while not r.at_end():
+        f, w = r.read_tag()
+        if f == 1:
+            er = r.read_message()
+            while not er.at_end():
+                ef, ew = er.read_tag()
+                if ef == 1:
+                    out.append(DuplicateVoteEvidence.from_proto(er.read_bytes()))
+                else:
+                    er.skip(ew)
+        else:
+            r.skip(w)
+    return out

@@ -1,0 +1,223 @@
+"""Commit verification over the batch-first crypto boundary.
+
+Reference: types/validation.go. All three entry points funnel signature rows
+into one BatchVerifier (TPU kernel or CPU loop, crypto/batch dispatch) —
+on failure the per-lane mask pinpoints the first bad signature without the
+reference's serial re-verify pass (types/validation.go:266).
+
+Semantics preserved exactly:
+  verify_commit            — counts only COMMIT flags, verifies ALL non-absent
+                             signatures (incentivization rule,
+                             types/validation.go:19-25), 1:1 index lookup.
+  verify_commit_light      — counts all non-ignored, stops at +2/3, 1:1 index.
+  verify_commit_light_trusting — trust-fraction threshold, lookup by address
+                             (valset may differ from the commit's), duplicate
+                             detection.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.types.basic import BlockID, BlockIDFlag
+from cometbft_tpu.types.commit import Commit, CommitSig
+from cometbft_tpu.types.validator import ValidatorSet
+
+BATCH_VERIFY_THRESHOLD = 2  # types/validation.go:13
+
+
+@dataclass(frozen=True)
+class Fraction:
+    """libs/math Fraction — light-client trust level."""
+
+    numerator: int
+    denominator: int
+
+
+class ErrNotEnoughVotingPowerSigned(Exception):
+    def __init__(self, got: int, needed: int):
+        super().__init__(f"invalid commit -- insufficient voting power: got {got}, needed more than {needed}")
+        self.got = got
+        self.needed = needed
+
+
+class ErrInvalidCommitSignature(Exception):
+    pass
+
+
+def _verify_basic(vals: ValidatorSet, commit: Commit, height: int, block_id: BlockID) -> None:
+    """types/validation.go verifyBasicValsAndCommit."""
+    if vals is None or vals.is_nil_or_empty():
+        raise ValueError("nil or empty validator set")
+    if commit is None:
+        raise ValueError("nil commit")
+    if len(vals) != len(commit.signatures):
+        raise ValueError(
+            f"invalid commit -- wrong set size: {len(vals)} vs {len(commit.signatures)}"
+        )
+    if height != commit.height:
+        raise ValueError(f"invalid commit -- wrong height: {height} vs {commit.height}")
+    if block_id != commit.block_id:
+        raise ValueError(
+            f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
+        )
+
+
+def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
+    return len(commit.signatures) >= BATCH_VERIFY_THRESHOLD and crypto_batch.supports_batch_verifier(
+        vals.get_proposer().pub_key if vals.get_proposer() else None
+    )
+
+
+def _verify_commit_batch(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+    lookup_by_index: bool,
+) -> None:
+    """types/validation.go:153-257."""
+    bv = crypto_batch.create_batch_verifier(vals.get_proposer().pub_key)
+    seen_vals: dict[int, int] = {}
+    batch_sig_idxs: list[int] = []
+    tallied = 0
+    for idx, cs in enumerate(commit.signatures):
+        if ignore_sig(cs):
+            continue
+        if lookup_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(cs.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise ValueError(
+                    f"double vote from {val.address.hex()} ({seen_vals[val_idx]} and {idx})"
+                )
+            seen_vals[val_idx] = idx
+        bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature)
+        batch_sig_idxs.append(idx)
+        if count_sig(cs):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            break
+    if tallied <= voting_power_needed:
+        raise ErrNotEnoughVotingPowerSigned(got=tallied, needed=voting_power_needed)
+    ok, valid_sigs = bv.verify()
+    if ok:
+        return
+    for i, sig_ok in enumerate(valid_sigs):
+        if not sig_ok:
+            idx = batch_sig_idxs[i]
+            raise ErrInvalidCommitSignature(
+                f"wrong signature (#{idx}): {commit.signatures[idx].signature.hex()}"
+            )
+    raise RuntimeError("BUG: batch verification failed with no invalid signatures")
+
+
+def _verify_commit_single(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+    lookup_by_index: bool,
+) -> None:
+    """types/validation.go:266-330."""
+    seen_vals: dict[int, int] = {}
+    tallied = 0
+    for idx, cs in enumerate(commit.signatures):
+        if ignore_sig(cs):
+            continue
+        if lookup_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(cs.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise ValueError(
+                    f"double vote from {val.address.hex()} ({seen_vals[val_idx]} and {idx})"
+                )
+            seen_vals[val_idx] = idx
+        sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        if not val.pub_key.verify_signature(sign_bytes, cs.signature):
+            raise ErrInvalidCommitSignature(
+                f"wrong signature (#{idx}): {cs.signature.hex()}"
+            )
+        if count_sig(cs):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            return
+    if tallied <= voting_power_needed:
+        raise ErrNotEnoughVotingPowerSigned(got=tallied, needed=voting_power_needed)
+
+
+def verify_commit(
+    chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
+) -> None:
+    """+2/3 signed; checks ALL signatures (types/validation.go:26-57)."""
+    _verify_basic(vals, commit, height, block_id)
+    needed = vals.total_voting_power() * 2 // 3
+
+    def ignore(c: CommitSig) -> bool:
+        return c.block_id_flag == BlockIDFlag.ABSENT
+
+    def count(c: CommitSig) -> bool:
+        return c.block_id_flag == BlockIDFlag.COMMIT
+
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(chain_id, vals, commit, needed, ignore, count, True, True)
+    else:
+        _verify_commit_single(chain_id, vals, commit, needed, ignore, count, True, True)
+
+
+def verify_commit_light(
+    chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
+) -> None:
+    """+2/3 signed; stops early (types/validation.go:60-92)."""
+    _verify_basic(vals, commit, height, block_id)
+    needed = vals.total_voting_power() * 2 // 3
+
+    def ignore(c: CommitSig) -> bool:
+        return c.block_id_flag != BlockIDFlag.COMMIT
+
+    def count(c: CommitSig) -> bool:
+        return True
+
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(chain_id, vals, commit, needed, ignore, count, False, True)
+    else:
+        _verify_commit_single(chain_id, vals, commit, needed, ignore, count, False, True)
+
+
+def verify_commit_light_trusting(
+    chain_id: str, vals: ValidatorSet, commit: Commit, trust_level: Fraction
+) -> None:
+    """trustLevel of the (possibly different) valset signed
+    (types/validation.go:95-131)."""
+    if vals is None:
+        raise ValueError("nil validator set")
+    if trust_level.denominator == 0:
+        raise ValueError("trustLevel has zero Denominator")
+    if commit is None:
+        raise ValueError("nil commit")
+    needed = vals.total_voting_power() * trust_level.numerator // trust_level.denominator
+
+    def ignore(c: CommitSig) -> bool:
+        return c.block_id_flag != BlockIDFlag.COMMIT
+
+    def count(c: CommitSig) -> bool:
+        return True
+
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(chain_id, vals, commit, needed, ignore, count, False, False)
+    else:
+        _verify_commit_single(chain_id, vals, commit, needed, ignore, count, False, False)

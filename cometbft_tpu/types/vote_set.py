@@ -1,0 +1,424 @@
+"""VoteSet — vote accumulation with batch-first verification.
+
+Reference: types/vote_set.go. The reference verifies every incoming vote
+serially on the consensus goroutine (vote_set.go:218-231 — ~50-100 us each,
+10k serial verifies per step at max valset, SURVEY.md §3.3). This VoteSet
+keeps those semantics for add_vote() but adds the TPU-shaped path the
+north-star demands:
+
+  add_pending(vote)  — cheap structural checks + staging + SPECULATIVE tally;
+                       no consensus-visible state changes.
+  flush_pending()    — one batched device verification of all staged votes;
+                       only then are votes added to the real tally.
+
+The "never count an unverified vote" invariant holds: two_thirds_majority(),
+get_vote(), make_commit() etc. read only verified state. The speculative
+tally is used solely to decide when flushing is worthwhile (quorum boundary),
+mirroring the deferred-flush design in SURVEY.md §7 step 2. Conflicting-vote
+(equivocation) evidence semantics are preserved for both paths.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.libs.bits import BitArray
+from cometbft_tpu.types.basic import MAX_VOTES_COUNT, BlockID, BlockIDFlag, SignedMsgType
+from cometbft_tpu.types.commit import Commit, CommitSig, ExtendedCommit, ExtendedCommitSig
+from cometbft_tpu.types.validator import ValidatorSet
+from cometbft_tpu.types.vote import Vote
+
+
+class ErrVoteConflictingVotes(Exception):
+    """Equivocation detected — carries both votes (evidence material)."""
+
+    def __init__(self, vote_a: Vote, vote_b: Vote):
+        super().__init__(f"conflicting votes from validator {vote_b.validator_address.hex()}")
+        self.vote_a = vote_a
+        self.vote_b = vote_b
+
+
+class ErrVoteInvalidSignature(Exception):
+    pass
+
+
+@dataclass
+class _BlockVotes:
+    """Votes for one particular block (vote_set.go:471-500)."""
+
+    peer_maj23: bool
+    bit_array: BitArray
+    votes: list[Vote | None]
+    sum: int
+
+    @classmethod
+    def new(cls, peer_maj23: bool, num_validators: int) -> "_BlockVotes":
+        return cls(
+            peer_maj23=peer_maj23,
+            bit_array=BitArray(num_validators),
+            votes=[None] * num_validators,
+            sum=0,
+        )
+
+    def add_verified_vote(self, vote: Vote, voting_power: int) -> None:
+        idx = vote.validator_index
+        if self.votes[idx] is None:
+            self.bit_array.set_index(idx, True)
+            self.votes[idx] = vote
+            self.sum += voting_power
+
+    def get_by_index(self, idx: int) -> Vote | None:
+        return self.votes[idx]
+
+
+class VoteSet:
+    """vote_set.go:55-100."""
+
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        round_: int,
+        signed_msg_type: SignedMsgType,
+        val_set: ValidatorSet,
+        extensions_enabled: bool = False,
+        batch_flush_size: int = 128,
+    ):
+        if height == 0:
+            raise ValueError("cannot make VoteSet for height == 0, doesn't make sense")
+        if len(val_set) > MAX_VOTES_COUNT:
+            raise ValueError(f"validator set exceeds MaxVotesCount {MAX_VOTES_COUNT}")
+        self.chain_id = chain_id
+        self.height = height
+        self.round_ = round_
+        self.signed_msg_type = signed_msg_type
+        self.extensions_enabled = extensions_enabled
+        self.val_set = val_set
+        self.votes_bit_array = BitArray(len(val_set))
+        self.votes: list[Vote | None] = [None] * len(val_set)
+        self.sum = 0
+        self.maj23: BlockID | None = None
+        self.votes_by_block: dict[bytes, _BlockVotes] = {}
+        self.peer_maj23s: dict[str, BlockID] = {}
+        # --- batch path state ---
+        self.batch_flush_size = batch_flush_size
+        self._pending: list[tuple[Vote, int]] = []  # (vote, voting_power)
+        self._pending_keys: set[tuple[int, bytes]] = set()
+        self._speculative_sum = 0
+
+    def size(self) -> int:
+        return len(self.val_set)
+
+    # ------------------------------------------------------ serial add path
+
+    def add_vote(self, vote: Vote) -> bool:
+        """Reference addVote (vote_set.go:157-231): full structural checks +
+        serial signature verification + verified-tally update. Returns True
+        if added; False for exact duplicates; raises on anything bad."""
+        val, _ = self._check_structure(vote)
+        existing = self._get_vote(vote.validator_index, vote.block_id.key())
+        if existing is not None:
+            if existing.signature == vote.signature:
+                return False
+            raise ValueError(
+                f"non-deterministic signature: existing {existing}; new {vote}"
+            )
+        if self.extensions_enabled:
+            if not vote.verify_vote_and_extension(self.chain_id, val.pub_key):
+                raise ErrVoteInvalidSignature(f"failed to verify extended vote {vote}")
+        else:
+            if not vote.verify(self.chain_id, val.pub_key):
+                raise ErrVoteInvalidSignature(f"failed to verify vote {vote}")
+            if vote.extension or vote.extension_signature:
+                raise ValueError("unexpected vote extension data present in vote")
+        return self._add_verified_vote(vote, val.voting_power)
+
+    # ------------------------------------------------------- batch add path
+
+    def add_pending(self, vote: Vote) -> bool:
+        """Stage a vote for batched verification. Cheap host-side checks
+        only; consensus-visible state untouched. Returns True if staged
+        (auto-flushes at quorum boundaries / batch size; see flush_pending)."""
+        val, _ = self._check_structure(vote)
+        key = (vote.validator_index, vote.block_id.key())
+        if key in self._pending_keys:
+            return False
+        existing = self._get_vote(vote.validator_index, vote.block_id.key())
+        if existing is not None:
+            if existing.signature == vote.signature:
+                return False
+            raise ValueError(
+                f"non-deterministic signature: existing {existing}; new {vote}"
+            )
+        if not self.extensions_enabled and (vote.extension or vote.extension_signature):
+            raise ValueError("unexpected vote extension data present in vote")
+        self._pending.append((vote, val.voting_power))
+        self._pending_keys.add(key)
+        if self.votes[vote.validator_index] is None:
+            self._speculative_sum += val.voting_power
+        if self._should_flush():
+            self.flush_pending()
+        return True
+
+    def _should_flush(self) -> bool:
+        if len(self._pending) >= self.batch_flush_size:
+            return True
+        # quorum boundary: the speculative (unverified) tally would cross
+        # 2/3 — verifying now lets consensus observe the majority.
+        quorum = self.val_set.total_voting_power() * 2 // 3 + 1
+        return self.sum < quorum <= self.sum + self._speculative_sum
+
+    def flush_pending(self) -> list[tuple[Vote, bool]]:
+        """Verify all staged votes in ONE device batch; fold the valid ones
+        into the verified tally. Returns [(vote, valid)]. Conflicting votes
+        surface as ErrVoteConflictingVotes AFTER the tally is updated with
+        everything non-conflicting (matching serial-path ordering)."""
+        if not self._pending:
+            return []
+        pending, self._pending = self._pending, []
+        self._pending_keys.clear()
+        self._speculative_sum = 0
+
+        proposer = self.val_set.get_proposer()
+        results: list[tuple[Vote, bool]] = []
+        batchable = len(pending) >= 2 and crypto_batch.supports_batch_verifier(
+            proposer.pub_key if proposer else None
+        )
+        if batchable:
+            bv = crypto_batch.create_batch_verifier(proposer.pub_key)
+            for vote, _power in pending:
+                _, val = self.val_set.get_by_index(vote.validator_index)
+                bv.add(val.pub_key, vote.sign_bytes(self.chain_id), vote.signature)
+            _, mask = bv.verify()
+        else:
+            mask = []
+            for vote, _power in pending:
+                _, val = self.val_set.get_by_index(vote.validator_index)
+                mask.append(vote.verify(self.chain_id, val.pub_key))
+
+        ext_bad: set[int] = set()
+        if self.extensions_enabled:
+            # Extension signatures ride a second batch over the same keys.
+            ext_rows = [
+                (i, vote) for i, (vote, _) in enumerate(pending)
+                if mask[i] and not vote.block_id.is_nil()
+            ]
+            if ext_rows:
+                bv2 = crypto_batch.create_batch_verifier(proposer.pub_key)
+                for _, vote in ext_rows:
+                    _, val = self.val_set.get_by_index(vote.validator_index)
+                    bv2.add(val.pub_key, vote.extension_sign_bytes(self.chain_id), vote.extension_signature)
+                _, ext_mask = bv2.verify()
+                for (i, _), ok in zip(ext_rows, ext_mask):
+                    if not ok:
+                        ext_bad.add(i)
+
+        conflict: ErrVoteConflictingVotes | None = None
+        for i, (vote, power) in enumerate(pending):
+            ok = bool(mask[i]) and i not in ext_bad
+            if ok:
+                try:
+                    self._add_verified_vote(vote, power)
+                except ErrVoteConflictingVotes as e:
+                    conflict = conflict or e
+            results.append((vote, ok))
+        if conflict is not None:
+            raise conflict
+        return results
+
+    # -------------------------------------------------------------- internals
+
+    def _check_structure(self, vote: Vote):
+        if vote is None:
+            raise ValueError("nil vote")
+        if vote.validator_index < 0:
+            raise ValueError("index < 0: invalid validator index")
+        if not vote.validator_address:
+            raise ValueError("empty address: invalid validator address")
+        if (
+            vote.height != self.height
+            or vote.round_ != self.round_
+            or vote.type_ != self.signed_msg_type
+        ):
+            raise ValueError(
+                f"expected {self.height}/{self.round_}/{self.signed_msg_type}, got "
+                f"{vote.height}/{vote.round_}/{vote.type_}: unexpected step"
+            )
+        lookup_addr, val = self.val_set.get_by_index(vote.validator_index)
+        if val is None:
+            raise ValueError(
+                f"cannot find validator {vote.validator_index} in valSet of size {self.size()}"
+            )
+        if vote.validator_address != lookup_addr:
+            raise ValueError(
+                f"vote.ValidatorAddress ({vote.validator_address.hex()}) does not match "
+                f"address ({lookup_addr.hex()}) for vote.ValidatorIndex ({vote.validator_index})"
+            )
+        return val, lookup_addr
+
+    def _get_vote(self, val_index: int, block_key: bytes) -> Vote | None:
+        existing = self.votes[val_index]
+        if existing is not None and existing.block_id.key() == block_key:
+            return existing
+        bv = self.votes_by_block.get(block_key)
+        if bv is not None:
+            return bv.get_by_index(val_index)
+        return None
+
+    def _add_verified_vote(self, vote: Vote, voting_power: int) -> bool:
+        """vote_set.go:257-330 addVerifiedVote."""
+        val_index = vote.validator_index
+        block_key = vote.block_id.key()
+        conflicting: Vote | None = None
+
+        existing = self.votes[val_index]
+        if existing is None:
+            self.votes[val_index] = vote
+            self.votes_bit_array.set_index(val_index, True)
+            self.sum += voting_power
+        else:
+            if existing.block_id == vote.block_id:
+                raise RuntimeError("_add_verified_vote does not expect duplicate votes")
+            conflicting = existing
+            # Replace vote if the maj23 block's vote (vote_set.go:284-291)
+            if self.maj23 is not None and self.maj23.key() == block_key:
+                self.votes[val_index] = vote
+                self.votes_bit_array.set_index(val_index, True)
+
+        votes_by_block = self.votes_by_block.get(block_key)
+        if votes_by_block is not None:
+            if conflicting is not None and not votes_by_block.peer_maj23:
+                # ignore conflicting vote without peer maj23 (vote_set.go:297-301)
+                raise ErrVoteConflictingVotes(conflicting, vote)
+        else:
+            if conflicting is not None:
+                # peer claimed no maj23 for this block: ignore (vote_set.go:305-312)
+                raise ErrVoteConflictingVotes(conflicting, vote)
+            votes_by_block = _BlockVotes.new(False, self.size())
+            self.votes_by_block[block_key] = votes_by_block
+
+        old_sum = votes_by_block.sum
+        quorum = self.val_set.total_voting_power() * 2 // 3 + 1
+        votes_by_block.add_verified_vote(vote, voting_power)
+        if old_sum < quorum <= votes_by_block.sum and self.maj23 is None:
+            self.maj23 = vote.block_id
+            # promote this block's votes to the main tracking (vote_set.go:326-330)
+            for i, v in enumerate(votes_by_block.votes):
+                if v is not None:
+                    self.votes[i] = v
+        if conflicting is not None:
+            raise ErrVoteConflictingVotes(conflicting, vote)
+        return True
+
+    # ---------------------------------------------------------- peer maj23
+
+    def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
+        """vote_set.go:339-368: peer claims a +2/3 majority for block_id."""
+        existing = self.peer_maj23s.get(peer_id)
+        if existing is not None:
+            if existing == block_id:
+                return
+            raise ValueError(
+                f"setPeerMaj23: Received conflicting blockID from peer {peer_id}: "
+                f"{existing} vs {block_id}"
+            )
+        self.peer_maj23s[peer_id] = block_id
+        block_key = block_id.key()
+        votes_by_block = self.votes_by_block.get(block_key)
+        if votes_by_block is not None:
+            votes_by_block.peer_maj23 = True
+        else:
+            self.votes_by_block[block_key] = _BlockVotes.new(True, self.size())
+
+    # ------------------------------------------------------------- queries
+
+    def bit_array(self) -> BitArray:
+        return self.votes_bit_array.copy()
+
+    def bit_array_by_block_id(self, block_id: BlockID) -> BitArray | None:
+        bv = self.votes_by_block.get(block_id.key())
+        return bv.bit_array.copy() if bv is not None else None
+
+    def get_by_index(self, idx: int) -> Vote | None:
+        return self.votes[idx]
+
+    def get_by_address(self, address: bytes) -> Vote | None:
+        idx, val = self.val_set.get_by_address(address)
+        return self.votes[idx] if val is not None else None
+
+    def has_two_thirds_majority(self) -> bool:
+        return self.maj23 is not None
+
+    def two_thirds_majority(self) -> tuple[BlockID | None, bool]:
+        if self.maj23 is not None:
+            return self.maj23, True
+        return None, False
+
+    def has_two_thirds_any(self) -> bool:
+        return self.sum > self.val_set.total_voting_power() * 2 // 3
+
+    def has_one_third_any(self) -> bool:
+        return self.sum > self.val_set.total_voting_power() // 3
+
+    def has_all(self) -> bool:
+        return self.sum == self.val_set.total_voting_power()
+
+    def is_commit(self) -> bool:
+        return self.signed_msg_type == SignedMsgType.PRECOMMIT and self.maj23 is not None
+
+    # -------------------------------------------------------------- commit
+
+    def make_commit(self) -> Commit:
+        """vote_set.go MakeCommit (plain, pre-extension)."""
+        if self.signed_msg_type != SignedMsgType.PRECOMMIT:
+            raise ValueError("cannot MakeCommit() unless VoteSet.Type is PRECOMMIT")
+        if self.maj23 is None:
+            raise ValueError("cannot MakeCommit() unless a blockhash has +2/3")
+        sigs = []
+        for i, v in enumerate(self.votes):
+            sigs.append(self._commit_sig_for(v, i))
+        return Commit(
+            height=self.height, round_=self.round_, block_id=self.maj23, signatures=sigs
+        )
+
+    def make_extended_commit(self) -> ExtendedCommit:
+        if self.signed_msg_type != SignedMsgType.PRECOMMIT:
+            raise ValueError("cannot MakeExtendedCommit() unless VoteSet.Type is PRECOMMIT")
+        if self.maj23 is None:
+            raise ValueError("cannot MakeExtendedCommit() unless a blockhash has +2/3")
+        esigs = []
+        for i, v in enumerate(self.votes):
+            cs = self._commit_sig_for(v, i)
+            esigs.append(
+                ExtendedCommitSig(
+                    commit_sig=cs,
+                    extension=v.extension if v is not None and cs.for_block() else b"",
+                    extension_signature=(
+                        v.extension_signature if v is not None and cs.for_block() else b""
+                    ),
+                )
+            )
+        return ExtendedCommit(
+            height=self.height,
+            round_=self.round_,
+            block_id=self.maj23,
+            extended_signatures=esigs,
+        )
+
+    def _commit_sig_for(self, v: Vote | None, idx: int) -> CommitSig:
+        if v is None:
+            return CommitSig.absent()
+        if v.block_id == self.maj23:
+            flag = BlockIDFlag.COMMIT
+        elif v.block_id.is_nil():
+            flag = BlockIDFlag.NIL
+        else:
+            # Vote for a different block: commit records it as nil-vote
+            flag = BlockIDFlag.NIL
+        return CommitSig(
+            block_id_flag=flag,
+            validator_address=v.validator_address,
+            timestamp=v.timestamp,
+            signature=v.signature,
+        )

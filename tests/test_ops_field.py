@@ -1,0 +1,140 @@
+"""Field/curve limb arithmetic vs the Python bignum oracle."""
+
+import secrets
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import ed25519_math as oracle
+from cometbft_tpu.ops import limbs as L
+
+
+def _rand_elems(n, bits=255):
+    return [secrets.randbits(bits) % oracle.P for _ in range(n)]
+
+
+def _to_batch(vals):
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.stack([L.int_to_limbs(v) for v in vals]))
+
+
+def _from_batch(arr):
+    from cometbft_tpu.ops import field as F
+
+    canon = np.asarray(F.canonicalize(arr))
+    return [L.limbs_to_int(canon[i]) for i in range(canon.shape[0])]
+
+
+def test_limb_roundtrip():
+    for v in _rand_elems(8) + [0, 1, oracle.P - 1, 2**255 - 1]:
+        assert L.limbs_to_int(L.int_to_limbs(v)) == v
+
+
+@pytest.mark.parametrize("op", ["add", "sub", "mul", "sq"])
+def test_field_ops_match_oracle(op):
+    from cometbft_tpu.ops import field as F
+
+    n = 16
+    a_vals = _rand_elems(n)
+    b_vals = _rand_elems(n)
+    a, b = _to_batch(a_vals), _to_batch(b_vals)
+    if op == "add":
+        got = _from_batch(F.add(a, b))
+        want = [(x + y) % oracle.P for x, y in zip(a_vals, b_vals)]
+    elif op == "sub":
+        got = _from_batch(F.sub(a, b))
+        want = [(x - y) % oracle.P for x, y in zip(a_vals, b_vals)]
+    elif op == "mul":
+        got = _from_batch(F.mul(a, b))
+        want = [(x * y) % oracle.P for x, y in zip(a_vals, b_vals)]
+    else:
+        got = _from_batch(F.sq(a))
+        want = [(x * x) % oracle.P for x in a_vals]
+    assert got == want
+
+
+def test_repeated_ops_keep_invariant():
+    """Chain many ops without blowup: the carried-limb invariant must hold
+    through arbitrarily long op sequences (a 253-iteration ladder)."""
+    from cometbft_tpu.ops import field as F
+
+    a_vals = _rand_elems(4)
+    b_vals = _rand_elems(4)
+    a, b = _to_batch(a_vals), _to_batch(b_vals)
+    xa, xb = list(a_vals), list(b_vals)
+    for _ in range(30):
+        a, b = F.mul(a, b), F.sub(F.sq(a), F.add(a, b))
+        xa, xb = (
+            [(x * y) % oracle.P for x, y in zip(xa, xb)],
+            [(x * x - x - y) % oracle.P for x, y in zip(xa, xb)],
+        )
+        assert int(np.abs(np.asarray(a)).max()) <= 2**13 + 16
+    assert _from_batch(a) == xa and _from_batch(b) == xb
+
+
+def test_pow22523():
+    from cometbft_tpu.ops import field as F
+
+    vals = _rand_elems(8)
+    got = _from_batch(F.pow22523(_to_batch(vals)))
+    want = [pow(v, (oracle.P - 5) // 8, oracle.P) for v in vals]
+    assert got == want
+
+
+def test_canonicalize_noncanonical_input():
+    from cometbft_tpu.ops import field as F
+
+    vals = [oracle.P, oracle.P + 1, 2**255 - 1, 2**255 + 5 * oracle.P // 7]
+    got = _from_batch(_to_batch(vals))
+    assert got == [v % oracle.P for v in vals]
+    assert all(v < oracle.P for v in got)
+    assert bool(np.asarray(F.is_zero(_to_batch([oracle.P, 0, 1, 2 * oracle.P]))).tolist() == [True, True, False, True])
+
+
+def test_point_add_double_match_oracle():
+    from cometbft_tpu.ops import curve
+
+    n = 8
+    ks = [secrets.randbits(252) for _ in range(n)]
+    pts = [oracle.scalar_mult(k, oracle.B_POINT) for k in ks]
+    qts = [oracle.scalar_mult(k + 7, oracle.B_POINT) for k in ks]
+
+    def pt_batch(points):
+        coords = [
+            _to_batch([p[i] % oracle.P for p in points]) for i in range(4)
+        ]
+        return curve.Point(*coords)
+
+    p_b, q_b = pt_batch(pts), pt_batch(qts)
+    got_add = curve.add(p_b, q_b)
+    got_dbl = curve.double(p_b)
+    for i in range(n):
+        want_a = oracle.point_add(pts[i], qts[i])
+        want_d = oracle.point_double(pts[i])
+        ga = tuple(_from_batch(c)[i] for c in got_add)
+        gd = tuple(_from_batch(c)[i] for c in got_dbl)
+        assert oracle.point_equal(ga, want_a)
+        assert oracle.point_equal(gd, want_d)
+
+
+def test_decompress_matches_oracle():
+    from cometbft_tpu.ops import ed25519_kernel as K
+
+    encs = []
+    # valid points
+    for _ in range(6):
+        encs.append(oracle.point_compress(oracle.scalar_mult(secrets.randbits(252), oracle.B_POINT)))
+    # identity, non-canonical y (= p + 1 -> y=1 identity under ZIP-215), garbage
+    encs.append((1).to_bytes(32, "little"))
+    encs.append((oracle.P + 1).to_bytes(32, "little"))
+    encs.append(bytes(31) + b"\x12")
+    enc_arr = np.frombuffer(b"".join(encs), dtype=np.uint8).reshape(-1, 32)
+    ok, coords = K.decompress_points(enc_arr)
+    for i, e in enumerate(encs):
+        want = oracle.point_decompress_zip215(e)
+        assert bool(ok[i]) == (want is not None), f"enc {i}"
+        if want is not None:
+            # carried limbs may be non-canonical ints; point_equal is mod-p
+            got = tuple(L.limbs_to_int(coords[i, j]) for j in range(4))
+            assert oracle.point_equal(got, want)
