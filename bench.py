@@ -1,0 +1,94 @@
+"""Headline benchmark: Ed25519 signatures verified per second per chip.
+
+Reproduces BASELINE.json config 1/5 shape: a mega-batch of random signatures
+(default 10240 ~ the 10k-validator commit cap, types/vote_set.go:17) pushed
+through the TPU batch-verification pipeline end-to-end — host staging
+(SHA-512 challenges, limb packing), device kernel, mask readback — with the
+decompressed-pubkey cache warm (a validator set re-verifies every height;
+the reference's expanded-key LRU plays the same role,
+crypto/ed25519/ed25519.go:44).
+
+Baseline: the CPU serial path (OpenSSL, same machine) — the stand-in for the
+reference's Go batch verifier; vs_baseline is the throughput ratio.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "")
+
+BATCH = int(os.environ.get("BENCH_BATCH", "10240"))
+CPU_SAMPLE = int(os.environ.get("BENCH_CPU_SAMPLE", "2048"))
+ITERS = int(os.environ.get("BENCH_ITERS", "5"))
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+
+    from cometbft_tpu.crypto import ed25519
+    from cometbft_tpu.ops import ed25519_kernel as K
+
+    # -- build the batch: one "validator set" signing distinct messages
+    n_vals = min(BATCH, 10240)
+    privs = [ed25519.gen_priv_key() for _ in range(n_vals)]
+    pubs, msgs, sigs = [], [], []
+    for i in range(BATCH):
+        p = privs[i % n_vals]
+        msg = b"bench-vote-" + i.to_bytes(4, "big") + secrets.token_bytes(8)
+        pubs.append(p.pub_key().bytes_())
+        msgs.append(msg)
+        sigs.append(p.sign(msg))
+
+    cache = K.PubKeyCache()
+    # warm-up: compiles the kernel and fills the pubkey cache
+    ok, _ = K.verify_batch(pubs, msgs, sigs, cache=cache)
+    assert ok, "warm-up batch failed verification"
+
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        ok, mask = K.verify_batch(pubs, msgs, sigs, cache=cache)
+        times.append(time.perf_counter() - t0)
+        assert ok
+    t_device = min(times)
+    tpu_sigs_per_s = BATCH / t_device
+
+    # -- CPU baseline: serial OpenSSL loop on a sample, extrapolated
+    sample = CPU_SAMPLE
+    pk_objs = [ed25519.PubKey(pubs[i]) for i in range(sample)]
+    t0 = time.perf_counter()
+    for i in range(sample):
+        assert pk_objs[i].verify_signature(msgs[i], sigs[i])
+    t_cpu = time.perf_counter() - t0
+    cpu_sigs_per_s = sample / t_cpu
+
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_verify_throughput",
+                "value": round(tpu_sigs_per_s, 1),
+                "unit": "sigs/sec/chip",
+                "vs_baseline": round(tpu_sigs_per_s / cpu_sigs_per_s, 2),
+                "detail": {
+                    "batch": BATCH,
+                    "p50_batch_latency_ms": round(sorted(times)[len(times) // 2] * 1e3, 2),
+                    "cpu_baseline_sigs_per_s": round(cpu_sigs_per_s, 1),
+                    "backend": jax.devices()[0].platform,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""ABCI 2.0 — the application boundary (reference: abci/).
+
+The replicated state machine is EXTERNAL to the consensus engine; everything
+the framework knows of app state is the AppHash and the responses to these
+17 methods (abci/types/application.go:9-35). Subpackages:
+
+  types.py    request/response dataclasses + Application ABC + BaseApplication
+  client.py   client abstraction: local (in-proc) and socket transports
+  server.py   socket server hosting an Application out-of-process
+  kvstore.py  the example app (abci/example/kvstore) used by tests/harness
+"""
+
+from cometbft_tpu.abci.types import (  # noqa: F401
+    Application,
+    BaseApplication,
+    CODE_TYPE_OK,
+)

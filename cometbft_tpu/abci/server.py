@@ -1,0 +1,83 @@
+"""Socket ABCI server: host an Application out-of-process (asyncio).
+
+Reference: abci/server/socket_server.go. Each connection is served by its
+own task; app calls are executed on worker threads under one app-wide lock
+(the app is a single non-reentrant state machine).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+
+from cometbft_tpu.abci import codec
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.libs.service import BaseService, TaskRunner
+
+
+class ABCIServer(BaseService):
+    def __init__(self, app: abci.Application, addr: str):
+        super().__init__("ABCIServer")
+        self.app = app
+        self.addr = addr
+        self.app_lock = threading.Lock()
+        self._server: asyncio.AbstractServer | None = None
+        self._tasks = TaskRunner("abci-server")
+
+    async def on_start(self) -> None:
+        if self.addr.startswith("unix://"):
+            path = self.addr[len("unix://"):]
+            if os.path.exists(path):
+                os.unlink(path)
+            self._server = await asyncio.start_unix_server(self._serve, path)
+        else:
+            host, _, port = self.addr.removeprefix("tcp://").rpartition(":")
+            self._server = await asyncio.start_server(
+                self._serve, host or "127.0.0.1", int(port)
+            )
+
+    def bound_addr(self) -> str:
+        """Actual address after bind (useful with tcp port 0)."""
+        import socket as socketlib
+
+        assert self._server is not None
+        sock = self._server.sockets[0]
+        if sock.family == getattr(socketlib, "AF_UNIX", None):
+            return self.addr
+        host, port = sock.getsockname()[:2]
+        return f"tcp://{host}:{port}"
+
+    async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            while self.is_running:
+                try:
+                    method, req = await codec.decode_request_async(reader)
+                except (EOFError, asyncio.IncompleteReadError, ConnectionError):
+                    return
+                if method == "echo":
+                    writer.write(codec.encode_response("echo", abci.ResponseEcho(message=req.message)))
+                elif method == "flush":
+                    writer.write(codec.encode_response("flush", abci.ResponseFlush()))
+                else:
+                    try:
+                        resp = await self._dispatch(method, req)
+                        writer.write(codec.encode_response(method, resp))
+                    except Exception as e:  # noqa: BLE001 - report to client
+                        writer.write(codec.encode_exception(f"{type(e).__name__}: {e}"))
+                await writer.drain()
+        finally:
+            writer.close()
+
+    async def _dispatch(self, method: str, req):
+        def run():
+            with self.app_lock:
+                return getattr(self.app, method)(req)
+
+        return await asyncio.to_thread(run)
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        await self._tasks.cancel_all()

@@ -1,0 +1,9 @@
+"""Mempool (reference: mempool/).
+
+CheckTx-gated concurrent tx pool with LRU dedup cache, reap for proposals,
+post-commit update + recheck (SURVEY.md §2.1 row Mempool). The gossip
+reactor lives in p2p-land (mempool/reactor.py) and consumes the pool's
+async iteration (the clist analog).
+"""
+
+from cometbft_tpu.mempool.mempool import CListMempool, TxCache  # noqa: F401

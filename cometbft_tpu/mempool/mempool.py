@@ -1,0 +1,235 @@
+"""CListMempool — the validated tx pool (reference: mempool/clist_mempool.go).
+
+Semantics preserved: txs enter only after an app CheckTx OK
+(clist_mempool.go:251,376); an LRU cache short-circuits duplicates (the
+cache also remembers invalid txs, config keep-invalid-txs-in-cache aside);
+reap returns txs under byte/gas budgets in FIFO order
+(clist_mempool.go:527); update removes committed txs and re-checks the
+remainder against the post-block app state (clist_mempool.go:586).
+
+Async design: one asyncio.Lock serializes structural mutation; a Condition
+wakes gossip/proposal waiters when txs arrive — the clist
+"wait-for-next" blocking iteration, minus the hand-rolled linked list.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.client import Client
+from cometbft_tpu.types.block import tx_hash
+
+
+class ErrTxInCache(Exception):
+    pass
+
+
+class ErrMempoolIsFull(Exception):
+    pass
+
+
+class ErrTxTooLarge(Exception):
+    pass
+
+
+class TxCache:
+    """LRU of tx hashes (reference: mempool/cache.go LRUTxCache)."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._map: OrderedDict[bytes, None] = OrderedDict()
+
+    def push(self, tx: bytes) -> bool:
+        """False if already present (moves to front either way)."""
+        h = tx_hash(tx)
+        if h in self._map:
+            self._map.move_to_end(h)
+            return False
+        self._map[h] = None
+        if len(self._map) > self.size:
+            self._map.popitem(last=False)
+        return True
+
+    def remove(self, tx: bytes) -> None:
+        self._map.pop(tx_hash(tx), None)
+
+    def has(self, tx: bytes) -> bool:
+        return tx_hash(tx) in self._map
+
+    def reset(self) -> None:
+        self._map.clear()
+
+
+@dataclass
+class MempoolTx:
+    tx: bytes
+    height: int  # height at which the tx entered the pool
+    gas_wanted: int
+    sender: str = ""  # peer that first sent it (gossip loop suppression)
+    seq: int = 0
+
+
+@dataclass
+class MempoolConfig:
+    size: int = 5000  # max txs (config/config.go:838)
+    max_txs_bytes: int = 1 << 30  # 1 GB
+    cache_size: int = 10000
+    max_tx_bytes: int = 1048576
+    recheck: bool = True
+    keep_invalid_txs_in_cache: bool = False
+
+
+class CListMempool:
+    def __init__(
+        self,
+        config: MempoolConfig,
+        app_conn: Client,
+        height: int = 0,
+    ):
+        self.config = config
+        self.app_conn = app_conn
+        self.height = height
+        self.cache = TxCache(config.cache_size)
+        self._txs: OrderedDict[bytes, MempoolTx] = OrderedDict()  # hash -> tx
+        self._txs_bytes = 0
+        self._seq = 0
+        self._lock = asyncio.Lock()
+        self._tx_available = asyncio.Event()
+        self.notify_available = True
+
+    # ------------------------------------------------------------- sizes
+
+    def size(self) -> int:
+        return len(self._txs)
+
+    def size_bytes(self) -> int:
+        return self._txs_bytes
+
+    def is_full(self, tx_len: int) -> bool:
+        return (
+            len(self._txs) >= self.config.size
+            or self._txs_bytes + tx_len > self.config.max_txs_bytes
+        )
+
+    # ------------------------------------------------------------ checktx
+
+    async def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
+        """Gate a tx into the pool (clist_mempool.go:251-300 CheckTx +
+        resCbFirstTime). Raises for structural rejects; returns the app
+        response (which may be a rejection) otherwise."""
+        if len(tx) > self.config.max_tx_bytes:
+            raise ErrTxTooLarge(f"tx size {len(tx)} > max {self.config.max_tx_bytes}")
+        if self.is_full(len(tx)):
+            raise ErrMempoolIsFull(
+                f"{len(self._txs)} txs, {self._txs_bytes} bytes"
+            )
+        if not self.cache.push(tx):
+            # Record the extra sender, as the reference does, then reject.
+            h = tx_hash(tx)
+            async with self._lock:
+                if h in self._txs and sender and not self._txs[h].sender:
+                    self._txs[h].sender = sender
+            raise ErrTxInCache()
+
+        res = await self.app_conn.check_tx(abci.RequestCheckTx(tx=tx, type_=abci.CheckTxType.NEW))
+        if res.is_ok():
+            async with self._lock:
+                if self.is_full(len(tx)):
+                    self.cache.remove(tx)
+                    raise ErrMempoolIsFull()
+                self._seq += 1
+                self._txs[tx_hash(tx)] = MempoolTx(
+                    tx=tx, height=self.height, gas_wanted=res.gas_wanted, sender=sender,
+                    seq=self._seq,
+                )
+                self._txs_bytes += len(tx)
+                if self.notify_available:
+                    self._tx_available.set()
+        else:
+            if not self.config.keep_invalid_txs_in_cache:
+                self.cache.remove(tx)
+        return res
+
+    async def wait_for_txs(self) -> None:
+        """Block until the pool is non-empty (consensus txNotifier +
+        gossip wakeup; clist WaitChan analog)."""
+        await self._tx_available.wait()
+
+    def has_txs(self) -> bool:
+        return bool(self._txs)
+
+    # -------------------------------------------------------------- reap
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
+        """FIFO reap under budgets (clist_mempool.go:527-560). Byte budget
+        counts raw tx bytes; -1 = unlimited."""
+        out: list[bytes] = []
+        total_bytes = total_gas = 0
+        for mtx in self._txs.values():
+            if max_bytes >= 0 and total_bytes + len(mtx.tx) > max_bytes:
+                break
+            if max_gas >= 0 and total_gas + mtx.gas_wanted > max_gas:
+                break
+            total_bytes += len(mtx.tx)
+            total_gas += mtx.gas_wanted
+            out.append(mtx.tx)
+        return out
+
+    def reap_max_txs(self, n: int) -> list[bytes]:
+        if n < 0:
+            return [m.tx for m in self._txs.values()]
+        return [m.tx for m in list(self._txs.values())[:n]]
+
+    def iter_txs(self) -> list[MempoolTx]:
+        """Snapshot for the gossip routine."""
+        return list(self._txs.values())
+
+    # ------------------------------------------------------------- update
+
+    async def update(
+        self,
+        height: int,
+        txs: list[bytes],
+        tx_results: list[abci.ExecTxResult],
+    ) -> None:
+        """Post-commit maintenance (clist_mempool.go:586-650): drop
+        committed txs (valid ones stay cached for dedup; invalid ones leave
+        the cache so they can be resubmitted), then re-check survivors.
+        Caller must hold the commit lock (consensus does, via lock())."""
+        self.height = height
+        for tx, res in zip(txs, tx_results):
+            if res.is_ok():
+                self.cache.push(tx)
+            elif not self.config.keep_invalid_txs_in_cache:
+                self.cache.remove(tx)
+            mtx = self._txs.pop(tx_hash(tx), None)
+            if mtx is not None:
+                self._txs_bytes -= len(mtx.tx)
+        if self.config.recheck and self._txs:
+            await self._recheck_txs()
+        if not self._txs:
+            self._tx_available.clear()
+
+    async def _recheck_txs(self) -> None:
+        """Re-validate remaining txs against post-block state
+        (clist_mempool.go recheckTxs)."""
+        for h, mtx in list(self._txs.items()):
+            res = await self.app_conn.check_tx(
+                abci.RequestCheckTx(tx=mtx.tx, type_=abci.CheckTxType.RECHECK)
+            )
+            if not res.is_ok():
+                self._txs.pop(h, None)
+                self._txs_bytes -= len(mtx.tx)
+                if not self.config.keep_invalid_txs_in_cache:
+                    self.cache.remove(mtx.tx)
+
+    async def flush(self) -> None:
+        """Drop everything (RPC unsafe_flush_mempool)."""
+        async with self._lock:
+            self._txs.clear()
+            self._txs_bytes = 0
+            self.cache.reset()
+            self._tx_available.clear()

@@ -1,7 +1,8 @@
 """edwards25519 point operations on TPU vector lanes.
 
 A point is a tuple (X, Y, Z, T) of extended homogeneous coordinates, each a
-(..., 20) carried limb array (field.py); one lane = one point. All formulas
+(20, B) carried limb array (field.py, limb-axis first); one lane = one
+point. All formulas
 are complete/unified (add-2008-hwcd-3 for a=-1, dbl-2008-hwcd) — branch-free
 by construction, exactly what lockstep SIMD lanes need: no special-casing of
 identity or equal points, so adversarial inputs (small-order points,
@@ -31,24 +32,24 @@ class Point(NamedTuple):
     t: jnp.ndarray
 
 
-# Base point as limb constants, shape (20,), broadcastable over batches.
+# Base point as limb constants, shape (20, 1), broadcastable over batches.
 B_X = F._const(oracle.B_POINT[0])
 B_Y = F._const(oracle.B_POINT[1])
 B_T = F._const(oracle.B_POINT[3])
 
 
 def identity(shape: tuple[int, ...]) -> Point:
-    """(0 : 1 : 1 : 0) broadcast to batch shape + (20,)."""
-    zero = jnp.zeros(shape + (F.NLIMBS,), dtype=jnp.int32)
-    one = jnp.broadcast_to(F.ONE, shape + (F.NLIMBS,)).astype(jnp.int32)
+    """(0 : 1 : 1 : 0) broadcast to (20,) + batch shape."""
+    zero = jnp.zeros((F.NLIMBS,) + shape, dtype=jnp.int32)
+    one = jnp.broadcast_to(F.ONE, (F.NLIMBS,) + shape).astype(jnp.int32)
     return Point(zero, one, one, zero)
 
 
 def base_point(shape: tuple[int, ...]) -> Point:
-    bx = jnp.broadcast_to(B_X, shape + (F.NLIMBS,)).astype(jnp.int32)
-    by = jnp.broadcast_to(B_Y, shape + (F.NLIMBS,)).astype(jnp.int32)
-    bt = jnp.broadcast_to(B_T, shape + (F.NLIMBS,)).astype(jnp.int32)
-    one = jnp.broadcast_to(F.ONE, shape + (F.NLIMBS,)).astype(jnp.int32)
+    bx = jnp.broadcast_to(B_X, (F.NLIMBS,) + shape).astype(jnp.int32)
+    by = jnp.broadcast_to(B_Y, (F.NLIMBS,) + shape).astype(jnp.int32)
+    bt = jnp.broadcast_to(B_T, (F.NLIMBS,) + shape).astype(jnp.int32)
+    one = jnp.broadcast_to(F.ONE, (F.NLIMBS,) + shape).astype(jnp.int32)
     return Point(bx, by, one, bt)
 
 
@@ -100,23 +101,23 @@ def decompress_zip215(y_limbs: jnp.ndarray, sign: jnp.ndarray) -> tuple[jnp.ndar
     Oracle: ed25519_math.point_decompress_zip215."""
     y = y_limbs
     yy = F.sq(y)
-    u = F.sub(yy, jnp.broadcast_to(F.ONE, yy.shape).astype(jnp.int32))
-    v = F.add(F.mul(F.D, yy), jnp.broadcast_to(F.ONE, yy.shape).astype(jnp.int32))
+    one = jnp.broadcast_to(F.ONE, yy.shape).astype(jnp.int32)
+    u = F.sub(yy, one)
+    v = F.add(F.mul(F.D, yy), one)
     v3 = F.mul(F.sq(v), v)
     v7 = F.mul(F.sq(v3), v)
     x = F.mul(F.mul(u, v3), F.pow22523(F.mul(u, v7)))
     vxx = F.mul(v, F.sq(x))
     root1 = F.is_zero(F.sub(vxx, u))       # v*x^2 == u
     root2 = F.is_zero(F.add(vxx, u))       # v*x^2 == -u -> x *= sqrt(-1)
-    x = jnp.where(root1[..., None], x, F.mul(x, F.SQRT_M1))
+    x = jnp.where(root1[None], x, F.mul(x, F.SQRT_M1))
     ok = root1 | root2
     xc = F.canonicalize(x)
-    x_zero = jnp.all(xc == 0, axis=-1)
+    x_zero = jnp.all(xc == 0, axis=0)
     ok = ok & ~(x_zero & (sign == 1))      # x=0 with sign bit set: reject
-    flip = (xc[..., 0] & 1) != sign
-    x = jnp.where(flip[..., None], F.neg(x), x)
-    one = jnp.broadcast_to(F.ONE, y.shape).astype(jnp.int32)
-    return ok, Point(x, y, one, F.mul(x, y))
+    flip = (xc[0] & 1) != sign
+    x = jnp.where(flip[None], F.neg(x), x)
+    return ok, Point(x, y, jnp.broadcast_to(F.ONE, y.shape).astype(jnp.int32), F.mul(x, y))
 
 
 def straus_base_and_point(
@@ -128,18 +129,19 @@ def straus_base_and_point(
     (scalars < 2^253: s < L enforced host-side, k = H mod L), selecting its
     table entry branch-free per bit pair.
 
-    s_bits/k_bits: (..., 253) int32 in {0,1}, little-endian bit order.
+    s_bits/k_bits: (253, B) int32 in {0,1}, little-endian bit order along
+    axis 0 (bit axis leading, batch on lanes like everything else).
     """
-    batch_shape = s_bits.shape[:-1]
-    nbits = s_bits.shape[-1]
+    batch_shape = s_bits.shape[1:]
+    nbits = s_bits.shape[0]
     t0 = identity(batch_shape)
     t1 = base_point(batch_shape)
     t2 = a
     t3 = add(t1, a)
 
     def select(b_s: jnp.ndarray, b_k: jnp.ndarray) -> Point:
-        bs = b_s[..., None]
-        bk = b_k[..., None]
+        bs = b_s[None]
+        bk = b_k[None]
         coords = []
         for c0, c1, c2, c3 in zip(t0, t1, t2, t3):
             lo = jnp.where(bs == 1, c1, c0)
@@ -150,8 +152,14 @@ def straus_base_and_point(
     def body(it: jnp.ndarray, acc: Point) -> Point:
         i = nbits - 1 - it
         acc = double(acc)
-        b_s = jax.lax.dynamic_index_in_dim(s_bits, i, axis=-1, keepdims=False)
-        b_k = jax.lax.dynamic_index_in_dim(k_bits, i, axis=-1, keepdims=False)
+        b_s = jax.lax.dynamic_index_in_dim(s_bits, i, axis=0, keepdims=False)
+        b_k = jax.lax.dynamic_index_in_dim(k_bits, i, axis=0, keepdims=False)
         return add(acc, select(b_s, b_k))
 
-    return jax.lax.fori_loop(0, nbits, body, identity(batch_shape))
+    # Derive the identity init from an input so its sharding "varying-ness"
+    # matches the loop body under shard_map (a replicated-constant carry
+    # would trip the manual-axes vma check).
+    zero = jnp.zeros_like(a.x)
+    one = zero + F.ONE
+    init = Point(zero, one, one, zero)
+    return jax.lax.fori_loop(0, nbits, body, init)

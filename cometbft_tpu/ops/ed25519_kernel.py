@@ -74,7 +74,8 @@ def _verify_kernel(
 def decompress_points(enc: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """(N, 32) uint8 encodings -> (ok (N,) bool, coords (N, 4, 20) int32),
     padding internally to a bucket. Host-facing; used to fill the pubkey
-    cache and by tests."""
+    cache and by tests. Device arrays are limb-axis-first (20, B); the host
+    cache keeps batch-major (N, 4, 20) for cheap per-key gathers."""
     n = enc.shape[0]
     b = bucket_size(n)
     y, sign = L.encodings_to_point_inputs(enc)
@@ -83,8 +84,10 @@ def decompress_points(enc: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         pad_y[:, 0] = 1  # y = 1: the identity point, always decompressible
         y = np.concatenate([y, pad_y])
         sign = np.concatenate([sign, np.zeros(b - n, dtype=np.int32)])
-    ok, x, yy, z, t = _decompress_kernel(jnp.asarray(y), jnp.asarray(sign))
-    coords = np.stack([np.asarray(x), np.asarray(yy), np.asarray(z), np.asarray(t)], axis=1)
+    ok, x, yy, z, t = _decompress_kernel(jnp.asarray(y.T), jnp.asarray(sign))
+    coords = np.stack(
+        [np.asarray(x).T, np.asarray(yy).T, np.asarray(z).T, np.asarray(t).T], axis=1
+    )
     return np.asarray(ok)[:n], coords[:n]
 
 
@@ -188,15 +191,15 @@ def verify_batch(
         k_bits = np.concatenate([k_bits, zbits])
 
     mask_dev = _verify_kernel(
-        jnp.asarray(a_coords[:, 0]),
-        jnp.asarray(a_coords[:, 1]),
-        jnp.asarray(a_coords[:, 2]),
-        jnp.asarray(a_coords[:, 3]),
+        jnp.asarray(np.ascontiguousarray(a_coords[:, 0].T)),
+        jnp.asarray(np.ascontiguousarray(a_coords[:, 1].T)),
+        jnp.asarray(np.ascontiguousarray(a_coords[:, 2].T)),
+        jnp.asarray(np.ascontiguousarray(a_coords[:, 3].T)),
         jnp.asarray(ok_a),
-        jnp.asarray(y_r),
+        jnp.asarray(np.ascontiguousarray(y_r.T)),
         jnp.asarray(sign_r),
-        jnp.asarray(s_bits),
-        jnp.asarray(k_bits),
+        jnp.asarray(np.ascontiguousarray(s_bits.T)),
+        jnp.asarray(np.ascontiguousarray(k_bits.T)),
     )
     mask = np.asarray(mask_dev)[:n] & pre_ok
     return bool(mask.all()), mask.tolist()

@@ -1,0 +1,16 @@
+"""Multi-chip scaling of signature mega-batches.
+
+The reference's only scaling dimension is signatures-per-verification-call
+(SURVEY.md §5.7): validator-set size (cap 10k) x commits in flight
+(blocksync pipelines up to 600 heights). Here a mega-batch is sharded over a
+1-D `jax.sharding.Mesh` along the batch ("sig") axis with shard_map — each
+chip verifies its slice of lanes independently (verification is
+embarrassingly parallel; the only collective is the implicit result
+gather). ICI carries the shards; DCN is irrelevant at <=10k-sig batches.
+"""
+
+from cometbft_tpu.parallel.mesh import (  # noqa: F401
+    batch_mesh,
+    shard_verify_kernel,
+    sharded_verify_batch,
+)

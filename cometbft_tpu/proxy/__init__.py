@@ -1,0 +1,14 @@
+"""Proxy: 4 logical ABCI connections over one client creator.
+
+Reference: proxy/app_conn.go:18-56, proxy/multi_app_conn.go. Consensus,
+mempool, query, and snapshot traffic each get a connection facade; local
+creators share one lock (the app is one non-reentrant state machine),
+socket creators open 4 sockets.
+"""
+
+from cometbft_tpu.proxy.app_conns import (  # noqa: F401
+    AppConns,
+    ClientCreator,
+    local_client_creator,
+    socket_client_creator,
+)

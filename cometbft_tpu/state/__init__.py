@@ -1,0 +1,10 @@
+"""State & execution (reference: state/).
+
+State is the engine's snapshot of the replicated app at the latest committed
+height (valsets for H/H+1/H-1, consensus params, app hash, last results);
+BlockExecutor drives ABCI to produce/validate/apply blocks.
+"""
+
+from cometbft_tpu.state.state import State  # noqa: F401
+from cometbft_tpu.state.store import StateStore  # noqa: F401
+from cometbft_tpu.state.execution import BlockExecutor  # noqa: F401

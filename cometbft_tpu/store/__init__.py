@@ -1,0 +1,9 @@
+"""Persistence: KV backends + block storage.
+
+Reference: store/store.go over cometbft-db. db.py defines the backend
+interface with in-memory and SQLite implementations; blockstore.py persists
+block meta/parts/commits keyed by height (SURVEY.md §2.1 row Store).
+"""
+
+from cometbft_tpu.store.db import KVStore, MemDB, SQLiteDB, open_db  # noqa: F401
+from cometbft_tpu.store.blockstore import BlockStore  # noqa: F401

@@ -1,0 +1,138 @@
+"""Key-value backend interface (reference: cometbft-db's DB interface, used
+by store/store.go, state/store.go, indexers, evidence pool, light store).
+
+Two backends: MemDB (tests, light stores) and SQLiteDB (durable, the
+default node backend — sqlite is this stack's goleveldb: embedded,
+crash-safe, zero-install). Iteration is ordered by raw key bytes, matching
+the reference's iterator contract.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Iterator
+
+
+class KVStore:
+    def get(self, key: bytes) -> bytes | None: ...
+
+    def set(self, key: bytes, value: bytes) -> None: ...
+
+    def delete(self, key: bytes) -> None: ...
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None) -> Iterator[tuple[bytes, bytes]]:
+        """Ordered iteration over [start, end)."""
+        ...
+
+    def batch_set(self, pairs: list[tuple[bytes, bytes | None]]) -> None:
+        """Atomic write batch; value None = delete."""
+        for k, v in pairs:
+            if v is None:
+                self.delete(k)
+            else:
+                self.set(k, v)
+
+    def close(self) -> None: ...
+
+
+class MemDB(KVStore):
+    def __init__(self):
+        self._data: dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: bytes) -> bytes | None:
+        with self._lock:
+            return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._data[bytes(key)] = bytes(value)
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None):
+        with self._lock:
+            keys = sorted(k for k in self._data if k >= start and (end is None or k < end))
+        for k in keys:
+            v = self.get(k)
+            if v is not None:
+                yield k, v
+
+    def close(self) -> None:
+        pass
+
+
+class SQLiteDB(KVStore):
+    """One table of (key BLOB PRIMARY KEY, value BLOB); WAL mode for
+    concurrent readers + crash safety."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._local = threading.local()
+        conn = self._conn()
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA synchronous=NORMAL")
+        conn.execute("CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL)")
+        conn.commit()
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.path, timeout=30)
+            self._local.conn = conn
+        return conn
+
+    def get(self, key: bytes) -> bytes | None:
+        row = self._conn().execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        return row[0] if row else None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        c = self._conn()
+        c.execute("INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)", (key, value))
+        c.commit()
+
+    def delete(self, key: bytes) -> None:
+        c = self._conn()
+        c.execute("DELETE FROM kv WHERE k = ?", (key,))
+        c.commit()
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None):
+        c = self._conn()
+        if end is None:
+            cur = c.execute("SELECT k, v FROM kv WHERE k >= ? ORDER BY k", (start,))
+        else:
+            cur = c.execute(
+                "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k", (start, end)
+            )
+        yield from cur
+
+    def batch_set(self, pairs: list[tuple[bytes, bytes | None]]) -> None:
+        c = self._conn()
+        with c:  # transaction
+            for k, v in pairs:
+                if v is None:
+                    c.execute("DELETE FROM kv WHERE k = ?", (k,))
+                else:
+                    c.execute("INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)", (k, v))
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+def open_db(backend: str, path: str | None = None) -> KVStore:
+    if backend == "memdb":
+        return MemDB()
+    if backend == "sqlite":
+        if not path:
+            raise ValueError("sqlite backend requires a path")
+        return SQLiteDB(path)
+    raise ValueError(f"unknown db backend {backend!r}")

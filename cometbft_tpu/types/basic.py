@@ -16,8 +16,6 @@ from cometbft_tpu.utils import protobuf as pb
 
 # reference: types/vote_set.go:17 — hard cap on votes per set.
 MAX_VOTES_COUNT = 10000
-# reference: types/tx.go — max int64
-MAX_BLOCK_SIZE_BYTES = 104857600  # 100 MiB, config cap
 
 
 class SignedMsgType(enum.IntEnum):

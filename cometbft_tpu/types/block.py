@@ -163,16 +163,7 @@ class Header:
             elif f == 3:
                 h.height = r.read_varint_i64()
             elif f == 4:
-                tr = r.read_message()
-                secs = nanos = 0
-                while not tr.at_end():
-                    tf, tw = tr.read_tag()
-                    if tf == 1:
-                        secs = tr.read_varint_i64()
-                    elif tf == 2:
-                        nanos = tr.read_varint_i64()
-                    else:
-                        tr.skip(tw)
+                secs, nanos = r.read_timestamp()
                 h.time = cmttime.Timestamp(secs, nanos)
             elif f == 5:
                 h.last_block_id = BlockID.from_proto(r.read_bytes())

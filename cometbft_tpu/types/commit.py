@@ -80,16 +80,7 @@ class CommitSig:
             elif f == 2:
                 cs.validator_address = r.read_bytes()
             elif f == 3:
-                tr = r.read_message()
-                secs = nanos = 0
-                while not tr.at_end():
-                    tf, tw = tr.read_tag()
-                    if tf == 1:
-                        secs = tr.read_varint_i64()
-                    elif tf == 2:
-                        nanos = tr.read_varint_i64()
-                    else:
-                        tr.skip(tw)
+                secs, nanos = r.read_timestamp()
                 cs.timestamp = cmttime.Timestamp(secs, nanos)
             elif f == 4:
                 cs.signature = r.read_bytes()

@@ -138,16 +138,7 @@ class DuplicateVoteEvidence(Evidence):
             elif f == 4:
                 ev.validator_power = r.read_varint_i64()
             elif f == 5:
-                tr = r.read_message()
-                secs = nanos = 0
-                while not tr.at_end():
-                    tf, tw = tr.read_tag()
-                    if tf == 1:
-                        secs = tr.read_varint_i64()
-                    elif tf == 2:
-                        nanos = tr.read_varint_i64()
-                    else:
-                        tr.skip(tw)
+                secs, nanos = r.read_timestamp()
                 ev.timestamp = cmttime.Timestamp(secs, nanos)
             else:
                 r.skip(w)

@@ -125,16 +125,7 @@ class Vote:
             elif f == 4:
                 v.block_id = BlockID.from_proto(r.read_bytes())
             elif f == 5:
-                tr = r.read_message()
-                secs = nanos = 0
-                while not tr.at_end():
-                    tf, tw = tr.read_tag()
-                    if tf == 1:
-                        secs = tr.read_varint_i64()
-                    elif tf == 2:
-                        nanos = tr.read_varint_i64()
-                    else:
-                        tr.skip(tw)
+                secs, nanos = r.read_timestamp()
                 v.timestamp = cmttime.Timestamp(secs, nanos)
             elif f == 6:
                 v.validator_address = r.read_bytes()

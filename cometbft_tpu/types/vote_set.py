@@ -104,7 +104,7 @@ class VoteSet:
         # --- batch path state ---
         self.batch_flush_size = batch_flush_size
         self._pending: list[tuple[Vote, int]] = []  # (vote, voting_power)
-        self._pending_keys: set[tuple[int, bytes]] = set()
+        self._pending_by_key: dict[tuple[int, bytes], Vote] = {}
         self._speculative_sum = 0
 
     def size(self) -> int:
@@ -141,9 +141,16 @@ class VoteSet:
         only; consensus-visible state untouched. Returns True if staged
         (auto-flushes at quorum boundaries / batch size; see flush_pending)."""
         val, _ = self._check_structure(vote)
+        if len(vote.signature) != 64:
+            raise ErrVoteInvalidSignature(f"bad signature length {len(vote.signature)}")
         key = (vote.validator_index, vote.block_id.key())
-        if key in self._pending_keys:
-            return False
+        staged = self._pending_by_key.get(key)
+        if staged is not None:
+            if staged.signature == vote.signature:
+                return False
+            raise ValueError(
+                f"non-deterministic signature: staged {staged}; new {vote}"
+            )
         existing = self._get_vote(vote.validator_index, vote.block_id.key())
         if existing is not None:
             if existing.signature == vote.signature:
@@ -154,7 +161,7 @@ class VoteSet:
         if not self.extensions_enabled and (vote.extension or vote.extension_signature):
             raise ValueError("unexpected vote extension data present in vote")
         self._pending.append((vote, val.voting_power))
-        self._pending_keys.add(key)
+        self._pending_by_key[key] = vote
         if self.votes[vote.validator_index] is None:
             self._speculative_sum += val.voting_power
         if self._should_flush():
@@ -177,7 +184,7 @@ class VoteSet:
         if not self._pending:
             return []
         pending, self._pending = self._pending, []
-        self._pending_keys.clear()
+        self._pending_by_key.clear()
         self._speculative_sum = 0
 
         proposer = self.val_set.get_proposer()
@@ -200,10 +207,14 @@ class VoteSet:
         ext_bad: set[int] = set()
         if self.extensions_enabled:
             # Extension signatures ride a second batch over the same keys.
-            ext_rows = [
-                (i, vote) for i, (vote, _) in enumerate(pending)
-                if mask[i] and not vote.block_id.is_nil()
-            ]
+            ext_rows = []
+            for i, (vote, _) in enumerate(pending):
+                if not mask[i] or vote.block_id.is_nil():
+                    continue
+                if len(vote.extension_signature) != 64:
+                    ext_bad.add(i)  # structurally invalid: fails without device trip
+                    continue
+                ext_rows.append((i, vote))
             if ext_rows:
                 bv2 = crypto_batch.create_batch_verifier(proposer.pub_key)
                 for _, vote in ext_rows:
@@ -218,6 +229,11 @@ class VoteSet:
         for i, (vote, power) in enumerate(pending):
             ok = bool(mask[i]) and i not in ext_bad
             if ok:
+                existing = self._get_vote(vote.validator_index, vote.block_id.key())
+                if existing is not None and existing.signature == vote.signature:
+                    # landed via the serial path while staged: already tallied
+                    results.append((vote, True))
+                    continue
                 try:
                     self._add_verified_vote(vote, power)
                 except ErrVoteConflictingVotes as e:
@@ -414,8 +430,10 @@ class VoteSet:
         elif v.block_id.is_nil():
             flag = BlockIDFlag.NIL
         else:
-            # Vote for a different block: commit records it as nil-vote
-            flag = BlockIDFlag.NIL
+            # Vote for a different block: excluded as ABSENT — its signature
+            # is over that other BlockID and would fail reconstruction
+            # (reference: vote_set.go MakeExtendedCommit:652-655).
+            return CommitSig.absent()
         return CommitSig(
             block_id_flag=flag,
             validator_address=v.validator_address,

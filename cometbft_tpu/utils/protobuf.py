@@ -226,6 +226,21 @@ class Reader:
         body = self.read_bytes()
         return Reader(body)
 
+    def read_timestamp(self) -> tuple[int, int]:
+        """Parse an embedded google.protobuf.Timestamp field value that was
+        written by timestamp_bytes(): returns (seconds, nanos)."""
+        tr = self.read_message()
+        seconds = nanos = 0
+        while not tr.at_end():
+            f, w = tr.read_tag()
+            if f == 1:
+                seconds = tr.read_varint_i64()
+            elif f == 2:
+                nanos = tr.read_varint_i64()
+            else:
+                tr.skip(w)
+        return seconds, nanos
+
     def skip(self, wire: int) -> None:
         if wire == 0:
             self.read_uvarint()

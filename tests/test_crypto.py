@@ -191,7 +191,7 @@ class TestBatchDispatch:
             ok, mask = bv.verify()
             assert ok and mask == [True] * 4
         finally:
-            batch.set_backend("auto")
+            batch.set_backend("cpu")  # conftest policy: unit tests stay on CPU
 
     def test_bad_sig_mask(self):
         batch.set_backend("cpu")
@@ -203,7 +203,7 @@ class TestBatchDispatch:
             ok, mask = bv.verify()
             assert not ok and mask == [True, False]
         finally:
-            batch.set_backend("auto")
+            batch.set_backend("cpu")  # conftest policy: unit tests stay on CPU
 
     def test_add_rejects_malformed(self):
         batch.set_backend("cpu")
@@ -213,4 +213,4 @@ class TestBatchDispatch:
             with pytest.raises(crypto.ErrInvalidSignature):
                 bv.add(priv.pub_key(), b"m", b"short")
         finally:
-            batch.set_backend("auto")
+            batch.set_backend("cpu")  # conftest policy: unit tests stay on CPU

@@ -1,0 +1,210 @@
+"""State machine + stores + mempool + BlockExecutor: apply blocks end-to-end
+against the in-proc kvstore app (reference test model: state/execution_test.go,
+mempool/mempool_test.go, store/store_test.go)."""
+
+import asyncio
+import secrets
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.mempool.mempool import CListMempool, ErrTxInCache, MempoolConfig
+from cometbft_tpu.proxy import AppConns, local_client_creator
+from cometbft_tpu.state import BlockExecutor, State, StateStore
+from cometbft_tpu.store import BlockStore, MemDB
+from cometbft_tpu.types import SignedMsgType, Validator, ValidatorSet, Vote, VoteSet
+from cometbft_tpu.types.basic import BlockID, PartSetHeader
+from cometbft_tpu.types.commit import Commit, ExtendedCommit
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.part_set import PartSet
+from cometbft_tpu.utils import cmttime
+
+
+def make_genesis(n=4, power=10):
+    privs = [ed25519.gen_priv_key() for _ in range(n)]
+    gdoc = GenesisDoc(
+        genesis_time=cmttime.canonical_now_ms(),
+        chain_id="exec-test-chain",
+        validators=[
+            GenesisValidator(address=p.pub_key().address(), pub_key=p.pub_key(), power=power)
+            for p in privs
+        ],
+    )
+    gdoc.validate_and_complete()
+    state = State.from_genesis(gdoc)
+    by_addr = {p.pub_key().address(): p for p in privs}
+    privs_sorted = [by_addr[v.address] for v in state.validators.validators]
+    return gdoc, state, privs_sorted
+
+
+def sign_commit_for(block, state, privs, round_=0):
+    """All validators precommit the block -> Commit."""
+    ps = block.make_part_set(65536)
+    bid = BlockID(hash=block.hash(), part_set_header=ps.header())
+    vote_set = VoteSet(
+        state.chain_id, block.header.height, round_, SignedMsgType.PRECOMMIT, state.validators
+    )
+    for i, p in enumerate(privs):
+        v = Vote(
+            type_=SignedMsgType.PRECOMMIT,
+            height=block.header.height,
+            round_=round_,
+            block_id=bid,
+            timestamp=cmttime.canonical_now_ms(),
+            validator_address=p.pub_key().address(),
+            validator_index=i,
+        )
+        v.signature = p.sign(v.sign_bytes(state.chain_id))
+        vote_set.add_vote(v)
+    return bid, vote_set.make_commit(), ps
+
+
+async def run_chain(n_blocks=3, txs_per_block=2):
+    gdoc, state, privs = make_genesis()
+    app = KVStoreApplication()
+    conns = AppConns(local_client_creator(app))
+    await conns.start()
+    await conns.consensus.init_chain(abci.RequestInitChain(chain_id=gdoc.chain_id))
+
+    state_store = StateStore(MemDB())
+    block_store = BlockStore(MemDB())
+    mempool = CListMempool(MempoolConfig(), conns.mempool)
+    executor = BlockExecutor(state_store, conns.consensus, mempool)
+
+    last_commit = Commit(height=0, round_=0, block_id=BlockID(), signatures=[])
+    tx_counter = 0
+    for height in range(1, n_blocks + 1):
+        for _ in range(txs_per_block):
+            r = await mempool.check_tx(f"k{tx_counter}=v{tx_counter}".encode())
+            assert r.is_ok()
+            tx_counter += 1
+        proposer = state.validators.get_proposer()
+        ec = ExtendedCommit(
+            height=last_commit.height,
+            round_=last_commit.round_,
+            block_id=last_commit.block_id,
+            extended_signatures=[],
+        )
+        # rebuild extended sigs from plain commit (no extensions enabled)
+        from cometbft_tpu.types.commit import ExtendedCommitSig
+
+        ec.extended_signatures = [
+            ExtendedCommitSig(commit_sig=cs) for cs in last_commit.signatures
+        ]
+        block = await executor.create_proposal_block(height, state, ec, proposer.address)
+        assert len(block.data.txs) == txs_per_block
+        assert await executor.process_proposal(block, state)
+        bid, commit, ps = sign_commit_for(block, state, privs)
+        state = await executor.apply_block(state, bid, block)
+        block_store.save_block(block, ps, commit)
+        last_commit = commit
+        assert state.last_block_height == height
+        assert mempool.size() == 0  # committed txs removed
+
+    await conns.stop()
+    return state, state_store, block_store, app
+
+
+def test_apply_blocks_end_to_end():
+    state, state_store, block_store, app = asyncio.run(run_chain(3))
+    assert app.height == 3
+    assert state.app_hash == app.app_hash
+    assert block_store.height() == 3
+    # reload state from store and compare
+    loaded = state_store.load()
+    assert loaded.last_block_height == 3
+    assert loaded.app_hash == state.app_hash
+    assert loaded.validators.hash() == state.validators.hash()
+    # blocks reload with commits
+    b2 = block_store.load_block(2)
+    assert b2 is not None and b2.header.height == 2
+    assert block_store.load_seen_commit(3) is not None
+    assert block_store.load_block_commit(2) is not None  # block 3's LastCommit
+
+
+def test_validate_block_rejects_tampering():
+    async def main():
+        gdoc, state, privs = make_genesis()
+        app = KVStoreApplication()
+        conns = AppConns(local_client_creator(app))
+        await conns.start()
+        state_store = StateStore(MemDB())
+        mempool = CListMempool(MempoolConfig(), conns.mempool)
+        executor = BlockExecutor(state_store, conns.consensus, mempool)
+        ec = ExtendedCommit(height=0, round_=0, block_id=BlockID(), extended_signatures=[])
+        proposer = state.validators.get_proposer()
+        block = await executor.create_proposal_block(1, state, ec, proposer.address)
+        from cometbft_tpu.state.execution import ErrInvalidBlock
+
+        block.header.app_hash = b"\x01" * 32
+        with pytest.raises(ErrInvalidBlock):
+            executor.validate_block(state, block)
+        await conns.stop()
+
+    asyncio.run(main())
+
+
+def test_mempool_cache_and_reap():
+    async def main():
+        app = KVStoreApplication()
+        conns = AppConns(local_client_creator(app))
+        await conns.start()
+        mp = CListMempool(MempoolConfig(), conns.mempool)
+        assert (await mp.check_tx(b"a=1")).is_ok()
+        with pytest.raises(ErrTxInCache):
+            await mp.check_tx(b"a=1")
+        assert (await mp.check_tx(b"b=2")).is_ok()
+        assert (await mp.check_tx(b"\xff\xff")).code != 0  # app-rejected
+        assert mp.size() == 2
+        assert mp.reap_max_bytes_max_gas(-1, -1) == [b"a=1", b"b=2"]
+        assert mp.reap_max_bytes_max_gas(3, -1) == [b"a=1"]
+        assert mp.reap_max_bytes_max_gas(-1, 1) == [b"a=1"]  # gas_wanted=1 each
+        # update removes committed, recheck keeps the rest
+        await mp.update(1, [b"a=1"], [abci.ExecTxResult(code=0)])
+        assert mp.size() == 1 and mp.reap_max_txs(-1) == [b"b=2"]
+        # committed valid tx stays cache-blocked
+        with pytest.raises(ErrTxInCache):
+            await mp.check_tx(b"a=1")
+        await conns.stop()
+
+    asyncio.run(main())
+
+
+def test_validator_updates_flow_through():
+    """A val: tx changes the next-next valset (execution.go:587 updateState)."""
+
+    async def main():
+        import base64
+
+        gdoc, state, privs = make_genesis()
+        app = KVStoreApplication()
+        conns = AppConns(local_client_creator(app))
+        await conns.start()
+        state_store = StateStore(MemDB())
+        mempool = CListMempool(MempoolConfig(), conns.mempool)
+        executor = BlockExecutor(state_store, conns.consensus, mempool)
+
+        new_priv = ed25519.gen_priv_key()
+        tx = b"val:" + base64.b64encode(new_priv.pub_key().bytes_()) + b"!7"
+        await mempool.check_tx(tx)
+        ec = ExtendedCommit(height=0, round_=0, block_id=BlockID(), extended_signatures=[])
+        proposer = state.validators.get_proposer()
+        block = await executor.create_proposal_block(1, state, ec, proposer.address)
+        bid, commit, ps = sign_commit_for(block, state, privs)
+        new_state = await executor.apply_block(state, bid, block)
+        assert len(new_state.next_validators) == 5  # grew by one
+        assert len(new_state.validators) == 4  # H+1 set unchanged
+        assert new_state.last_height_validators_changed == 3
+        await conns.stop()
+
+    asyncio.run(main())
+
+
+def test_blockstore_prune():
+    state, state_store, block_store, _ = asyncio.run(run_chain(3))
+    assert block_store.prune_blocks(3) == 2
+    assert block_store.base() == 3
+    assert block_store.load_block(1) is None
+    assert block_store.load_block(3) is not None
