@@ -1,0 +1,12 @@
+"""Consensus core — the Tendermint state machine (reference: consensus/).
+
+One asyncio task per node serializes ALL state transitions (the analog of
+the reference's single receiveRoutine goroutine, consensus/state.go:774);
+peer messages, self-generated messages, and timeouts are queue items. Gossip
+lives in the reactor (p2p-land); this package never touches sockets
+(SURVEY.md §1 control relationships).
+"""
+
+from cometbft_tpu.consensus.config import ConsensusConfig  # noqa: F401
+from cometbft_tpu.consensus.round_state import RoundState, RoundStepType  # noqa: F401
+from cometbft_tpu.consensus.state import ConsensusState  # noqa: F401

@@ -1,0 +1,56 @@
+"""Consensus timing/behavior knobs (reference: config/config.go:1090-1230).
+
+Defaults mirror the reference (propose 3s + 500ms/round, prevote/precommit
+1s + 500ms/round, commit 1s); tests shrink them to drive rounds in
+milliseconds — the injectable analog of the reference's mock TimeoutTicker.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ConsensusConfig:
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: float = 0.0
+    peer_gossip_sleep_duration: float = 0.1
+    peer_query_maj23_sleep_duration: float = 2.0
+    double_sign_check_height: int = 0
+    # batch-first vote verification: stage gossip votes into device batches
+    # (VoteSet.add_pending/flush) instead of serial per-vote verification
+    batch_vote_verification: bool = False
+
+    def propose_timeout(self, round_: int) -> float:
+        return self.timeout_propose + self.timeout_propose_delta * round_
+
+    def prevote_timeout(self, round_: int) -> float:
+        return self.timeout_prevote + self.timeout_prevote_delta * round_
+
+    def precommit_timeout(self, round_: int) -> float:
+        return self.timeout_precommit + self.timeout_precommit_delta * round_
+
+
+def test_consensus_config() -> ConsensusConfig:
+    """Millisecond-scale timeouts for in-process multi-validator tests
+    (reference: config.TestConsensusConfig)."""
+    return ConsensusConfig(
+        timeout_propose=0.12,
+        timeout_propose_delta=0.05,
+        timeout_prevote=0.06,
+        timeout_prevote_delta=0.03,
+        timeout_precommit=0.06,
+        timeout_precommit_delta=0.03,
+        timeout_commit=0.03,
+        skip_timeout_commit=True,
+        peer_gossip_sleep_duration=0.005,
+        peer_query_maj23_sleep_duration=0.25,
+    )

@@ -1,0 +1,94 @@
+"""HeightVoteSet — prevote/precommit VoteSets for every round of one height.
+
+Reference: consensus/types/height_vote_set.go. Tracks which peers claim
+catch-up rounds (peer_catchup_rounds, max 2 per peer) so Byzantine peers
+can't force unbounded round allocations.
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu.types.basic import SignedMsgType
+from cometbft_tpu.types.validator import ValidatorSet
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.types.vote_set import VoteSet
+
+
+class ErrGotVoteFromUnwantedRound(Exception):
+    pass
+
+
+class HeightVoteSet:
+    def __init__(self, chain_id: str, height: int, val_set: ValidatorSet,
+                 extensions_enabled: bool = False):
+        self.chain_id = chain_id
+        self.height = height
+        self.val_set = val_set
+        self.extensions_enabled = extensions_enabled
+        self.round_ = 0
+        self._sets: dict[int, dict[str, VoteSet]] = {}
+        self._peer_catchup_rounds: dict[str, list[int]] = {}
+        self._add_round(0)
+
+    def _add_round(self, round_: int) -> None:
+        if round_ in self._sets:
+            return
+        self._sets[round_] = {
+            "prevote": VoteSet(self.chain_id, self.height, round_,
+                               SignedMsgType.PREVOTE, self.val_set),
+            "precommit": VoteSet(self.chain_id, self.height, round_,
+                                 SignedMsgType.PRECOMMIT, self.val_set,
+                                 extensions_enabled=self.extensions_enabled),
+        }
+
+    def set_round(self, round_: int) -> None:
+        """Create vote sets up to round_+1 (catchup; height_vote_set.go:104)."""
+        new_round = self.round_
+        for r in range(self.round_, round_ + 2):
+            self._add_round(r)
+        self.round_ = round_
+
+    def add_vote(self, vote: Vote, peer_id: str = "") -> bool:
+        """height_vote_set.go:126-160: non-current rounds only allowed from
+        peers with catchup quota."""
+        if not self._is_wanted(vote.round_, peer_id):
+            raise ErrGotVoteFromUnwantedRound(
+                f"peer {peer_id} has sent a vote for round {vote.round_} != current {self.round_}"
+            )
+        self._add_round(vote.round_)
+        vs = self._get(vote.round_, vote.type_)
+        return vs.add_vote(vote)
+
+    def _is_wanted(self, round_: int, peer_id: str) -> bool:
+        if self.round_ <= round_ <= self.round_ + 1:
+            return True
+        if round_ in self._sets:
+            return True
+        rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
+        if round_ in rounds:
+            return True
+        if len(rounds) < 2:
+            rounds.append(round_)
+            return True
+        return False
+
+    def _get(self, round_: int, type_: SignedMsgType) -> VoteSet | None:
+        sets = self._sets.get(round_)
+        if sets is None:
+            return None
+        return sets["prevote" if type_ == SignedMsgType.PREVOTE else "precommit"]
+
+    def prevotes(self, round_: int) -> VoteSet | None:
+        return self._get(round_, SignedMsgType.PREVOTE)
+
+    def precommits(self, round_: int) -> VoteSet | None:
+        return self._get(round_, SignedMsgType.PRECOMMIT)
+
+    def pol_info(self) -> tuple[int, object]:
+        """Highest round with a prevote +2/3 majority (POLRound, POLBlockID)."""
+        for r in sorted(self._sets.keys(), reverse=True):
+            vs = self.prevotes(r)
+            if vs is not None:
+                bid, ok = vs.two_thirds_majority()
+                if ok:
+                    return r, bid
+        return -1, None

@@ -1,0 +1,753 @@
+"""ConsensusState — the Tendermint round state machine.
+
+Reference: consensus/state.go. Structure mirrors the reference's transition
+graph exactly (SURVEY.md §3.2):
+
+  enterNewRound -> enterPropose -> [complete proposal] -> enterPrevote
+  -> [+2/3 prevotes] -> enterPrecommit (lock/unlock rules)
+  -> [+2/3 precommits] -> enterCommit -> finalizeCommit -> next height
+
+Concurrency: ONE asyncio task (`_receive_routine`) consumes a queue of
+peer/internal messages and timeout events; every transition happens on that
+task, so the round state needs no locks (the reference pins everything to
+one goroutine for the same reason, state.go:774). Messages are WAL-logged
+before processing; EndHeightMessage is fsynced before ApplyBlock
+(state.go:1810), making crash-replay exact.
+
+Vote ingestion: serial add_vote by default; with
+config.batch_vote_verification the VoteSet's staged/batched path carries
+gossip votes to the TPU kernel (SURVEY.md §3.3, the north-star hot path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import traceback
+from typing import Callable, Optional
+
+from cometbft_tpu.consensus import messages as M
+from cometbft_tpu.consensus.config import ConsensusConfig
+from cometbft_tpu.consensus.height_vote_set import HeightVoteSet
+from cometbft_tpu.consensus.round_state import RoundState, RoundStepType
+from cometbft_tpu.consensus.ticker import TimeoutInfo, TimeoutTicker
+from cometbft_tpu.consensus.wal import WAL, EndHeightMessage
+from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.libs.service import BaseService, TaskRunner
+from cometbft_tpu.privval.file_pv import PrivValidator
+from cometbft_tpu.state import BlockExecutor, State
+from cometbft_tpu.store.blockstore import BlockStore
+from cometbft_tpu.types.basic import BlockID, SignedMsgType
+from cometbft_tpu.types.block import Block
+from cometbft_tpu.types.commit import Commit, ExtendedCommit, ExtendedCommitSig
+from cometbft_tpu.types.part_set import PartSet
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.types.vote_set import ErrVoteConflictingVotes, VoteSet
+from cometbft_tpu.utils import cmttime
+
+BLOCK_PART_SIZE = 65536
+
+
+class _TaggedQueue:
+    """Adapter: the TimeoutTicker puts bare TimeoutInfo; the state queue
+    carries (from_peer, msg) pairs."""
+
+    def __init__(self, inner: asyncio.Queue):
+        self._inner = inner
+
+    async def put(self, ti) -> None:
+        await self._inner.put((False, ti))
+
+
+class ConsensusState(BaseService):
+    def __init__(
+        self,
+        config: ConsensusConfig,
+        state: State,
+        block_exec: BlockExecutor,
+        block_store: BlockStore,
+        wal: WAL | None = None,
+        priv_validator: PrivValidator | None = None,
+        event_switch=None,
+        logger: cmtlog.Logger | None = None,
+    ):
+        super().__init__("ConsensusState", logger)
+        self.config = config
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.wal = wal
+        self.priv_validator = priv_validator
+        self.priv_validator_pub_key = (
+            priv_validator.get_pub_key() if priv_validator else None
+        )
+        self.event_switch = event_switch  # libs.events.EventSwitch (reactor fast path)
+
+        self.rs = RoundState()
+        self.state: State | None = None
+
+        # One multiplexed queue of (from_peer, msg) — the analog of the
+        # reference's select over peerMsgQueue/internalMsgQueue/tockChan.
+        self.msg_queue: asyncio.Queue = asyncio.Queue(maxsize=5000)
+        self.timeout_queue = _TaggedQueue(self.msg_queue)
+        self.timeout_ticker = TimeoutTicker(self.timeout_queue)
+        self._tasks = TaskRunner("consensus")
+        self._wait_sync = False
+        self.n_steps = 0  # transition counter (test instrumentation)
+
+        # injectable decision hooks (reference: state.go:122-124, the seam
+        # that makes byzantine tests possible)
+        self.decide_proposal: Callable = self._default_decide_proposal
+        self.do_prevote: Callable = self._default_do_prevote
+        self.set_proposal_fn: Callable = self._default_set_proposal
+
+        self.update_to_state(state)
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def on_start(self) -> None:
+        if self.wal is not None:
+            await self._catchup_replay()
+        self._tasks.spawn(self._receive_routine(), name="cs-receive")
+        self._schedule_round_0(self.rs)
+
+    async def on_stop(self) -> None:
+        self.timeout_ticker.stop()
+        await self._tasks.cancel_all()
+        if self.wal is not None:
+            self.wal.close()
+
+    # ---------------------------------------------------------- state setup
+
+    def update_to_state(self, state: State) -> None:
+        """state.go:1842 updateToState: prepare RoundState for the height
+        after state.last_block_height."""
+        if self.rs.commit_round > -1 and 0 < self.rs.height != state.last_block_height:
+            raise RuntimeError(
+                f"updateToState expected state height {self.rs.height}, got {state.last_block_height}"
+            )
+        validators = state.validators
+        last_precommits: VoteSet | None = None
+        if self.rs.commit_round > -1 and self.rs.votes is not None:
+            pcs = self.rs.votes.precommits(self.rs.commit_round)
+            if pcs is None or not pcs.has_two_thirds_majority():
+                raise RuntimeError("updateToState called with no +2/3 precommits")
+            last_precommits = pcs
+
+        height = state.last_block_height + 1
+        if height == 1:
+            height = state.initial_height
+
+        self.rs = RoundState(
+            height=height,
+            round_=0,
+            step=RoundStepType.NEW_HEIGHT,
+            start_time=cmttime.now().add_seconds(self.config.timeout_commit),
+            validators=validators.copy() if validators else None,
+            votes=HeightVoteSet(
+                state.chain_id, height, validators,
+                extensions_enabled=state.consensus_params.abci.vote_extensions_enabled(height),
+            ),
+            last_commit=last_precommits,
+            last_validators=state.last_validators.copy() if state.last_validators else None,
+            locked_round=-1,
+            valid_round=-1,
+            commit_round=-1,
+        )
+        self.state = state
+
+    def _schedule_round_0(self, rs: RoundState) -> None:
+        sleep = max(0.0, (rs.start_time.unix_ns() - cmttime.now().unix_ns()) / 1e9)
+        self.timeout_ticker.schedule_timeout(
+            TimeoutInfo(sleep, rs.height, 0, RoundStepType.NEW_HEIGHT)
+        )
+
+    def _schedule_timeout(self, duration: float, height: int, round_: int, step: RoundStepType) -> None:
+        self.timeout_ticker.schedule_timeout(TimeoutInfo(duration, height, round_, step))
+
+    # --------------------------------------------------------- public input
+
+    async def add_vote_from_peer(self, vote: Vote, peer_id: str) -> None:
+        await self.msg_queue.put((True, M.VoteMessage(vote=vote, peer_id=peer_id)))
+
+    async def add_proposal_from_peer(self, proposal: Proposal, peer_id: str) -> None:
+        await self.msg_queue.put((True, M.ProposalMessage(proposal=proposal, peer_id=peer_id)))
+
+    async def add_block_part_from_peer(self, height: int, round_: int, part, peer_id: str) -> None:
+        await self.msg_queue.put(
+            (True, M.BlockPartMessage(height=height, round_=round_, part=part, peer_id=peer_id))
+        )
+
+    # --------------------------------------------------------- receive loop
+
+    async def _receive_routine(self) -> None:
+        """state.go:774-862: the single serialization point."""
+        while True:
+            try:
+                from_peer, msg = await self.msg_queue.get()
+                if isinstance(msg, TimeoutInfo):
+                    if self.wal is not None:
+                        self.wal.write(msg)
+                    await self._handle_timeout(msg)
+                else:
+                    if self.wal is not None:
+                        if from_peer:
+                            self.wal.write(msg)
+                        else:
+                            self.wal.write_sync(msg)  # state.go:829 fsync own msgs
+                    await self._handle_msg(msg)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - CONSENSUS FAILURE (state.go:789)
+                self.logger.error(
+                    "CONSENSUS FAILURE!!!", err=traceback.format_exc()
+                )
+                return
+
+    async def _handle_msg(self, msg) -> None:
+        if isinstance(msg, M.ProposalMessage):
+            self._set_proposal(msg.proposal, msg.peer_id)
+        elif isinstance(msg, M.BlockPartMessage):
+            await self._add_proposal_block_part(msg)
+        elif isinstance(msg, M.VoteMessage):
+            await self._try_add_vote(msg.vote, msg.peer_id)
+        else:
+            self.logger.error("unknown msg type", type=str(type(msg)))
+
+    async def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """state.go:930-980."""
+        rs = self.rs
+        if ti.height != rs.height or ti.round_ < rs.round_ or (
+            ti.round_ == rs.round_ and ti.step < rs.step
+        ):
+            return  # stale
+        if ti.step == RoundStepType.NEW_HEIGHT:
+            await self._enter_new_round(ti.height, 0)
+        elif ti.step == RoundStepType.NEW_ROUND:
+            await self._enter_propose(ti.height, 0)
+        elif ti.step == RoundStepType.PROPOSE:
+            await self._enter_prevote(ti.height, ti.round_)
+        elif ti.step == RoundStepType.PREVOTE_WAIT:
+            await self._enter_precommit(ti.height, ti.round_)
+        elif ti.step == RoundStepType.PRECOMMIT_WAIT:
+            await self._enter_precommit(ti.height, ti.round_)
+            await self._enter_new_round(ti.height, ti.round_ + 1)
+        else:
+            self.logger.error("invalid timeout step", step=ti.step.name)
+
+    # ------------------------------------------------------------- rounds
+
+    def _new_step(self, step: RoundStepType) -> None:
+        self.rs.step = step
+        self.n_steps += 1
+        if self.event_switch is not None:
+            self.event_switch.fire("NewRoundStep", self.rs)
+
+    async def _enter_new_round(self, height: int, round_: int) -> None:
+        """state.go:1042-1127."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round_ or (
+            rs.round_ == round_ and rs.step != RoundStepType.NEW_HEIGHT
+        ):
+            return
+        validators = rs.validators
+        if rs.round_ < round_:
+            validators = validators.copy()
+            validators.increment_proposer_priority(round_ - rs.round_)
+        rs.validators = validators
+        rs.round_ = round_
+        self._new_step(RoundStepType.NEW_ROUND)
+        if round_ != 0:
+            # round catchup resets proposal state (state.go:1092-1100)
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_)
+        rs.triggered_timeout_precommit = False
+
+        wait_for_txs = (
+            self.config.create_empty_blocks_interval > 0
+            and not self.config.create_empty_blocks
+        )
+        if wait_for_txs:
+            self._schedule_timeout(
+                self.config.create_empty_blocks_interval, height, round_,
+                RoundStepType.NEW_ROUND,
+            )
+        await self._enter_propose(height, round_)
+
+    def _is_proposer(self) -> bool:
+        if self.priv_validator_pub_key is None:
+            return False
+        proposer = self.rs.validators.get_proposer()
+        return proposer is not None and proposer.address == self.priv_validator_pub_key.address()
+
+    async def _enter_propose(self, height: int, round_: int) -> None:
+        """state.go:1129-1192."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round_ or (
+            rs.round_ == round_ and rs.step >= RoundStepType.PROPOSE
+        ):
+            return
+        rs.round_ = round_
+        self._new_step(RoundStepType.PROPOSE)
+        self._schedule_timeout(
+            self.config.propose_timeout(round_), height, round_, RoundStepType.PROPOSE
+        )
+        if self._is_proposer():
+            await self.decide_proposal(height, round_)
+        if self._is_proposal_complete():
+            await self._enter_prevote(height, rs.round_)
+
+    async def _default_decide_proposal(self, height: int, round_: int) -> None:
+        """state.go:1193-1266."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, block_parts = rs.valid_block, rs.valid_block_parts
+        else:
+            block = await self._create_proposal_block()
+            if block is None:
+                return
+            block_parts = block.make_part_set(BLOCK_PART_SIZE)
+        block_id = BlockID(hash=block.hash(), part_set_header=block_parts.header())
+        proposal = Proposal(
+            height=height, round_=round_, pol_round=rs.valid_round,
+            block_id=block_id, timestamp=cmttime.now(),
+        )
+        try:
+            self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except Exception as e:  # noqa: BLE001
+            self.logger.error("propose step; failed signing proposal", err=str(e))
+            return
+        await self.msg_queue.put((False, M.ProposalMessage(proposal=proposal)))
+        for i in range(block_parts.total):
+            await self.msg_queue.put(
+                (False, M.BlockPartMessage(height=rs.height, round_=rs.round_, part=block_parts.get_part(i)))
+            )
+        self.logger.info("signed proposal", height=height, round=round_, proposal=str(proposal.block_id))
+
+    async def _create_proposal_block(self) -> Block | None:
+        """state.go:1268-1309."""
+        if self.priv_validator_pub_key is None:
+            return None
+        rs = self.rs
+        if rs.height == self.state.initial_height:
+            last_ext_commit = ExtendedCommit(
+                height=0, round_=0, block_id=BlockID(), extended_signatures=[]
+            )
+        elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+            last_ext_commit = rs.last_commit.make_extended_commit()
+        else:
+            self.logger.error("propose step; cannot propose anything without commit for the previous block")
+            return None
+        return await self.block_exec.create_proposal_block(
+            rs.height, self.state, last_ext_commit, self.priv_validator_pub_key.address()
+        )
+
+    def _is_proposal_complete(self) -> bool:
+        """state.go:1311-1330."""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    # ------------------------------------------------------------ proposal
+
+    def _set_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
+        self.set_proposal_fn(proposal, peer_id)
+
+    def _default_set_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
+        """state.go:1960-1993 defaultSetProposal."""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round_ != rs.round_:
+            return
+        if proposal.pol_round < -1 or (
+            proposal.pol_round >= 0 and proposal.pol_round >= proposal.round_
+        ):
+            raise ValueError("error invalid proposal POL round")
+        proposer = rs.validators.get_proposer()
+        if not proposal.verify(self.state.chain_id, proposer.pub_key):
+            raise ValueError("error invalid proposal signature")
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet.from_header(proposal.block_id.part_set_header)
+        self.logger.info("received proposal", proposal=str(proposal.block_id), peer=peer_id)
+
+    async def _add_proposal_block_part(self, msg: M.BlockPartMessage) -> bool:
+        """state.go:1994-2073."""
+        rs = self.rs
+        if msg.height != rs.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        added = rs.proposal_block_parts.add_part(msg.part)
+        if not added:
+            return False
+        if rs.proposal_block_parts.is_complete():
+            block = Block.from_proto(rs.proposal_block_parts.get_reader())
+            rs.proposal_block = block
+            self.logger.info("received complete proposal block",
+                             height=block.header.height, hash=block.hash().hex()[:12])
+            await self._handle_complete_proposal(msg.height)
+        return True
+
+    async def _handle_complete_proposal(self, height: int) -> None:
+        """state.go:2074-2108."""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(rs.round_)
+        block_id, has_maj = (prevotes.two_thirds_majority() if prevotes else (None, False))
+        if has_maj and not block_id.is_nil() and rs.valid_round < rs.round_:
+            if rs.proposal_block.hash() == block_id.hash:
+                rs.valid_round = rs.round_
+                rs.valid_block = rs.proposal_block
+                rs.valid_block_parts = rs.proposal_block_parts
+        if rs.step <= RoundStepType.PROPOSE and self._is_proposal_complete():
+            await self._enter_prevote(height, rs.round_)
+            if has_maj:
+                await self._enter_precommit(height, rs.round_)
+        elif rs.step == RoundStepType.COMMIT:
+            await self._try_finalize_commit(height)
+
+    # ------------------------------------------------------------- prevote
+
+    async def _enter_prevote(self, height: int, round_: int) -> None:
+        """state.go:1311-1336."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round_ or (
+            rs.round_ == round_ and rs.step >= RoundStepType.PREVOTE
+        ):
+            return
+        rs.round_ = round_
+        self._new_step(RoundStepType.PREVOTE)
+        await self.do_prevote(height, round_)
+
+    async def _default_do_prevote(self, height: int, round_: int) -> None:
+        """state.go:1337-1410."""
+        rs = self.rs
+        if rs.locked_block is not None:
+            await self._sign_add_vote(SignedMsgType.PREVOTE, rs.locked_block.hash(),
+                                      rs.locked_block_parts.header())
+            return
+        if rs.proposal_block is None:
+            await self._sign_add_vote(SignedMsgType.PREVOTE, b"", None)
+            return
+        try:
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+            accepted = await self.block_exec.process_proposal(rs.proposal_block, self.state)
+        except Exception as e:  # noqa: BLE001
+            self.logger.error("prevote step: invalid proposal block", err=str(e))
+            accepted = False
+        if accepted:
+            await self._sign_add_vote(
+                SignedMsgType.PREVOTE, rs.proposal_block.hash(),
+                rs.proposal_block_parts.header(),
+            )
+        else:
+            await self._sign_add_vote(SignedMsgType.PREVOTE, b"", None)
+
+    async def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        """state.go:1478-1510."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round_ or (
+            rs.round_ == round_ and rs.step >= RoundStepType.PREVOTE_WAIT
+        ):
+            return
+        prevotes = rs.votes.prevotes(round_)
+        if prevotes is None or not prevotes.has_two_thirds_any():
+            raise RuntimeError("enterPrevoteWait without +2/3 prevotes")
+        rs.round_ = round_
+        self._new_step(RoundStepType.PREVOTE_WAIT)
+        self._schedule_timeout(
+            self.config.prevote_timeout(round_), height, round_, RoundStepType.PREVOTE_WAIT
+        )
+
+    # ----------------------------------------------------------- precommit
+
+    async def _enter_precommit(self, height: int, round_: int) -> None:
+        """state.go:1513-1645 — the locking rules."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round_ or (
+            rs.round_ == round_ and rs.step >= RoundStepType.PRECOMMIT
+        ):
+            return
+        rs.round_ = round_
+        self._new_step(RoundStepType.PRECOMMIT)
+        prevotes = rs.votes.prevotes(round_)
+        block_id, has_maj = (prevotes.two_thirds_majority() if prevotes else (None, False))
+        if not has_maj:
+            # no +2/3 prevotes: precommit nil (no unlock)
+            await self._sign_add_vote(SignedMsgType.PRECOMMIT, b"", None)
+            return
+        # +2/3 nil: unlock and precommit nil
+        if block_id.is_nil():
+            if rs.locked_block is not None:
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+            await self._sign_add_vote(SignedMsgType.PRECOMMIT, b"", None)
+            return
+        # +2/3 for our locked block: relock
+        if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+            rs.locked_round = round_
+            await self._sign_add_vote(SignedMsgType.PRECOMMIT, block_id.hash,
+                                      block_id.part_set_header)
+            return
+        # +2/3 for the proposal block: lock it
+        if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            await self._sign_add_vote(SignedMsgType.PRECOMMIT, block_id.hash,
+                                      block_id.part_set_header)
+            return
+        # +2/3 for a block we don't have: unlock, fetch it, precommit nil
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet.from_header(block_id.part_set_header)
+        await self._sign_add_vote(SignedMsgType.PRECOMMIT, b"", None)
+
+    async def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        """state.go:1646-1676."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round_ or (
+            rs.round_ == round_ and rs.triggered_timeout_precommit
+        ):
+            return
+        precommits = rs.votes.precommits(round_)
+        if precommits is None or not precommits.has_two_thirds_any():
+            raise RuntimeError("enterPrecommitWait without +2/3 precommits")
+        rs.triggered_timeout_precommit = True
+        self._new_step(RoundStepType.PRECOMMIT_WAIT)
+        self._schedule_timeout(
+            self.config.precommit_timeout(round_), height, round_, RoundStepType.PRECOMMIT_WAIT
+        )
+
+    # -------------------------------------------------------------- commit
+
+    async def _enter_commit(self, height: int, commit_round: int) -> None:
+        """state.go:1648-1709."""
+        rs = self.rs
+        if rs.height != height or rs.step >= RoundStepType.COMMIT:
+            return
+        precommits = rs.votes.precommits(commit_round)
+        block_id, has_maj = precommits.two_thirds_majority()
+        if not has_maj or block_id.is_nil():
+            raise RuntimeError("RunActionCommit expected +2/3 precommits for a block")
+        rs.commit_round = commit_round
+        rs.commit_time = cmttime.now()
+        self._new_step(RoundStepType.COMMIT)
+        if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet.from_header(block_id.part_set_header)
+        await self._try_finalize_commit(height)
+
+    async def _try_finalize_commit(self, height: int) -> None:
+        """state.go:1711-1737."""
+        rs = self.rs
+        if rs.height != height:
+            raise RuntimeError("tryFinalizeCommit at wrong height")
+        precommits = rs.votes.precommits(rs.commit_round)
+        block_id, has_maj = precommits.two_thirds_majority()
+        if not has_maj or block_id.is_nil():
+            return
+        if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+            return  # waiting for block parts
+        await self._finalize_commit(height)
+
+    async def _finalize_commit(self, height: int) -> None:
+        """state.go:1739-1852."""
+        rs = self.rs
+        block, block_parts = rs.proposal_block, rs.proposal_block_parts
+        precommits = rs.votes.precommits(rs.commit_round)
+        block_id, _ = precommits.two_thirds_majority()
+        self.block_exec.validate_block(self.state, block)
+
+        if self.block_store.height() < block.header.height:
+            seen_extended = rs.votes.precommits(rs.commit_round).make_extended_commit()
+            if self.state.consensus_params.abci.vote_extensions_enabled(block.header.height):
+                self.block_store.save_block_with_extended_commit(block, block_parts, seen_extended)
+            else:
+                self.block_store.save_block(block, block_parts, seen_extended.to_commit())
+
+        if self.wal is not None:
+            self.wal.write_sync(EndHeightMessage(height))  # state.go:1810 fsync
+
+        new_state = await self.block_exec.apply_block(self.state, block_id, block)
+        self.logger.info(
+            "finalized block", height=height, hash=block.hash().hex()[:12],
+            txs=len(block.data.txs), app_hash=new_state.app_hash.hex()[:12],
+        )
+        self.update_to_state(new_state)
+        self._schedule_round_0(self.rs)
+
+    # --------------------------------------------------------------- votes
+
+    async def _sign_add_vote(self, type_: SignedMsgType, hash_: bytes, psh) -> Optional[Vote]:
+        """state.go:2452-2490 signAddVote."""
+        rs = self.rs
+        if self.priv_validator is None or self.priv_validator_pub_key is None:
+            return None
+        addr = self.priv_validator_pub_key.address()
+        if not rs.validators.has_address(addr):
+            return None
+        idx, _ = rs.validators.get_by_address(addr)
+        vote = Vote(
+            type_=type_,
+            height=rs.height,
+            round_=rs.round_,
+            block_id=BlockID(hash=hash_, part_set_header=psh) if hash_ else BlockID(),
+            timestamp=cmttime.canonical_now_ms(),
+            validator_address=addr,
+            validator_index=idx,
+        )
+        ext_enabled = self.state.consensus_params.abci.vote_extensions_enabled(rs.height)
+        if ext_enabled and type_ == SignedMsgType.PRECOMMIT and hash_:
+            from cometbft_tpu.abci import types as abci
+
+            resp = await self.block_exec.app_conn.extend_vote(
+                abci.RequestExtendVote(hash=hash_, height=rs.height, round_=rs.round_)
+            )
+            vote.extension = resp.vote_extension
+        try:
+            self.priv_validator.sign_vote(self.state.chain_id, vote, sign_extension=ext_enabled)
+        except Exception as e:  # noqa: BLE001
+            self.logger.error("failed signing vote", err=str(e))
+            return None
+        await self.msg_queue.put((False, M.VoteMessage(vote=vote)))
+        return vote
+
+    async def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """state.go:2110-2159: tolerate expected errors, detect equivocation."""
+        try:
+            return await self._add_vote(vote, peer_id)
+        except ErrVoteConflictingVotes as e:
+            if vote.validator_address == (
+                self.priv_validator_pub_key.address() if self.priv_validator_pub_key else b""
+            ):
+                self.logger.error("found conflicting vote from ourselves; did you unsafe_reset a validator?")
+                raise
+            if self.block_exec.evidence_pool is not None:
+                from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+
+                ev = DuplicateVoteEvidence.new(
+                    e.vote_a, e.vote_b, self.state.last_block_time, self.rs.validators
+                )
+                self.block_exec.evidence_pool.add_evidence(ev)
+            self.logger.info("found and sent conflicting vote to evidence pool", vote=str(vote))
+            return False
+        except Exception as e:  # noqa: BLE001 - bad votes are logged, not fatal
+            self.logger.info("failed attempting to add vote", err=str(e))
+            return False
+
+    async def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """state.go:2161-2450."""
+        rs = self.rs
+        # precommit for previous height (LastCommit catchup, state.go:2176)
+        if vote.height + 1 == rs.height and vote.type_ == SignedMsgType.PRECOMMIT:
+            if rs.step != RoundStepType.NEW_HEIGHT or rs.last_commit is None:
+                return False
+            added = rs.last_commit.add_vote(vote)
+            if added and self.event_switch is not None:
+                self.event_switch.fire("Vote", vote)
+            return added
+        if vote.height != rs.height:
+            return False
+
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        if self.event_switch is not None:
+            self.event_switch.fire("Vote", vote)
+
+        if vote.type_ == SignedMsgType.PREVOTE:
+            await self._on_prevote_added(vote)
+        else:
+            await self._on_precommit_added(vote)
+        return True
+
+    async def _on_prevote_added(self, vote: Vote) -> None:
+        """state.go:2270-2366."""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(vote.round_)
+        block_id, has_maj = prevotes.two_thirds_majority()
+        if has_maj:
+            # unlock on POL for a different block (state.go:2290-2305)
+            if (
+                rs.locked_block is not None
+                and rs.locked_round < vote.round_ <= rs.round_
+                and rs.locked_block.hash() != block_id.hash
+            ):
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+            # update valid block (state.go:2307-2330)
+            if not block_id.is_nil() and rs.valid_round < vote.round_ <= rs.round_:
+                if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+                    rs.valid_round = vote.round_
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+                else:
+                    rs.proposal_block = None
+                    rs.proposal_block_parts = PartSet.from_header(block_id.part_set_header)
+
+        if rs.round_ < vote.round_ and prevotes.has_two_thirds_any():
+            await self._enter_new_round(rs.height, vote.round_)
+        elif rs.round_ == vote.round_ and rs.step >= RoundStepType.PREVOTE:
+            if has_maj and (self._is_proposal_complete() or block_id.is_nil()):
+                await self._enter_precommit(rs.height, vote.round_)
+            elif prevotes.has_two_thirds_any():
+                await self._enter_prevote_wait(rs.height, vote.round_)
+        elif rs.proposal is not None and 0 <= rs.proposal.pol_round == vote.round_:
+            if self._is_proposal_complete():
+                await self._enter_prevote(rs.height, rs.round_)
+
+    async def _on_precommit_added(self, vote: Vote) -> None:
+        """state.go:2368-2416."""
+        rs = self.rs
+        precommits = rs.votes.precommits(vote.round_)
+        block_id, has_maj = precommits.two_thirds_majority()
+        if has_maj:
+            await self._enter_new_round(rs.height, vote.round_)
+            await self._enter_precommit(rs.height, vote.round_)
+            if not block_id.is_nil():
+                await self._enter_commit(rs.height, vote.round_)
+                if self.config.skip_timeout_commit and precommits.has_all():
+                    await self._enter_new_round(rs.height, 0)
+            else:
+                await self._enter_precommit_wait(rs.height, vote.round_)
+        elif rs.round_ <= vote.round_ and precommits.has_two_thirds_any():
+            await self._enter_new_round(rs.height, vote.round_)
+            await self._enter_precommit_wait(rs.height, vote.round_)
+
+    # -------------------------------------------------------------- replay
+
+    async def _catchup_replay(self) -> None:
+        """Replay WAL messages recorded after the last EndHeight
+        (consensus/replay.go:94): re-feed them through the handlers with
+        WAL writes disabled."""
+        msgs = self.wal.replay_after_height(self.rs.height - 1)
+        if not msgs:
+            return
+        self.logger.info("catchup replay", height=self.rs.height, msgs=len(msgs))
+        wal, self.wal = self.wal, None
+        try:
+            for msg in msgs:
+                if isinstance(msg, TimeoutInfo):
+                    await self._handle_timeout(msg)
+                elif isinstance(msg, EndHeightMessage):
+                    continue
+                else:
+                    await self._handle_msg(msg)
+        finally:
+            self.wal = wal

@@ -1,0 +1,7 @@
+"""Validator key custody (reference: privval/).
+
+FilePV: file-backed signer with height/round/step double-sign protection
+(privval/file.go:100 CheckHRS). Remote signer protocol in signer.py.
+"""
+
+from cometbft_tpu.privval.file_pv import FilePV, PrivValidator  # noqa: F401

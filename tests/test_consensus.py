@@ -1,0 +1,197 @@
+"""Consensus state machine: single-validator chain producing blocks
+end-to-end (proposal -> prevote -> precommit -> commit -> next height),
+WAL write/replay, privval double-sign protection.
+
+Reference test model: consensus/state_test.go, consensus/wal_test.go,
+privval/file_test.go.
+"""
+
+import asyncio
+import os
+import secrets
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.consensus import ConsensusState
+from cometbft_tpu.consensus.config import test_consensus_config as make_test_config
+from cometbft_tpu.consensus.ticker import TimeoutInfo
+from cometbft_tpu.consensus.round_state import RoundStepType
+from cometbft_tpu.consensus.wal import WAL, EndHeightMessage
+from cometbft_tpu.consensus import messages as M
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.mempool.mempool import CListMempool, MempoolConfig
+from cometbft_tpu.privval.file_pv import ErrDoubleSign, FilePV
+from cometbft_tpu.proxy import AppConns, local_client_creator
+from cometbft_tpu.state import BlockExecutor, State, StateStore
+from cometbft_tpu.store import BlockStore, MemDB
+from cometbft_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.utils import cmttime
+
+
+async def make_node(tmp_path=None, n_vals=1, val_index=0, privs=None):
+    """Wire a ConsensusState to an in-proc kvstore app. Returns the pieces."""
+    if privs is None:
+        privs = [ed25519.gen_priv_key() for _ in range(n_vals)]
+    gdoc = GenesisDoc(
+        genesis_time=cmttime.canonical_now_ms(),
+        chain_id="cs-test-chain",
+        validators=[
+            GenesisValidator(address=p.pub_key().address(), pub_key=p.pub_key(), power=10)
+            for p in privs
+        ],
+    )
+    gdoc.validate_and_complete()
+    state = State.from_genesis(gdoc)
+    app = KVStoreApplication()
+    conns = AppConns(local_client_creator(app))
+    await conns.start()
+    state_store = StateStore(MemDB())
+    block_store = BlockStore(MemDB())
+    mempool = CListMempool(MempoolConfig(), conns.mempool)
+    block_exec = BlockExecutor(state_store, conns.consensus, mempool)
+    wal = None
+    if tmp_path is not None:
+        wal = WAL(os.path.join(str(tmp_path), "wal", "wal.bin"))
+    pv = FilePV(privs[val_index])
+    cs = ConsensusState(
+        config=make_test_config(),
+        state=state,
+        block_exec=block_exec,
+        block_store=block_store,
+        wal=wal,
+        priv_validator=pv,
+    )
+    return cs, conns, mempool, block_store, app, privs
+
+
+async def wait_for_height(block_store, h, timeout=20.0):
+    async def poll():
+        while block_store.height() < h:
+            await asyncio.sleep(0.02)
+
+    await asyncio.wait_for(poll(), timeout)
+
+
+def test_single_validator_chain_produces_blocks(tmp_path):
+    async def main():
+        cs, conns, mempool, block_store, app, _ = await make_node(tmp_path)
+        r = await mempool.check_tx(b"cs=works")
+        assert r.is_ok()
+        await cs.start()
+        try:
+            await wait_for_height(block_store, 3)
+        finally:
+            await cs.stop()
+            await conns.stop()
+        assert block_store.height() >= 3
+        assert app.height >= 3
+        # the tx landed in some block
+        found = any(
+            b"cs=works" in (block_store.load_block(h).data.txs or [])
+            for h in range(1, block_store.height() + 1)
+        )
+        assert found
+        # commits verify: load block 2's LastCommit (sigs for height 1)
+        b2 = block_store.load_block(2)
+        assert b2.last_commit is not None and b2.last_commit.height == 1
+        return block_store.height()
+
+    asyncio.run(main())
+
+
+def test_wal_records_end_heights(tmp_path):
+    async def main():
+        cs, conns, mempool, block_store, app, _ = await make_node(tmp_path)
+        await cs.start()
+        try:
+            await wait_for_height(block_store, 2)
+        finally:
+            await cs.stop()
+            await conns.stop()
+        wal = WAL(os.path.join(str(tmp_path), "wal", "wal.bin"))
+        assert wal.search_for_end_height(1)
+        assert wal.search_for_end_height(2)
+        # messages exist after the last completed height
+        msgs = wal.replay_after_height(1)
+        assert any(isinstance(m, M.VoteMessage) for m in msgs)
+        wal.close()
+
+    asyncio.run(main())
+
+
+def test_wal_corrupted_tail_truncated(tmp_path):
+    path = os.path.join(str(tmp_path), "wal.bin")
+    wal = WAL(path)
+    wal.write_sync(EndHeightMessage(1))
+    wal.write_sync(EndHeightMessage(2))
+    wal.close()
+    good_size = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(b"\x00\x01\x02torn-record")
+    wal2 = WAL(path)
+    msgs = list(wal2.iter_records())
+    assert [m.height for m in msgs] == [1, 2]
+    assert os.path.getsize(path) == good_size  # tail repaired
+    wal2.close()
+
+
+class TestFilePV:
+    def _vote(self, priv, h, r, type_=SignedMsgType.PREVOTE, bid=None):
+        return Vote(
+            type_=type_, height=h, round_=r,
+            block_id=bid or BlockID(),
+            timestamp=cmttime.canonical_now_ms(),
+            validator_address=priv.pub_key().address(),
+            validator_index=0,
+        )
+
+    def test_sign_and_persist(self, tmp_path):
+        kf = os.path.join(str(tmp_path), "key.json")
+        sf = os.path.join(str(tmp_path), "state.json")
+        pv = FilePV.generate(kf, sf)
+        v = self._vote(pv.priv_key, 1, 0)
+        pv.sign_vote("c", v)
+        assert v.signature and pv.get_pub_key().verify_signature(v.sign_bytes("c"), v.signature)
+        # reload: same key, same state
+        pv2 = FilePV.load(kf, sf)
+        assert pv2.get_pub_key() == pv.get_pub_key()
+        assert pv2.last_sign_state.height == 1
+
+    def test_double_sign_blocked(self, tmp_path):
+        pv = FilePV(ed25519.gen_priv_key())
+        bid1 = BlockID(hash=secrets.token_bytes(32), part_set_header=PartSetHeader(1, secrets.token_bytes(32)))
+        bid2 = BlockID(hash=secrets.token_bytes(32), part_set_header=PartSetHeader(1, secrets.token_bytes(32)))
+        v1 = self._vote(pv.priv_key, 5, 0, bid=bid1)
+        pv.sign_vote("c", v1)
+        v2 = self._vote(pv.priv_key, 5, 0, bid=bid2)
+        with pytest.raises(ErrDoubleSign):
+            pv.sign_vote("c", v2)
+        # height regression also blocked
+        v3 = self._vote(pv.priv_key, 4, 0)
+        with pytest.raises(ErrDoubleSign):
+            pv.sign_vote("c", v3)
+
+    def test_same_vote_resigned(self, tmp_path):
+        pv = FilePV(ed25519.gen_priv_key())
+        bid = BlockID(hash=secrets.token_bytes(32), part_set_header=PartSetHeader(1, secrets.token_bytes(32)))
+        v1 = self._vote(pv.priv_key, 5, 0, bid=bid)
+        pv.sign_vote("c", v1)
+        # identical vote (crash-restart): cached signature returned
+        v2 = self._vote(pv.priv_key, 5, 0, bid=bid)
+        v2.timestamp = v1.timestamp
+        pv.sign_vote("c", v2)
+        assert v2.signature == v1.signature
+
+    def test_timestamp_only_difference_resigned(self, tmp_path):
+        pv = FilePV(ed25519.gen_priv_key())
+        bid = BlockID(hash=secrets.token_bytes(32), part_set_header=PartSetHeader(1, secrets.token_bytes(32)))
+        v1 = self._vote(pv.priv_key, 5, 0, bid=bid)
+        pv.sign_vote("c", v1)
+        v2 = self._vote(pv.priv_key, 5, 0, bid=bid)
+        v2.timestamp = v1.timestamp.add_ns(5_000_000)
+        pv.sign_vote("c", v2)
+        assert v2.signature == v1.signature
+        assert v2.timestamp == v1.timestamp  # original signed ts restored
