@@ -736,6 +736,17 @@ class ConsensusState(BaseService):
         """Replay WAL messages recorded after the last EndHeight
         (consensus/replay.go:94): re-feed them through the handlers with
         WAL writes disabled."""
+        # replay.go:99-115: the WAL must NOT already contain EndHeight for
+        # the height we are about to run — that means the block committed
+        # (crash between the EndHeight fsync and the state-store save) and
+        # re-feeding its messages would double-execute it against the app.
+        # Recovery for that window is handshake block replay, not WAL
+        # replay.
+        if self.wal.search_for_end_height(self.rs.height):
+            raise RuntimeError(
+                f"WAL should not contain EndHeight {self.rs.height}: block "
+                "already committed; requires handshake block replay"
+            )
         msgs = self.wal.replay_after_height(self.rs.height - 1)
         if not msgs:
             return

@@ -104,7 +104,10 @@ class WAL:
 
     def replay_after_height(self, height: int) -> list[object]:
         """Messages recorded after EndHeight(height) — the catchup-replay
-        input (consensus/replay.go:94)."""
+        input (consensus/replay.go:94). Collection stops at any LATER
+        EndHeight sentinel: messages past it belong to an already-committed
+        height and replaying them would re-execute the block against the
+        app (replay.go:99-115 semantics)."""
         out: list[object] = []
         found = height == -1
         for msg in self.iter_records():
@@ -112,6 +115,8 @@ class WAL:
                 if msg.height == height:
                     found = True
                     out = []
+                elif found and msg.height > height:
+                    break
                 continue
             if found:
                 out.append(msg)

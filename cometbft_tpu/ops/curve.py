@@ -163,3 +163,89 @@ def straus_base_and_point(
     one = zero + F.ONE
     init = Point(zero, one, one, zero)
     return jax.lax.fori_loop(0, nbits, body, init)
+
+
+# ---------------------------------------------------------------------------
+# 4-bit windowed double-scalar multiplication: 64 iterations of 4 doublings
+# + 2 table adds, vs the bitwise ladder's 253 x (double + add). The [d]B
+# table is a compile-time constant (B is fixed); the [d]A table is built
+# per batch (7 doubles + 7 adds). ~23% fewer field muls and a 4x shorter
+# loop than straus_base_and_point — shorter dependent chains compile to
+# much better TPU code than the 253-iteration dynamic-index loop.
+# ---------------------------------------------------------------------------
+
+def _base_table_consts() -> tuple[jnp.ndarray, ...]:
+    """[d]B for d in 0..15 as canonical affine-extended limb constants,
+    each coord (16, 20, 1) for broadcast over the lane axis."""
+    import numpy as np
+
+    from cometbft_tpu.ops import limbs as L
+
+    coords = np.zeros((4, 16, L.NLIMBS), dtype=np.int32)
+    pt = oracle.B_POINT
+    acc = (0, 1, 1, 0)
+    for d in range(16):
+        if d:
+            acc = oracle.point_add(acc, pt)
+        zinv = pow(acc[2], oracle.P - 2, oracle.P)
+        x = acc[0] * zinv % oracle.P
+        y = acc[1] * zinv % oracle.P
+        for ci, v in enumerate((x, y, 1, x * y % oracle.P)):
+            coords[ci, d] = L.int_to_limbs(v)
+    return tuple(jnp.asarray(coords[ci])[:, :, None] for ci in range(4))
+
+
+_BASE_TABLE = _base_table_consts()
+
+
+def build_point_table(a: Point) -> tuple[jnp.ndarray, ...]:
+    """{[0]A..[15]A} per lane: each coord stacked (16, 20, B). 7 doubles +
+    7 adds, shared across the whole 64-iteration window loop."""
+    zero = jnp.zeros_like(a.x)
+    one = zero + F.ONE
+    t = [Point(zero, one, one, zero), a]
+    for d in range(2, 16):
+        t.append(double(t[d // 2]) if d % 2 == 0 else add(t[d - 1], a))
+    return tuple(jnp.stack([p[ci] for p in t], axis=0) for ci in range(4))
+
+
+def _select(table: tuple[jnp.ndarray, ...], digit: jnp.ndarray) -> Point:
+    """Branch-free table lookup: 4-level binary where-tree over the 16
+    entries. table coords (16, 20, B|1), digit (B,) in 0..15 -> Point of
+    (20, B). A where-tree beats a gather on TPU: no dynamic indexing, pure
+    vector selects."""
+    coords = list(table)
+    for level in (3, 2, 1, 0):
+        bit = ((digit >> level) & 1)[None, None, :] == 1
+        half = coords[0].shape[0] // 2
+        coords = [jnp.where(bit, c[half:], c[:half]) for c in coords]
+    return Point(*(c[0] for c in coords))
+
+
+def windowed_double_scalar(
+    s_digits: jnp.ndarray, k_digits: jnp.ndarray, a: Point
+) -> Point:
+    """[s]B + [k]A with 4-bit windows. s_digits/k_digits: (64, B) int32
+    little-endian window digits (ops.unpack.words_to_digits4). Scalars are
+    < 2^253 < 16^64. Complete addition formulas make zero digits (identity
+    entries) branch-free no-ops."""
+    table_a = build_point_table(a)
+    bx = jnp.zeros_like(a.x)
+    table_b = tuple(c + bx[None] for c in _BASE_TABLE)  # broadcast to lanes
+
+    # most-significant digit first
+    sd = s_digits[::-1]
+    kd = k_digits[::-1]
+
+    def body(acc: Point, digs):
+        ds, dk = digs
+        acc = double(double(double(double(acc))))
+        acc = add(acc, _select(table_a, dk))
+        acc = add(acc, _select(table_b, ds))
+        return acc, None
+
+    zero = jnp.zeros_like(a.x)
+    one = zero + F.ONE
+    init = Point(zero, one, one, zero)
+    acc, _ = jax.lax.scan(body, init, (sd, kd))
+    return acc

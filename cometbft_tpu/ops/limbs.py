@@ -66,3 +66,17 @@ def scalars_to_bits(scalars: list[int]) -> np.ndarray:
         b"".join(s.to_bytes(32, "little") for s in scalars), dtype=np.uint8
     ).reshape(len(scalars), 32)
     return bytes32_to_bits(raw)[:, :SCALAR_BITS].astype(np.int32)
+
+
+def bytes_to_words(raw: np.ndarray) -> np.ndarray:
+    """(B, 32) uint8 -> (B, 8) uint32 little-endian words — the packed
+    host->device wire layout consumed by ops.unpack on device."""
+    return np.ascontiguousarray(raw).view("<u4").reshape(raw.shape[0], 8)
+
+
+def scalars_to_words(scalars: list[int]) -> np.ndarray:
+    """List of B ints (< 2^256) -> (B, 8) uint32 word array."""
+    raw = np.frombuffer(
+        b"".join(s.to_bytes(32, "little") for s in scalars), dtype=np.uint8
+    ).reshape(len(scalars), 32)
+    return bytes_to_words(raw)

@@ -1,11 +1,11 @@
 """Device-mesh sharding for the verification kernels.
 
-One mesh axis ("sig") over all chips; every kernel input is batch-major so
-sharding is a single PartitionSpec("sig") on dim 0. shard_map runs the
-per-chip program; XLA inserts the (trivial) collectives. This is the ICI
-data plane that replaces nothing in the reference — the Go engine has no
-multi-device compute at all (SURVEY.md §2.3) — and is the path to >1-chip
-commit-verification throughput.
+One mesh axis ("sig") over all chips; every kernel input is staged batch-
+minor so sharding is a single PartitionSpec on the lane axis. shard_map
+runs the per-chip program; XLA inserts the (trivial) collectives. This is
+the ICI data plane that replaces nothing in the reference — the Go engine
+has no multi-device compute at all (SURVEY.md §2.3) — and is the path to
+>1-chip commit-verification throughput.
 """
 
 from __future__ import annotations
@@ -17,9 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from cometbft_tpu.ops import curve
 from cometbft_tpu.ops import ed25519_kernel as K
-from cometbft_tpu.ops import limbs as L
 
 SIG_AXIS = "sig"
 
@@ -31,42 +29,26 @@ def batch_mesh(devices: list | None = None) -> Mesh:
     return Mesh(np.array(devices), axis_names=(SIG_AXIS,))
 
 
-def _per_chip_verify(ax, ay, az, at, ok_a, y_r, sign_r, s_bits, k_bits):
-    """The single-chip verify program, run on each mesh shard. Identical
-    math to ops.ed25519_kernel._verify_kernel."""
-    ok_r, r = curve.decompress_zip215(y_r, sign_r)
-    neg_a = curve.neg(curve.Point(ax, ay, az, at))
-    sb_ka = curve.straus_base_and_point(s_bits, k_bits, neg_a)
-    diff = curve.add(sb_ka, curve.neg(r))
-    valid = curve.is_identity(curve.mul_by_cofactor(diff))
-    return valid & ok_a & ok_r
-
-
 @functools.lru_cache(maxsize=8)
 def shard_verify_kernel(mesh: Mesh):
-    """jit-compiled shard_map of the verify program over `mesh`. Batch dim
-    must divide the mesh size; ed25519_kernel's bucket padding guarantees
-    power-of-two batches."""
-    # batch axis is trailing for limb/bit arrays (limb-axis-first layout),
-    # leading for the per-lane flags
+    """jit-compiled shard_map of the verify program over `mesh`. The lane
+    (batch) axis must divide the mesh size; bucket padding guarantees
+    power-of-two batches. Inputs follow ed25519_kernel.verify_math:
+    4x A-coords (20, B) int32, then r/s/k packed words (8, B) uint32."""
     spec_tail = P(None, SIG_AXIS)
-    spec_flat = P(SIG_AXIS)
-    in_specs = (
-        spec_tail,  # ax (20, B)
-        spec_tail,  # ay
-        spec_tail,  # az
-        spec_tail,  # at
-        spec_flat,  # ok_a (B,)
-        spec_tail,  # y_r (20, B)
-        spec_flat,  # sign_r (B,)
-        spec_tail,  # s_bits (253, B)
-        spec_tail,  # k_bits (253, B)
-    )
-    out_specs = spec_flat
+    in_specs = (spec_tail,) * 7
+    out_specs = P(SIG_AXIS)
     fn = jax.shard_map(
-        _per_chip_verify, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+        K.verify_math, mesh=mesh, in_specs=in_specs, out_specs=out_specs
     )
     return jax.jit(fn)
+
+
+def _mesh_bucket(n: int, n_dev: int) -> int:
+    b = K.bucket_size(n)
+    if b % n_dev:
+        b = ((b + n_dev - 1) // n_dev) * n_dev
+    return b
 
 
 def sharded_verify_batch(
@@ -74,88 +56,34 @@ def sharded_verify_batch(
     msgs: list[bytes],
     sigs: list[bytes],
     mesh: Mesh | None = None,
+    cache: K.PubKeyCache | None = None,
 ) -> tuple[bool, list[bool]]:
     """Multi-chip analog of ops.ed25519_kernel.verify_batch: same host glue
-    (structural checks, SHA-512 challenges, bucket padding), with the device
-    batch sharded over the mesh's 'sig' axis."""
+    (structural checks, SHA-512 challenges, bucket padding — shared via
+    stage_batch), with the device batch sharded over the mesh's 'sig'
+    axis."""
     n = len(sigs)
     if n == 0:
         return True, []
     if mesh is None:
         mesh = batch_mesh()
     n_dev = mesh.devices.size
+    cache = cache or K._default_cache
 
-    import hashlib
+    b = _mesh_bucket(n, n_dev)
+    pre_ok, safe_pubs, r_words, s_words, k_words = K.stage_batch(pubs, msgs, sigs, b)
 
-    from cometbft_tpu.crypto import ed25519_math as oracle
-
-    pre_ok = np.ones(n, dtype=bool)
-    s_vals = [0] * n
-    for i, (pub, sig) in enumerate(zip(pubs, sigs)):
-        if len(pub) != 32 or len(sig) != 64:
-            pre_ok[i] = False
-            continue
-        s = int.from_bytes(sig[32:], "little")
-        if s >= oracle.L:
-            pre_ok[i] = False
-            continue
-        s_vals[i] = s
-
-    safe_pubs = [p if pre_ok[i] else b"\x01" + b"\x00" * 31 for i, p in enumerate(pubs)]
-    safe_rs = [sigs[i][:32] if pre_ok[i] else b"\x01" + b"\x00" * 31 for i in range(n)]
-    ks = []
-    for i, (pub, msg, sig) in enumerate(zip(safe_pubs, msgs, sigs)):
-        if not pre_ok[i]:
-            ks.append(0)
-            continue
-        h = hashlib.sha512()
-        h.update(sig[:32])
-        h.update(pub)
-        h.update(msg)
-        ks.append(int.from_bytes(h.digest(), "little") % oracle.L)
-
-    # bucket to a multiple of the device count (power-of-two covers it when
-    # n_dev is a power of two; otherwise round up explicitly)
-    b = K.bucket_size(n)
-    if b % n_dev:
-        b = ((b + n_dev - 1) // n_dev) * n_dev
-    pad = b - n
-
-    ok_a, a_coords = K._default_cache.lookup_or_decompress(safe_pubs)
-    r_enc = np.frombuffer(b"".join(safe_rs), dtype=np.uint8).reshape(n, 32)
-    y_r, sign_r = L.encodings_to_point_inputs(r_enc)
-    s_bits = L.scalars_to_bits(s_vals)
-    k_bits = L.scalars_to_bits(ks)
-
-    if pad:
-        id_y = np.zeros((pad, L.NLIMBS), dtype=np.int32)
-        id_y[:, 0] = 1
-        id_coords = np.zeros((pad, 4, L.NLIMBS), dtype=np.int32)
-        id_coords[:, 1, 0] = 1
-        id_coords[:, 2, 0] = 1
-        a_coords = np.concatenate([a_coords, id_coords])
-        ok_a = np.concatenate([ok_a, np.ones(pad, dtype=bool)])
-        y_r = np.concatenate([y_r, id_y])
-        sign_r = np.concatenate([sign_r, np.zeros(pad, dtype=np.int32)])
-        zbits = np.zeros((pad, L.SCALAR_BITS), dtype=np.int32)
-        s_bits = np.concatenate([s_bits, zbits])
-        k_bits = np.concatenate([k_bits, zbits])
-
-    fn = shard_verify_kernel(mesh)
     tail = NamedSharding(mesh, P(None, SIG_AXIS))
-    flat = NamedSharding(mesh, P(SIG_AXIS))
-    host_args = (
-        (np.ascontiguousarray(a_coords[:, 0].T), tail),
-        (np.ascontiguousarray(a_coords[:, 1].T), tail),
-        (np.ascontiguousarray(a_coords[:, 2].T), tail),
-        (np.ascontiguousarray(a_coords[:, 3].T), tail),
-        (ok_a, flat),
-        (np.ascontiguousarray(y_r.T), tail),
-        (sign_r, flat),
-        (np.ascontiguousarray(s_bits.T), tail),
-        (np.ascontiguousarray(k_bits.T), tail),
+    put = functools.partial(jax.device_put, device=tail)
+    # stable cache key: device ids, not id(mesh) (addresses get reused)
+    mesh_key = "mesh-" + ",".join(str(d.id) for d in mesh.devices.flat)
+    ok_a, a_dev = cache.stage(safe_pubs, b, put=put, put_key=mesh_key)
+    fn = shard_verify_kernel(mesh)
+    mask_dev = fn(
+        *a_dev,
+        jax.device_put(r_words, tail),
+        jax.device_put(s_words, tail),
+        jax.device_put(k_words, tail),
     )
-    args = [jax.device_put(jnp.asarray(a), sh) for a, sh in host_args]
-    mask_dev = fn(*args)
-    mask = np.asarray(mask_dev)[:n] & pre_ok
+    mask = np.asarray(mask_dev)[:n] & pre_ok & ok_a
     return bool(mask.all()), mask.tolist()

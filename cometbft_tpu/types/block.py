@@ -248,15 +248,17 @@ class Block:
             self.header.evidence_hash = self.evidence.hash()
 
     def validate_basic(self) -> None:
-        """block.go ValidateBasic."""
+        """block.go ValidateBasic. LastCommit is required unconditionally —
+        first-height blocks carry an EMPTY (zero-signature) commit, never a
+        nil one (the reference likewise rejects nil at any height, and a
+        height-1 special case would also be wrong for chains whose
+        initial_height > 1)."""
         self.header.validate_basic()
         if self.last_commit is None:
-            if self.header.height != 1:
-                raise ValueError("nil LastCommit")
-        else:
-            self.last_commit.validate_basic()
-            if self.header.last_commit_hash != self.last_commit.hash():
-                raise ValueError("wrong LastCommitHash")
+            raise ValueError("nil LastCommit")
+        self.last_commit.validate_basic()
+        if self.header.last_commit_hash != self.last_commit.hash():
+            raise ValueError("wrong LastCommitHash")
         if self.header.data_hash != self.data.hash():
             raise ValueError("wrong DataHash")
         if self.header.evidence_hash != self.evidence.hash():

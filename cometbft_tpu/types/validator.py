@@ -260,12 +260,16 @@ class ValidatorSet:
                 raise ValueError(f"failed to find validator {addr.hex()} to remove")
 
         by_addr = {v.address: v for v in self.validators}
-        # compute the post-update total for the new-validator priority
+        # Total voting power after updates but BEFORE removals — the base
+        # for both the cap check and new-validator priorities
+        # (validator_set.go:490,618-624 tvpAfterUpdatesBeforeRemovals;
+        # excluding removals here would permanently diverge proposer
+        # rotation from the reference for mixed add+remove change sets).
+        upd_by_addr = {u.address: u for u in updates}
         new_total = 0
         for v in self.validators:
-            if v.address not in removals:
-                upd = next((u for u in updates if u.address == v.address), None)
-                new_total += upd.voting_power if upd else v.voting_power
+            upd = upd_by_addr.get(v.address)
+            new_total += upd.voting_power if upd else v.voting_power
         for u in updates:
             if u.address not in by_addr:
                 new_total += u.voting_power

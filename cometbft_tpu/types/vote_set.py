@@ -30,6 +30,12 @@ from cometbft_tpu.types.validator import ValidatorSet
 from cometbft_tpu.types.vote import Vote
 
 
+# flush_pending per-vote statuses
+FLUSH_ADDED = "added"        # signature verified, vote tallied
+FLUSH_INVALID = "invalid"    # bad signature or extension signature
+FLUSH_CONFLICT = "conflict"  # valid signature, rejected as equivocation
+
+
 class ErrVoteConflictingVotes(Exception):
     """Equivocation detected — carries both votes (evidence material)."""
 
@@ -176,11 +182,15 @@ class VoteSet:
         quorum = self.val_set.total_voting_power() * 2 // 3 + 1
         return self.sum < quorum <= self.sum + self._speculative_sum
 
-    def flush_pending(self) -> list[tuple[Vote, bool]]:
+    def flush_pending(self) -> list[tuple[Vote, str]]:
         """Verify all staged votes in ONE device batch; fold the valid ones
-        into the verified tally. Returns [(vote, valid)]. Conflicting votes
-        surface as ErrVoteConflictingVotes AFTER the tally is updated with
-        everything non-conflicting (matching serial-path ordering)."""
+        into the verified tally. Returns [(vote, status)] with status one
+        of FLUSH_ADDED (verified + tallied), FLUSH_INVALID (bad
+        signature/extension), FLUSH_CONFLICT (signature valid but rejected
+        as an equivocation — distinct so callers can turn it into
+        DuplicateVoteEvidence). Conflicting votes ALSO surface as
+        ErrVoteConflictingVotes AFTER the tally is updated with everything
+        non-conflicting (matching serial-path ordering)."""
         if not self._pending:
             return []
         pending, self._pending = self._pending, []
@@ -188,7 +198,7 @@ class VoteSet:
         self._speculative_sum = 0
 
         proposer = self.val_set.get_proposer()
-        results: list[tuple[Vote, bool]] = []
+        results: list[tuple[Vote, str]] = []
         batchable = len(pending) >= 2 and crypto_batch.supports_batch_verifier(
             proposer.pub_key if proposer else None
         )
@@ -226,20 +236,30 @@ class VoteSet:
                         ext_bad.add(i)
 
         conflict: ErrVoteConflictingVotes | None = None
+        conflicts: list[ErrVoteConflictingVotes] = []
         for i, (vote, power) in enumerate(pending):
-            ok = bool(mask[i]) and i not in ext_bad
-            if ok:
-                existing = self._get_vote(vote.validator_index, vote.block_id.key())
-                if existing is not None and existing.signature == vote.signature:
-                    # landed via the serial path while staged: already tallied
-                    results.append((vote, True))
-                    continue
-                try:
-                    self._add_verified_vote(vote, power)
-                except ErrVoteConflictingVotes as e:
-                    conflict = conflict or e
-            results.append((vote, ok))
+            if not (bool(mask[i]) and i not in ext_bad):
+                results.append((vote, FLUSH_INVALID))
+                continue
+            existing = self._get_vote(vote.validator_index, vote.block_id.key())
+            if existing is not None and existing.signature == vote.signature:
+                # landed via the serial path while staged: already tallied
+                results.append((vote, FLUSH_ADDED))
+                continue
+            try:
+                self._add_verified_vote(vote, power)
+                results.append((vote, FLUSH_ADDED))
+            except ErrVoteConflictingVotes as e:
+                conflict = conflict or e
+                conflicts.append(e)
+                results.append((vote, FLUSH_CONFLICT))
         if conflict is not None:
+            # The raise preserves serial-path parity; the full per-vote
+            # outcome survives on the exception so callers can build
+            # DuplicateVoteEvidence for EVERY equivocation in the flush,
+            # not just the first pair.
+            conflict.results = results
+            conflict.conflicts = conflicts
             raise conflict
         return results
 

@@ -1,0 +1,88 @@
+"""Multi-chip mesh-path tests on the 8-device virtual CPU mesh.
+
+Covers VERDICT r1 item 2: sharded_verify_batch had zero test coverage and
+the driver dryrun was red. Exercises the shard_map program with valid
+batches, bad-signature masks, non-divisible batch sizes (bucket padding
+across shards), and structural rejects."""
+
+import secrets
+
+import jax
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import ed25519_math as oracle
+from cometbft_tpu.ops import ed25519_kernel as K
+from cometbft_tpu.parallel import batch_mesh, sharded_verify_batch
+from cometbft_tpu.parallel.mesh import _mesh_bucket
+
+
+@pytest.fixture(scope="module")
+def mesh(jax_cpu_devices):
+    return batch_mesh(jax_cpu_devices[:8])
+
+
+def _sign_n(n):
+    out = []
+    for i in range(n):
+        seed = secrets.token_bytes(32)
+        pub = oracle.public_key_from_seed(seed)
+        msg = b"mesh-vote-" + i.to_bytes(4, "big")
+        out.append((pub, msg, oracle.sign(seed, msg)))
+    return out
+
+
+def test_all_valid_divisible(mesh):
+    pubs, msgs, sigs = map(list, zip(*_sign_n(16)))
+    ok, mask = sharded_verify_batch(pubs, msgs, sigs, mesh=mesh)
+    assert ok and mask == [True] * 16
+
+
+def test_bad_signatures_pinpointed_across_shards(mesh):
+    n = 24
+    pubs, msgs, sigs = map(list, zip(*_sign_n(n)))
+    # corrupt lanes landing on different shards
+    bad = [1, 9, 23]
+    for i in bad:
+        sigs[i] = sigs[i][:32] + sigs[(i + 1) % n][32:]
+    ok, mask = sharded_verify_batch(pubs, msgs, sigs, mesh=mesh)
+    assert not ok
+    want = [i not in bad for i in range(n)]
+    assert mask == want
+
+
+def test_non_divisible_batch_pads_to_mesh(mesh):
+    n = 11  # bucket 16, 2 lanes/shard
+    pubs, msgs, sigs = map(list, zip(*_sign_n(n)))
+    ok, mask = sharded_verify_batch(pubs, msgs, sigs, mesh=mesh)
+    assert ok and mask == [True] * n
+    assert _mesh_bucket(n, 8) % 8 == 0
+
+
+def test_structural_rejects_never_reach_device(mesh):
+    pubs, msgs, sigs = map(list, zip(*_sign_n(9)))
+    sigs[0] = sigs[0][:32] + (oracle.L).to_bytes(32, "little")  # s >= L
+    pubs[3] = b"\x00" * 31  # bad length
+    ok, mask = sharded_verify_batch(pubs, msgs, sigs, mesh=mesh)
+    assert not ok
+    want = [True] * 9
+    want[0] = want[3] = False
+    assert mask == want
+
+
+def test_matches_single_chip_path(mesh):
+    pubs, msgs, sigs = map(list, zip(*_sign_n(10)))
+    msgs[4] = msgs[4] + b"!"
+    ok_m, mask_m = sharded_verify_batch(pubs, msgs, sigs, mesh=mesh)
+    ok_s, mask_s = K.verify_batch(pubs, msgs, sigs)
+    assert (ok_m, mask_m) == (ok_s, mask_s)
+
+
+def test_mesh_device_cache_reuse(mesh):
+    cache = K.PubKeyCache()
+    pubs, msgs, sigs = map(list, zip(*_sign_n(8)))
+    ok, _ = sharded_verify_batch(pubs, msgs, sigs, mesh=mesh, cache=cache)
+    assert ok
+    assert len(cache._dev) == 1
+    ok2, _ = sharded_verify_batch(pubs, msgs, sigs, mesh=mesh, cache=cache)
+    assert ok2 and len(cache._dev) == 1  # full-batch device hit, no refill

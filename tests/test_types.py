@@ -27,6 +27,7 @@ from cometbft_tpu.types import (
     verify_commit_light_trusting,
 )
 from cometbft_tpu.types import validation as tv
+from cometbft_tpu.types import vote_set as VS
 from cometbft_tpu.types.part_set import PartSet
 from cometbft_tpu.utils import cmttime
 
@@ -198,7 +199,7 @@ class TestVoteSetAndCommit:
         vote_set.add_pending(good)
         vote_set.add_pending(bad)
         results = vote_set.flush_pending()
-        assert [ok for _, ok in results] == [True, False]
+        assert [st for _, st in results] == [VS.FLUSH_ADDED, VS.FLUSH_INVALID]
         assert vote_set.sum == 10  # only the good vote tallied
 
     def test_conflicting_votes_detected(self):
