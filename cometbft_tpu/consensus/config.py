@@ -28,6 +28,9 @@ class ConsensusConfig:
     # batch-first vote verification: stage gossip votes into device batches
     # (VoteSet.add_pending/flush) instead of serial per-vote verification
     batch_vote_verification: bool = False
+    # flush a staged batch once it reaches this many votes (flushes also
+    # happen at speculative quorum boundaries and on timeouts)
+    vote_batch_flush_size: int = 128
 
     def propose_timeout(self, round_: int) -> float:
         return self.timeout_propose + self.timeout_propose_delta * round_

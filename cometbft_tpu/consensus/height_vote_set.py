@@ -19,11 +19,12 @@ class ErrGotVoteFromUnwantedRound(Exception):
 
 class HeightVoteSet:
     def __init__(self, chain_id: str, height: int, val_set: ValidatorSet,
-                 extensions_enabled: bool = False):
+                 extensions_enabled: bool = False, batch_flush_size: int = 128):
         self.chain_id = chain_id
         self.height = height
         self.val_set = val_set
         self.extensions_enabled = extensions_enabled
+        self.batch_flush_size = batch_flush_size
         self.round_ = 0
         self._sets: dict[int, dict[str, VoteSet]] = {}
         self._peer_catchup_rounds: dict[str, list[int]] = {}
@@ -32,12 +33,18 @@ class HeightVoteSet:
     def _add_round(self, round_: int) -> None:
         if round_ in self._sets:
             return
+        # auto_flush off: ConsensusState drives flushes so it can observe
+        # the per-vote results (events, threshold hooks, evidence)
         self._sets[round_] = {
             "prevote": VoteSet(self.chain_id, self.height, round_,
-                               SignedMsgType.PREVOTE, self.val_set),
+                               SignedMsgType.PREVOTE, self.val_set,
+                               batch_flush_size=self.batch_flush_size,
+                               auto_flush=False),
             "precommit": VoteSet(self.chain_id, self.height, round_,
                                  SignedMsgType.PRECOMMIT, self.val_set,
-                                 extensions_enabled=self.extensions_enabled),
+                                 extensions_enabled=self.extensions_enabled,
+                                 batch_flush_size=self.batch_flush_size,
+                                 auto_flush=False),
         }
 
     def set_round(self, round_: int) -> None:
@@ -57,6 +64,27 @@ class HeightVoteSet:
         self._add_round(vote.round_)
         vs = self._get(vote.round_, vote.type_)
         return vs.add_vote(vote)
+
+    def add_pending(self, vote: Vote, peer_id: str = "") -> bool:
+        """Batch-path analog of add_vote: same round gating, then stage the
+        vote in the round's VoteSet for deferred device verification (the
+        SURVEY §3.3 hot path)."""
+        if not self._is_wanted(vote.round_, peer_id):
+            raise ErrGotVoteFromUnwantedRound(
+                f"peer {peer_id} has sent a vote for round {vote.round_} != current {self.round_}"
+            )
+        self._add_round(vote.round_)
+        vs = self._get(vote.round_, vote.type_)
+        return vs.add_pending(vote)
+
+    def pending_sets(self) -> list[VoteSet]:
+        """All VoteSets with staged (unflushed) votes, every round/type."""
+        out = []
+        for sets in self._sets.values():
+            for vs in sets.values():
+                if vs._pending:
+                    out.append(vs)
+        return out
 
     def _is_wanted(self, round_: int, peer_id: str) -> bool:
         if self.round_ <= round_ <= self.round_ + 1:

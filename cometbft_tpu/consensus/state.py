@@ -94,6 +94,12 @@ class ConsensusState(BaseService):
         self._wait_sync = False
         self.n_steps = 0  # transition counter (test instrumentation)
 
+        # Outbound tap: called with every self-produced gossipable message
+        # (ProposalMessage / BlockPartMessage / VoteMessage). The consensus
+        # reactor (and the in-process test net) subscribes here — the state
+        # machine itself never touches sockets (SURVEY §1).
+        self.outbound_hook: Optional[Callable] = None
+
         # injectable decision hooks (reference: state.go:122-124, the seam
         # that makes byzantine tests possible)
         self.decide_proposal: Callable = self._default_decide_proposal
@@ -146,6 +152,7 @@ class ConsensusState(BaseService):
             votes=HeightVoteSet(
                 state.chain_id, height, validators,
                 extensions_enabled=state.consensus_params.abci.vote_extensions_enabled(height),
+                batch_flush_size=self.config.vote_batch_flush_size,
             ),
             last_commit=last_precommits,
             last_validators=state.last_validators.copy() if state.last_validators else None,
@@ -178,6 +185,14 @@ class ConsensusState(BaseService):
         )
 
     # --------------------------------------------------------- receive loop
+
+    def _gossip(self, msg) -> None:
+        if self.outbound_hook is None:
+            return
+        try:
+            self.outbound_hook(msg)
+        except Exception as e:  # noqa: BLE001 - gossip must not kill consensus
+            self.logger.error("outbound hook failed", err=str(e))
 
     async def _receive_routine(self) -> None:
         """state.go:774-862: the single serialization point."""
@@ -215,6 +230,8 @@ class ConsensusState(BaseService):
 
     async def _handle_timeout(self, ti: TimeoutInfo) -> None:
         """state.go:930-980."""
+        if self.config.batch_vote_verification:
+            await self._flush_all_pending_votes()
         rs = self.rs
         if ti.height != rs.height or ti.round_ < rs.round_ or (
             ti.round_ == rs.round_ and ti.step < rs.step
@@ -319,10 +336,11 @@ class ConsensusState(BaseService):
             self.logger.error("propose step; failed signing proposal", err=str(e))
             return
         await self.msg_queue.put((False, M.ProposalMessage(proposal=proposal)))
+        self._gossip(M.ProposalMessage(proposal=proposal))
         for i in range(block_parts.total):
-            await self.msg_queue.put(
-                (False, M.BlockPartMessage(height=rs.height, round_=rs.round_, part=block_parts.get_part(i)))
-            )
+            part_msg = M.BlockPartMessage(height=rs.height, round_=rs.round_, part=block_parts.get_part(i))
+            await self.msg_queue.put((False, part_msg))
+            self._gossip(part_msg)
         self.logger.info("signed proposal", height=height, round=round_, proposal=str(proposal.block_id))
 
     async def _create_proposal_block(self) -> Block | None:
@@ -625,6 +643,7 @@ class ConsensusState(BaseService):
             self.logger.error("failed signing vote", err=str(e))
             return None
         await self.msg_queue.put((False, M.VoteMessage(vote=vote)))
+        self._gossip(M.VoteMessage(vote=vote))
         return vote
 
     async def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
@@ -637,6 +656,17 @@ class ConsensusState(BaseService):
             ):
                 self.logger.error("found conflicting vote from ourselves; did you unsafe_reset a validator?")
                 raise
+            self._conflicts_to_evidence(getattr(e, "conflicts", None) or [e])
+            return False
+        except Exception as e:  # noqa: BLE001 - bad votes are logged, not fatal
+            self.logger.info("failed attempting to add vote", err=str(e))
+            return False
+
+    def _conflicts_to_evidence(self, conflicts) -> None:
+        """Equivocations -> DuplicateVoteEvidence into the pool
+        (state.go:2117-2146). Takes a list so one batched flush can report
+        every conflicting pair it found."""
+        for e in conflicts:
             if self.block_exec.evidence_pool is not None:
                 from cometbft_tpu.types.evidence import DuplicateVoteEvidence
 
@@ -644,11 +674,10 @@ class ConsensusState(BaseService):
                     e.vote_a, e.vote_b, self.state.last_block_time, self.rs.validators
                 )
                 self.block_exec.evidence_pool.add_evidence(ev)
-            self.logger.info("found and sent conflicting vote to evidence pool", vote=str(vote))
-            return False
-        except Exception as e:  # noqa: BLE001 - bad votes are logged, not fatal
-            self.logger.info("failed attempting to add vote", err=str(e))
-            return False
+            self.logger.info(
+                "found and sent conflicting vote to evidence pool",
+                vote=str(e.vote_b),
+            )
 
     async def _add_vote(self, vote: Vote, peer_id: str) -> bool:
         """state.go:2161-2450."""
@@ -664,6 +693,9 @@ class ConsensusState(BaseService):
         if vote.height != rs.height:
             return False
 
+        if self.config.batch_vote_verification and peer_id:
+            return await self._add_vote_batched(vote, peer_id)
+
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
             return False
@@ -671,64 +703,140 @@ class ConsensusState(BaseService):
             self.event_switch.fire("Vote", vote)
 
         if vote.type_ == SignedMsgType.PREVOTE:
-            await self._on_prevote_added(vote)
+            await self._on_prevote_added(vote.round_)
         else:
-            await self._on_precommit_added(vote)
+            await self._on_precommit_added(vote.round_)
+        if self.config.batch_vote_verification:
+            # a serially-added vote (our own) can be the one that pushes the
+            # speculative tally over quorum: recheck the staged batch or
+            # peer votes staged earlier would never flush (liveness)
+            vs = (
+                rs.votes.prevotes(vote.round_)
+                if vote.type_ == SignedMsgType.PREVOTE
+                else rs.votes.precommits(vote.round_)
+            )
+            if vs is not None and vs.should_flush():
+                await self._flush_vote_set(vs)
         return True
 
-    async def _on_prevote_added(self, vote: Vote) -> None:
-        """state.go:2270-2366."""
+    # ---------------------------------------------------- batched vote path
+
+    async def _add_vote_batched(self, vote: Vote, peer_id: str) -> bool:
+        """THE hot path, batch-first (SURVEY §3.3): gossip votes are staged
+        with cheap structural checks; signatures verify in coalesced device
+        batches. Pending votes are invisible to every threshold read (the
+        tally only counts verified votes), so 'never count an unverified
+        vote' holds by construction; the speculative quorum boundary inside
+        should_flush guarantees a staged majority is flushed immediately."""
         rs = self.rs
-        prevotes = rs.votes.prevotes(vote.round_)
+        staged = rs.votes.add_pending(vote, peer_id)
+        if not staged:
+            return False
+        vs = (
+            rs.votes.prevotes(vote.round_)
+            if vote.type_ == SignedMsgType.PREVOTE
+            else rs.votes.precommits(vote.round_)
+        )
+        if vs is not None and vs.should_flush():
+            await self._flush_vote_set(vs)
+        return True
+
+    async def _flush_vote_set(self, vs: VoteSet) -> None:
+        """One device batch for a VoteSet's staged votes; then events +
+        threshold hooks for what got added, evidence for equivocations."""
+        try:
+            results = vs.flush_pending()
+        except ErrVoteConflictingVotes as e:
+            results = getattr(e, "results", [])
+            own_addr = (
+                self.priv_validator_pub_key.address()
+                if self.priv_validator_pub_key
+                else b""
+            )
+            conflicts = getattr(e, "conflicts", None) or [e]
+            if any(c.vote_b.validator_address == own_addr for c in conflicts):
+                self.logger.error("found conflicting vote from ourselves; did you unsafe_reset a validator?")
+                raise
+            self._conflicts_to_evidence(conflicts)
+        added_any = False
+        from cometbft_tpu.types import vote_set as VS
+
+        for v, status in results:
+            if status == VS.FLUSH_ADDED:
+                added_any = True
+                if self.event_switch is not None:
+                    self.event_switch.fire("Vote", v)
+        if added_any:
+            if vs.signed_msg_type == SignedMsgType.PREVOTE:
+                await self._on_prevote_added(vs.round_)
+            else:
+                await self._on_precommit_added(vs.round_)
+
+    async def _flush_all_pending_votes(self) -> None:
+        """Flush every staged vote batch for the current height — called
+        before timeout-driven threshold decisions so liveness never waits
+        on an unflushed batch."""
+        if self.rs.votes is None:
+            return
+        for vs in self.rs.votes.pending_sets():
+            await self._flush_vote_set(vs)
+
+    async def _on_prevote_added(self, round_: int) -> None:
+        """state.go:2270-2366 (parameterized by round: the batched path
+        folds many votes of one round at once)."""
+        rs = self.rs
+        vote_round = round_
+        prevotes = rs.votes.prevotes(vote_round)
         block_id, has_maj = prevotes.two_thirds_majority()
         if has_maj:
             # unlock on POL for a different block (state.go:2290-2305)
             if (
                 rs.locked_block is not None
-                and rs.locked_round < vote.round_ <= rs.round_
+                and rs.locked_round < vote_round <= rs.round_
                 and rs.locked_block.hash() != block_id.hash
             ):
                 rs.locked_round = -1
                 rs.locked_block = None
                 rs.locked_block_parts = None
             # update valid block (state.go:2307-2330)
-            if not block_id.is_nil() and rs.valid_round < vote.round_ <= rs.round_:
+            if not block_id.is_nil() and rs.valid_round < vote_round <= rs.round_:
                 if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
-                    rs.valid_round = vote.round_
+                    rs.valid_round = vote_round
                     rs.valid_block = rs.proposal_block
                     rs.valid_block_parts = rs.proposal_block_parts
                 else:
                     rs.proposal_block = None
                     rs.proposal_block_parts = PartSet.from_header(block_id.part_set_header)
 
-        if rs.round_ < vote.round_ and prevotes.has_two_thirds_any():
-            await self._enter_new_round(rs.height, vote.round_)
-        elif rs.round_ == vote.round_ and rs.step >= RoundStepType.PREVOTE:
+        if rs.round_ < vote_round and prevotes.has_two_thirds_any():
+            await self._enter_new_round(rs.height, vote_round)
+        elif rs.round_ == vote_round and rs.step >= RoundStepType.PREVOTE:
             if has_maj and (self._is_proposal_complete() or block_id.is_nil()):
-                await self._enter_precommit(rs.height, vote.round_)
+                await self._enter_precommit(rs.height, vote_round)
             elif prevotes.has_two_thirds_any():
-                await self._enter_prevote_wait(rs.height, vote.round_)
-        elif rs.proposal is not None and 0 <= rs.proposal.pol_round == vote.round_:
+                await self._enter_prevote_wait(rs.height, vote_round)
+        elif rs.proposal is not None and 0 <= rs.proposal.pol_round == vote_round:
             if self._is_proposal_complete():
                 await self._enter_prevote(rs.height, rs.round_)
 
-    async def _on_precommit_added(self, vote: Vote) -> None:
-        """state.go:2368-2416."""
+    async def _on_precommit_added(self, round_: int) -> None:
+        """state.go:2368-2416 (parameterized by round)."""
         rs = self.rs
-        precommits = rs.votes.precommits(vote.round_)
+        vote_round = round_
+        precommits = rs.votes.precommits(vote_round)
         block_id, has_maj = precommits.two_thirds_majority()
         if has_maj:
-            await self._enter_new_round(rs.height, vote.round_)
-            await self._enter_precommit(rs.height, vote.round_)
+            await self._enter_new_round(rs.height, vote_round)
+            await self._enter_precommit(rs.height, vote_round)
             if not block_id.is_nil():
-                await self._enter_commit(rs.height, vote.round_)
+                await self._enter_commit(rs.height, vote_round)
                 if self.config.skip_timeout_commit and precommits.has_all():
                     await self._enter_new_round(rs.height, 0)
             else:
-                await self._enter_precommit_wait(rs.height, vote.round_)
-        elif rs.round_ <= vote.round_ and precommits.has_two_thirds_any():
-            await self._enter_new_round(rs.height, vote.round_)
-            await self._enter_precommit_wait(rs.height, vote.round_)
+                await self._enter_precommit_wait(rs.height, vote_round)
+        elif rs.round_ <= vote_round and precommits.has_two_thirds_any():
+            await self._enter_new_round(rs.height, vote_round)
+            await self._enter_precommit_wait(rs.height, vote_round)
 
     # -------------------------------------------------------------- replay
 

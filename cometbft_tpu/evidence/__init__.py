@@ -1,0 +1,10 @@
+"""Evidence subsystem: detection -> pool -> block inclusion -> ABCI report.
+
+Reference: evidence/ (pool.go, verify.go, reactor.go). The pool stores
+verified-but-uncommitted Byzantine proofs, offers them to proposers,
+validates evidence in peers' proposed blocks, and expires what has aged
+out. The gossip reactor lives in cometbft_tpu/reactors/.
+"""
+
+from cometbft_tpu.evidence.pool import EvidencePool  # noqa: F401
+from cometbft_tpu.evidence.verify import verify_evidence, verify_duplicate_vote  # noqa: F401

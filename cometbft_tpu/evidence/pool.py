@@ -1,0 +1,149 @@
+"""EvidencePool (reference: evidence/pool.go).
+
+Verified-but-uncommitted Byzantine proofs, persisted under two keyspaces
+(pending / committed-marker) exactly like the reference's prefix scheme
+(pool.go:45-50). The pool:
+
+  add_evidence     — dedupe + verify + persist + offer to gossip
+  pending_evidence — proposer's pull, size-capped (pool.go:100-130)
+  check_evidence   — validates evidence in a peer's proposed block
+  update           — post-commit: mark committed, prune expired
+
+The reference guards the pool with mutexes; here every call happens on the
+consensus asyncio task (or blocksync's), so plain dicts suffice — same
+single-writer discipline as the rest of the engine.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+from cometbft_tpu.evidence.verify import ErrInvalidEvidence, verify_evidence
+from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.state.state import State
+from cometbft_tpu.state.store import StateStore
+from cometbft_tpu.store.db import KVStore, MemDB
+from cometbft_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    Evidence,
+    evidence_list_from_proto,
+    evidence_list_to_proto,
+)
+
+_PENDING = b"\x00"
+_COMMITTED = b"\x01"
+
+
+def _key(prefix: bytes, ev: Evidence) -> bytes:
+    return prefix + ev.height().to_bytes(8, "big") + ev.hash()
+
+
+class EvidencePool:
+    def __init__(
+        self,
+        db: KVStore | None,
+        state_store: StateStore,
+        logger: cmtlog.Logger | None = None,
+    ):
+        self.db = db if db is not None else MemDB()
+        self.state_store = state_store
+        self.logger = logger or cmtlog.nop()
+        self._pending: dict[bytes, Evidence] = {}
+        self._committed: set[bytes] = set()
+        self._state: State | None = state_store.load()
+        # broadcast hook: the evidence reactor subscribes (reactor.go:32)
+        self.on_evidence_added: Callable[[Evidence], None] | None = None
+        self._load()
+
+    # -------------------------------------------------------------- intake
+
+    def add_evidence(self, ev: Evidence) -> bool:
+        """pool.go:136-192 AddEvidence: idempotent; verifies before
+        accepting. Returns True if newly added."""
+        h = ev.hash()
+        if h in self._committed or h in self._pending:
+            return False
+        state = self._state or self.state_store.load()
+        if state is None:
+            raise ErrInvalidEvidence("evidence pool has no state")
+        verify_evidence(ev, state, self._validators_at)
+        self._pending[h] = ev
+        self.db.set(_key(_PENDING, ev), ev.bytes_())
+        self.logger.info("verified new evidence of byzantine behavior", evidence=ev.string())
+        if self.on_evidence_added is not None:
+            self.on_evidence_added(ev)
+        return True
+
+    def check_evidence(self, evs: Iterable[Evidence]) -> None:
+        """pool.go:194-235 CheckEvidence: every piece in a proposed block
+        must be valid and not already committed; duplicates within the
+        list are rejected."""
+        seen: set[bytes] = set()
+        for ev in evs:
+            h = ev.hash()
+            if h in seen:
+                raise ErrInvalidEvidence(f"duplicate evidence {h.hex()} in block")
+            seen.add(h)
+            if h in self._committed:
+                raise ErrInvalidEvidence(f"evidence {h.hex()} was already committed")
+            if h not in self._pending:
+                state = self._state or self.state_store.load()
+                verify_evidence(ev, state, self._validators_at)
+
+    # ------------------------------------------------------------- outflow
+
+    def pending_evidence(self, max_bytes: int) -> tuple[list[Evidence], int]:
+        """pool.go:100-130 PendingEvidence: oldest-first under a byte cap."""
+        out: list[Evidence] = []
+        size = 0
+        for ev in sorted(self._pending.values(), key=lambda e: (e.height(), e.hash())):
+            ev_size = len(ev.bytes_()) + 16  # proto wrapper overhead
+            if max_bytes >= 0 and size + ev_size > max_bytes:
+                break
+            out.append(ev)
+            size += ev_size
+        return out, size
+
+    def update(self, state: State, committed: list[Evidence]) -> None:
+        """pool.go:80-98: called after every ApplyBlock with the evidence
+        the block carried. Marks committed + prunes expired pending."""
+        self._state = state
+        for ev in committed:
+            h = ev.hash()
+            self._committed.add(h)
+            self.db.set(_key(_COMMITTED, ev), b"\x01")
+            if h in self._pending:
+                del self._pending[h]
+                self.db.delete(_key(_PENDING, ev))
+        self._prune_expired(state)
+
+    # ------------------------------------------------------------ internals
+
+    def _prune_expired(self, state: State) -> None:
+        params = state.consensus_params.evidence
+        height = state.last_block_height
+        now_ns = state.last_block_time.unix_ns()
+        for h, ev in list(self._pending.items()):
+            if (
+                height - ev.height() > params.max_age_num_blocks
+                and now_ns - ev.time().unix_ns() > params.max_age_duration_ns
+            ):
+                del self._pending[h]
+                self.db.delete(_key(_PENDING, ev))
+
+    def _validators_at(self, height: int):
+        return self.state_store.load_validators(height)
+
+    def _load(self) -> None:
+        """Recover pending/committed sets from the DB on boot."""
+        for k, v in self.db.iterate(_PENDING, _PENDING + b"\xff" * 40):
+            if not k.startswith(_PENDING):
+                continue
+            ev = DuplicateVoteEvidence.from_proto(v)
+            self._pending[ev.hash()] = ev
+        for k, _ in self.db.iterate(_COMMITTED, _COMMITTED + b"\xff" * 40):
+            if k.startswith(_COMMITTED):
+                self._committed.add(k[-32:])
+
+    def size(self) -> int:
+        return len(self._pending)

@@ -1,0 +1,103 @@
+"""Evidence verification (reference: evidence/verify.go).
+
+verify_evidence: age/expiry checks against consensus params + dispatch by
+type (verify.go:19-108). verify_duplicate_vote: the equivocation proof
+check (verify.go:166-232) — both votes must be valid signatures from the
+same validator over the same height/round/type but different block IDs.
+Signature checks ride the batch verifier (two sigs per evidence coalesce
+with anything else in flight on the device).
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.state.state import State
+from cometbft_tpu.types.evidence import DuplicateVoteEvidence, Evidence, LightClientAttackEvidence
+from cometbft_tpu.types.validator import ValidatorSet
+
+
+class ErrInvalidEvidence(Exception):
+    pass
+
+
+def verify_evidence(ev: Evidence, state: State, get_validators) -> None:
+    """verify.go:19-108 minus the light-client branch plumbing:
+    - the evidence must not be expired (height AND time window)
+    - the evidence height's validator set must contain the culprit(s)
+    get_validators(height) -> ValidatorSet | None (historical lookup)."""
+    ev_params = state.consensus_params.evidence
+    height = state.last_block_height
+    age_num_blocks = height - ev.height()
+    age_ns = state.last_block_time.unix_ns() - ev.time().unix_ns()
+    if (
+        age_num_blocks > ev_params.max_age_num_blocks
+        and age_ns > ev_params.max_age_duration_ns
+    ):
+        raise ErrInvalidEvidence(
+            f"evidence from height {ev.height()} is too old; "
+            f"min height is {height - ev_params.max_age_num_blocks}"
+        )
+    val_set = get_validators(ev.height())
+    if val_set is None:
+        raise ErrInvalidEvidence(f"no validator set at evidence height {ev.height()}")
+
+    if isinstance(ev, DuplicateVoteEvidence):
+        verify_duplicate_vote(ev, state.chain_id, val_set)
+    elif isinstance(ev, LightClientAttackEvidence):
+        _verify_light_client_attack(ev, state, val_set)
+    else:
+        raise ErrInvalidEvidence(f"unknown evidence type {type(ev).__name__}")
+
+
+def verify_duplicate_vote(
+    ev: DuplicateVoteEvidence, chain_id: str, val_set: ValidatorSet
+) -> None:
+    """verify.go:166-232."""
+    a, b = ev.vote_a, ev.vote_b
+    if a.height != b.height or a.round_ != b.round_ or a.type_ != b.type_:
+        raise ErrInvalidEvidence(
+            f"h/r/s mismatch: {a.height}/{a.round_}/{a.type_} vs {b.height}/{b.round_}/{b.type_}"
+        )
+    if a.block_id.key() == b.block_id.key():
+        raise ErrInvalidEvidence("block IDs are the same; not an equivocation")
+    if a.validator_address != b.validator_address:
+        raise ErrInvalidEvidence(
+            f"validator addresses differ: {a.validator_address.hex()} vs {b.validator_address.hex()}"
+        )
+    if a.validator_index != b.validator_index:
+        raise ErrInvalidEvidence("validator indices differ")
+    _, val = val_set.get_by_address(a.validator_address)
+    if val is None:
+        raise ErrInvalidEvidence(
+            f"address {a.validator_address.hex()} was not a validator at height {a.height}"
+        )
+    # powers recorded in the evidence must match the historical set
+    if ev.validator_power != val.voting_power:
+        raise ErrInvalidEvidence(
+            f"validator power mismatch: evidence {ev.validator_power}, valset {val.voting_power}"
+        )
+    if ev.total_voting_power != val_set.total_voting_power():
+        raise ErrInvalidEvidence(
+            f"total voting power mismatch: evidence {ev.total_voting_power}, "
+            f"valset {val_set.total_voting_power()}"
+        )
+    # both signatures must verify under the culprit's key (batched: 2 sigs)
+    bv = crypto_batch.create_batch_verifier(val.pub_key)
+    bv.add(val.pub_key, a.sign_bytes(chain_id), a.signature)
+    bv.add(val.pub_key, b.sign_bytes(chain_id), b.signature)
+    ok, mask = bv.verify()
+    if not ok:
+        which = "A" if not mask[0] else "B"
+        raise ErrInvalidEvidence(f"invalid signature on vote {which}")
+
+
+def _verify_light_client_attack(
+    ev: LightClientAttackEvidence, state: State, common_vals: ValidatorSet
+) -> None:
+    """verify.go:110-164 shape: validated once the light client lands
+    (conflicting header must be signed by 1/3+ of the common valset). The
+    pool rejects LC evidence until then rather than accepting it
+    unverified."""
+    raise ErrInvalidEvidence(
+        "light-client attack evidence requires the light client (not yet wired)"
+    )

@@ -230,6 +230,11 @@ class BlockExecutor:
                 block.last_commit,
             )
 
+        # evidence in the proposed block must verify (validation.go:15 ->
+        # evpool.CheckEvidence, state/validation.go end)
+        if self.evidence_pool is not None and block.evidence.evidence:
+            self.evidence_pool.check_evidence(block.evidence.evidence)
+
     # -------------------------------------------------------------- apply
 
     async def apply_block(

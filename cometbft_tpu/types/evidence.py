@@ -28,7 +28,7 @@ class Evidence:
         raise NotImplementedError
 
     def hash(self) -> bytes:
-        return tmhash.sum(self.bytes_())
+        return tmhash.sum_(self.bytes_())
 
     def height(self) -> int:
         raise NotImplementedError

@@ -90,6 +90,7 @@ class VoteSet:
         val_set: ValidatorSet,
         extensions_enabled: bool = False,
         batch_flush_size: int = 128,
+        auto_flush: bool = True,
     ):
         if height == 0:
             raise ValueError("cannot make VoteSet for height == 0, doesn't make sense")
@@ -109,6 +110,9 @@ class VoteSet:
         self.peer_maj23s: dict[str, BlockID] = {}
         # --- batch path state ---
         self.batch_flush_size = batch_flush_size
+        # auto_flush=False hands flush control to the caller (consensus
+        # needs the flush results to fire events / run threshold hooks)
+        self.auto_flush = auto_flush
         self._pending: list[tuple[Vote, int]] = []  # (vote, voting_power)
         self._pending_by_key: dict[tuple[int, bytes], Vote] = {}
         self._speculative_sum = 0
@@ -170,11 +174,15 @@ class VoteSet:
         self._pending_by_key[key] = vote
         if self.votes[vote.validator_index] is None:
             self._speculative_sum += val.voting_power
-        if self._should_flush():
+        if self.auto_flush and self.should_flush():
             self.flush_pending()
         return True
 
-    def _should_flush(self) -> bool:
+    def should_flush(self) -> bool:
+        """True when flushing now is worthwhile: the staged batch is full,
+        or the speculative (unverified) tally would cross the 2/3 quorum —
+        the deferred-flush boundary that keeps 'never count an unverified
+        vote' compatible with batching (SURVEY.md §7 step 2)."""
         if len(self._pending) >= self.batch_flush_size:
             return True
         # quorum boundary: the speculative (unverified) tally would cross

@@ -1,0 +1,143 @@
+"""In-process multi-validator consensus network (the spirit of the
+reference's consensus/common_test.go: N real consensus.States wired to
+in-proc ABCI apps with simulated networking).
+
+Each node is a full vertical stack (kvstore app, proxy conns, mempool,
+stores, evidence pool, BlockExecutor, ConsensusState); the "network" is the
+outbound_hook tap on each state machine fanning its proposals/parts/votes
+into every other node's peer queue. No sockets — reactor-level gossip is
+exercised separately (reactors/, p2p/)."""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.consensus import ConsensusState
+from cometbft_tpu.consensus import messages as M
+from cometbft_tpu.consensus.config import ConsensusConfig, test_consensus_config
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.evidence import EvidencePool
+from cometbft_tpu.mempool.mempool import CListMempool, MempoolConfig
+from cometbft_tpu.privval.file_pv import FilePV
+from cometbft_tpu.proxy import AppConns, local_client_creator
+from cometbft_tpu.state import BlockExecutor, State, StateStore
+from cometbft_tpu.store import BlockStore, MemDB
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.utils import cmttime
+
+
+@dataclass
+class NetNode:
+    name: str
+    cs: ConsensusState
+    conns: AppConns
+    mempool: CListMempool
+    block_store: BlockStore
+    evidence_pool: EvidencePool
+    app: KVStoreApplication
+    running: bool = False
+
+
+@dataclass
+class InProcNet:
+    nodes: list[NetNode] = field(default_factory=list)
+    privs: list = field(default_factory=list)
+
+    def wire(self, node: NetNode) -> None:
+        sender = node.name
+
+        def hook(msg) -> None:
+            loop = asyncio.get_running_loop()
+            for other in self.nodes:
+                if other.name == sender or not other.running:
+                    continue
+                if isinstance(msg, M.VoteMessage):
+                    coro = other.cs.add_vote_from_peer(msg.vote, sender)
+                elif isinstance(msg, M.ProposalMessage):
+                    coro = other.cs.add_proposal_from_peer(msg.proposal, sender)
+                elif isinstance(msg, M.BlockPartMessage):
+                    coro = other.cs.add_block_part_from_peer(
+                        msg.height, msg.round_, msg.part, sender
+                    )
+                else:
+                    continue
+                loop.create_task(coro)
+
+        node.cs.outbound_hook = hook
+
+    async def start(self, names: list[str] | None = None) -> None:
+        for n in self.nodes:
+            if names is None or n.name in names:
+                n.running = True
+                await n.cs.start()
+
+    async def stop(self) -> None:
+        for n in self.nodes:
+            if n.running:
+                n.running = False
+                await n.cs.stop()
+            await n.conns.stop()
+
+    def max_height(self) -> int:
+        return max((n.block_store.height() for n in self.nodes if n.running), default=0)
+
+    async def wait_for_height(self, h: int, timeout: float = 30.0) -> None:
+        async def poll():
+            while any(n.block_store.height() < h for n in self.nodes if n.running):
+                await asyncio.sleep(0.02)
+
+        await asyncio.wait_for(poll(), timeout)
+
+
+async def make_net(
+    n_vals: int = 4,
+    config: ConsensusConfig | None = None,
+    chain_id: str = "net-test-chain",
+) -> InProcNet:
+    privs = [ed25519.gen_priv_key() for _ in range(n_vals)]
+    gdoc = GenesisDoc(
+        genesis_time=cmttime.canonical_now_ms(),
+        chain_id=chain_id,
+        validators=[
+            GenesisValidator(address=p.pub_key().address(), pub_key=p.pub_key(), power=10)
+            for p in privs
+        ],
+    )
+    gdoc.validate_and_complete()
+
+    net = InProcNet(privs=privs)
+    for i in range(n_vals):
+        state = State.from_genesis(gdoc)
+        app = KVStoreApplication()
+        conns = AppConns(local_client_creator(app))
+        await conns.start()
+        state_store = StateStore(MemDB())
+        state_store.bootstrap(state)
+        block_store = BlockStore(MemDB())
+        mempool = CListMempool(MempoolConfig(), conns.mempool)
+        ev_pool = EvidencePool(MemDB(), state_store)
+        block_exec = BlockExecutor(
+            state_store, conns.consensus, mempool, evidence_pool=ev_pool
+        )
+        cs = ConsensusState(
+            config=config or test_consensus_config(),
+            state=state,
+            block_exec=block_exec,
+            block_store=block_store,
+            wal=None,
+            priv_validator=FilePV(privs[i]),
+        )
+        node = NetNode(
+            name=f"val{i}",
+            cs=cs,
+            conns=conns,
+            mempool=mempool,
+            block_store=block_store,
+            evidence_pool=ev_pool,
+            app=app,
+        )
+        net.nodes.append(node)
+        net.wire(node)
+    return net
