@@ -1,0 +1,47 @@
+"""Flow-rate measurement and limiting.
+
+Reference: libs/flowrate (token-bucket rate monitor used by MConnection to
+throttle per-peer send/recv to config.SendRate/RecvRate,
+p2p/conn/connection.go:44-45). asyncio-native: `limit()` returns the delay
+to sleep before transferring n more bytes.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Monitor:
+    """Sliding-average rate monitor with an optional hard limit."""
+
+    def __init__(self, rate_limit: int = 0, window: float = 1.0):
+        self.rate_limit = rate_limit  # bytes/sec; 0 = unlimited
+        self.window = window
+        self.bytes_total = 0
+        self._window_start = time.monotonic()
+        self._window_bytes = 0
+        self._avg_rate = 0.0
+
+    def update(self, n: int) -> float:
+        """Record n transferred bytes; return seconds the caller should
+        sleep to stay under rate_limit (0.0 when unlimited/under budget)."""
+        now = time.monotonic()
+        self.bytes_total += n
+        self._window_bytes += n
+        elapsed = now - self._window_start
+        if elapsed >= self.window:
+            self._avg_rate = self._window_bytes / elapsed
+            self._window_start = now
+            self._window_bytes = 0
+        if self.rate_limit <= 0:
+            return 0.0
+        # delay so that window_bytes/elapsed <= rate_limit
+        min_elapsed = self._window_bytes / self.rate_limit
+        return max(0.0, min_elapsed - elapsed)
+
+    def rate(self) -> float:
+        """Most recent windowed average rate (bytes/sec)."""
+        elapsed = time.monotonic() - self._window_start
+        if elapsed > 0.1:
+            return self._window_bytes / elapsed
+        return self._avg_rate

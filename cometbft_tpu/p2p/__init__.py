@@ -1,0 +1,16 @@
+from cometbft_tpu.p2p.key import NodeKey
+from cometbft_tpu.p2p.node_info import NodeInfo
+from cometbft_tpu.p2p.base_reactor import Reactor, Envelope
+from cometbft_tpu.p2p.peer import Peer
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.p2p.transport import Transport
+
+__all__ = [
+    "NodeKey",
+    "NodeInfo",
+    "Reactor",
+    "Envelope",
+    "Peer",
+    "Switch",
+    "Transport",
+]

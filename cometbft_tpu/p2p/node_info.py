@@ -1,0 +1,93 @@
+"""Node handshake info.
+
+Reference: p2p/node_info.go — exchanged in plaintext-over-SecretConnection
+right after the crypto handshake; carries protocol versions, the claimed
+node ID (must match the SecretConnection-authenticated pubkey), network
+(chain id), and the channel list for reactor compatibility checks
+(node_info.go:142 CompatibleWith).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ProtocolVersion:
+    p2p: int = 8
+    block: int = 11
+    app: int = 0
+
+
+@dataclass
+class NodeInfo:
+    node_id: str = ""
+    listen_addr: str = ""
+    network: str = ""  # chain id
+    version: str = ""
+    channels: bytes = b""
+    moniker: str = ""
+    protocol_version: ProtocolVersion = field(default_factory=ProtocolVersion)
+    tx_index: str = "on"
+    rpc_address: str = ""
+
+    def validate(self) -> None:
+        """node_info.go:173 Validate (subset: structural checks)."""
+        if not self.node_id:
+            raise ValueError("node info: empty node id")
+        if len(self.channels) > 64:
+            raise ValueError("node info: too many channels")
+        if len(set(self.channels)) != len(self.channels):
+            raise ValueError("node info: duplicate channel ids")
+
+    def compatible_with(self, other: "NodeInfo") -> None:
+        """node_info.go:142: same block protocol + network, >=1 common
+        channel."""
+        if self.protocol_version.block != other.protocol_version.block:
+            raise ValueError(
+                f"incompatible block protocol: {self.protocol_version.block} vs "
+                f"{other.protocol_version.block}"
+            )
+        if self.network != other.network:
+            raise ValueError(f"different networks: {self.network!r} vs {other.network!r}")
+        if self.channels and other.channels and not set(self.channels) & set(other.channels):
+            raise ValueError("no common channels")
+
+    # ------------------------------------------------------------- codec
+
+    def encode(self) -> bytes:
+        doc = {
+            "node_id": self.node_id,
+            "listen_addr": self.listen_addr,
+            "network": self.network,
+            "version": self.version,
+            "channels": self.channels.hex(),
+            "moniker": self.moniker,
+            "protocol_version": {
+                "p2p": self.protocol_version.p2p,
+                "block": self.protocol_version.block,
+                "app": self.protocol_version.app,
+            },
+            "tx_index": self.tx_index,
+            "rpc_address": self.rpc_address,
+        }
+        return json.dumps(doc, separators=(",", ":")).encode()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NodeInfo":
+        doc = json.loads(data)
+        pv = doc.get("protocol_version", {})
+        return cls(
+            node_id=doc.get("node_id", ""),
+            listen_addr=doc.get("listen_addr", ""),
+            network=doc.get("network", ""),
+            version=doc.get("version", ""),
+            channels=bytes.fromhex(doc.get("channels", "")),
+            moniker=doc.get("moniker", ""),
+            protocol_version=ProtocolVersion(
+                p2p=pv.get("p2p", 0), block=pv.get("block", 0), app=pv.get("app", 0)
+            ),
+            tx_index=doc.get("tx_index", "on"),
+            rpc_address=doc.get("rpc_address", ""),
+        )

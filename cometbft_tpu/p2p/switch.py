@@ -1,0 +1,217 @@
+"""Switch: owns peers and reactors, routes messages between them.
+
+Reference: p2p/switch.go:72 — reactors register channel descriptors; the
+switch accepts/dials connections, wraps them in Peers, and dispatches every
+received message to the reactor owning that channel. Persistent peers are
+redialed with exponential backoff (switch.go:398 reconnectToPeer);
+StopPeerForError tears a peer down and triggers the redial.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Optional
+
+from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.libs.service import BaseService, TaskRunner
+from cometbft_tpu.p2p.base_reactor import Envelope, Reactor
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor, MConnConfig
+from cometbft_tpu.p2p.peer import Peer
+from cometbft_tpu.p2p.transport import Transport, UpgradedConn, parse_addr
+
+RECONNECT_ATTEMPTS = 20
+RECONNECT_BASE_DELAY = 0.5
+RECONNECT_MAX_DELAY = 30.0
+
+
+class ErrDuplicatePeer(Exception):
+    pass
+
+
+class Switch(BaseService):
+    def __init__(
+        self,
+        transport: Transport,
+        mconn_config: MConnConfig | None = None,
+        logger: cmtlog.Logger | None = None,
+    ):
+        super().__init__("P2P Switch", logger)
+        self.transport = transport
+        self.mconn_config = mconn_config or MConnConfig()
+        self.reactors: dict[str, Reactor] = {}
+        self._chan_to_reactor: dict[int, Reactor] = {}
+        self._channels: list[ChannelDescriptor] = []
+        self.peers: dict[str, Peer] = {}
+        self.persistent_addrs: dict[str, str] = {}  # node_id -> addr
+        self._reconnecting: set[str] = set()
+        self._tasks = TaskRunner("switch")
+
+    # ------------------------------------------------------------ reactors
+
+    def add_reactor(self, name: str, reactor: Reactor) -> None:
+        """switch.go:206 AddReactor: channel ids must be globally unique."""
+        for d in reactor.get_channels():
+            if d.id in self._chan_to_reactor:
+                raise ValueError(f"channel {d.id:#x} already registered")
+            self._chan_to_reactor[d.id] = reactor
+            self._channels.append(d)
+        self.reactors[name] = reactor
+        reactor.set_switch(self)
+        # advertise channels in the handshake
+        self.transport.node_info.channels = bytes(
+            sorted(d.id for d in self._channels)
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def on_start(self) -> None:
+        for reactor in self.reactors.values():
+            await reactor.on_start()
+        self._tasks.spawn(self._accept_routine(), name="switch-accept")
+
+    async def on_stop(self) -> None:
+        await self._tasks.cancel_all()
+        for peer in list(self.peers.values()):
+            await self._stop_peer(peer, "switch stopping")
+        for reactor in self.reactors.values():
+            await reactor.on_stop()
+        self.transport.close()
+
+    # -------------------------------------------------------------- accept
+
+    async def _accept_routine(self) -> None:
+        """switch.go:633 acceptRoutine."""
+        while True:
+            try:
+                up = await self.transport.accept()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                self.logger.error("accept error", err=str(e))
+                await asyncio.sleep(0.1)
+                continue
+            try:
+                await self._add_peer(up)
+            except Exception as e:  # noqa: BLE001 - bad peer must not kill accepts
+                self.logger.info("failed to add inbound peer", err=str(e))
+                up.conn.close()
+
+    # ---------------------------------------------------------------- dial
+
+    async def dial_peers_async(self, addrs: list[str], persistent: bool = False) -> None:
+        """switch.go:573 DialPeersAsync: fire-and-forget dial attempts."""
+        for addr in addrs:
+            node_id, _, _ = parse_addr(addr)
+            if persistent and node_id:
+                self.persistent_addrs[node_id] = addr
+            self._tasks.spawn(self._dial_with_retries(addr, persistent),
+                              name=f"dial-{addr}")
+
+    async def _dial_with_retries(self, addr: str, persistent: bool) -> None:
+        node_id, _, _ = parse_addr(addr)
+        attempts = RECONNECT_ATTEMPTS if persistent else 1
+        delay = RECONNECT_BASE_DELAY
+        for i in range(attempts):
+            if node_id and node_id in self.peers:
+                return
+            try:
+                up = await self.transport.dial(addr)
+                await self._add_peer(up, persistent=persistent)
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                self.logger.info("dial failed", addr=addr, attempt=i, err=str(e))
+                # exponential backoff + jitter (switch.go:398)
+                await asyncio.sleep(delay * (0.5 + random.random()))
+                delay = min(delay * 2, RECONNECT_MAX_DELAY)
+
+    # ---------------------------------------------------------------- peers
+
+    async def _add_peer(self, up: UpgradedConn, persistent: bool = False) -> Peer:
+        node_id = up.node_info.node_id
+        if node_id in self.peers:
+            up.conn.close()
+            raise ErrDuplicatePeer(node_id)
+        persistent = persistent or node_id in self.persistent_addrs
+        peer = Peer(
+            conn=up.conn,
+            node_info=up.node_info,
+            channels=self._channels,
+            on_receive=self._on_peer_receive,
+            on_error=self._on_peer_error,
+            outbound=up.outbound,
+            persistent=persistent,
+            mconn_config=self.mconn_config,
+            logger=self.logger.with_fields(peer=node_id[:10]),
+        )
+        for reactor in self.reactors.values():
+            reactor.init_peer(peer)
+        await peer.start()
+        self.peers[node_id] = peer
+        for reactor in self.reactors.values():
+            await reactor.add_peer(peer)
+        self.logger.info("added peer", peer=node_id[:10],
+                         outbound=up.outbound, n_peers=len(self.peers))
+        return peer
+
+    async def _on_peer_receive(self, chan_id: int, peer: Peer, msg: bytes) -> None:
+        reactor = self._chan_to_reactor.get(chan_id)
+        if reactor is None:
+            await self.stop_peer_for_error(peer, f"unknown channel {chan_id:#x}")
+            return
+        try:
+            await reactor.receive(Envelope(channel_id=chan_id, message=msg, src=peer))
+        except Exception as e:  # noqa: BLE001 - a bad message bans the peer
+            self.logger.error("reactor receive failed", chan=f"{chan_id:#x}", err=str(e))
+            await self.stop_peer_for_error(peer, e)
+
+    async def _on_peer_error(self, peer: Peer, err: Exception) -> None:
+        await self.stop_peer_for_error(peer, err)
+
+    async def stop_peer_for_error(self, peer: Peer, reason: object) -> None:
+        """switch.go:335: drop the peer; redial if persistent."""
+        if peer.id not in self.peers:
+            return
+        self.logger.info("stopping peer for error", peer=peer.id[:10], err=str(reason))
+        await self._stop_peer(peer, reason)
+        if peer.is_persistent():
+            addr = self.persistent_addrs.get(peer.id)
+            if addr and peer.id not in self._reconnecting:
+                self._reconnecting.add(peer.id)
+                self._tasks.spawn(self._reconnect(peer.id, addr),
+                                  name=f"reconnect-{peer.id[:10]}")
+
+    async def _reconnect(self, node_id: str, addr: str) -> None:
+        try:
+            await asyncio.sleep(RECONNECT_BASE_DELAY)
+            await self._dial_with_retries(addr, persistent=True)
+        finally:
+            self._reconnecting.discard(node_id)
+
+    async def _stop_peer(self, peer: Peer, reason: object) -> None:
+        self.peers.pop(peer.id, None)
+        try:
+            await peer.stop()
+        except Exception:  # noqa: BLE001
+            pass
+        for reactor in self.reactors.values():
+            try:
+                await reactor.remove_peer(peer, reason)
+            except Exception as e:  # noqa: BLE001
+                self.logger.error("remove_peer failed", reactor=reactor.name, err=str(e))
+
+    # ------------------------------------------------------------ broadcast
+
+    async def broadcast(self, chan_id: int, msg: bytes) -> None:
+        """switch.go:274 Broadcast: try_send to every peer (drops on full
+        queues — gossip routines provide reliability)."""
+        for peer in list(self.peers.values()):
+            peer.try_send(chan_id, msg)
+
+    def n_peers(self) -> int:
+        return len(self.peers)
+
+    def get_peer(self, node_id: str) -> Optional[Peer]:
+        return self.peers.get(node_id)

@@ -1,0 +1,216 @@
+"""P2P stack tests: SecretConnection handshake + framing, MConnection
+multiplexing, Transport upgrade, Switch peer lifecycle + reconnect.
+
+Reference behaviors mirrored: p2p/conn/secret_connection_test.go,
+p2p/conn/connection_test.go, p2p/switch_test.go.
+"""
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.p2p.base_reactor import Envelope, Reactor
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.p2p.conn.secret_connection import SecretConnection
+from cometbft_tpu.p2p.key import NodeKey
+from cometbft_tpu.p2p.node_info import NodeInfo
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.p2p.transport import ErrRejected, Transport
+
+
+def make_transport(network: str = "test-chain", moniker: str = "t") -> Transport:
+    nk = NodeKey(ed25519.gen_priv_key())
+    info = NodeInfo(
+        node_id=nk.id(), network=network, version="dev", moniker=moniker,
+        channels=bytes([0x01]),
+    )
+    return Transport(nk, info, logger=cmtlog.nop())
+
+
+async def make_secret_pair():
+    """Two SecretConnections over a localhost socket."""
+    k1, k2 = ed25519.gen_priv_key(), ed25519.gen_priv_key()
+    server_conn: dict = {}
+    done = asyncio.Event()
+
+    async def on_conn(reader, writer):
+        server_conn["conn"] = await SecretConnection.make(reader, writer, k2)
+        done.set()
+
+    server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    client = await SecretConnection.make(reader, writer, k1)
+    await done.wait()
+    server.close()
+    return client, server_conn["conn"], k1, k2
+
+
+class TestSecretConnection:
+    def test_handshake_authenticates_remote_key(self):
+        async def main():
+            client, srv, k1, k2 = await make_secret_pair()
+            assert client.remote_pubkey.bytes_() == k2.pub_key().bytes_()
+            assert srv.remote_pubkey.bytes_() == k1.pub_key().bytes_()
+            client.close()
+
+        asyncio.run(main())
+
+    def test_roundtrip_small_and_multiframe(self):
+        async def main():
+            client, srv, _, _ = await make_secret_pair()
+            await client.write_msg(b"hello")
+            assert await srv.read_msg() == b"hello"
+            big = bytes(range(256)) * 40  # 10240 bytes -> 11 frames
+            await srv.write_msg(big)
+            assert await client.read_msg() == big
+            client.close()
+
+        asyncio.run(main())
+
+    def test_tampered_frame_rejected(self):
+        async def main():
+            client, srv, _, _ = await make_secret_pair()
+            # garbage straight onto the wire: AEAD must reject
+            client._writer.write(b"\x00" * 1044)
+            await client._writer.drain()
+            with pytest.raises(Exception):
+                await srv.read_msg()
+            client.close()
+
+        asyncio.run(main())
+
+
+class EchoReactor(Reactor):
+    """Echoes every message back on the same channel; records receipts."""
+
+    def __init__(self, chan_id: int = 0x01, echo: bool = True):
+        super().__init__("echo")
+        self.chan_id = chan_id
+        self.echo = echo
+        self.received: list[bytes] = []
+        self.got_msg = asyncio.Event()
+        self.peers_added: list = []
+        self.peers_removed: list = []
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=self.chan_id, priority=5)]
+
+    async def add_peer(self, peer):
+        self.peers_added.append(peer.id)
+
+    async def remove_peer(self, peer, reason):
+        self.peers_removed.append(peer.id)
+
+    async def receive(self, e: Envelope):
+        self.received.append(e.message)
+        self.got_msg.set()
+        if self.echo:
+            await e.src.send(e.channel_id, b"echo:" + e.message)
+
+
+async def make_switch_pair():
+    t1, t2 = make_transport(moniker="a"), make_transport(moniker="b")
+    r1, r2 = EchoReactor(echo=False), EchoReactor()
+    s1 = Switch(t1)
+    s2 = Switch(t2)
+    s1.add_reactor("echo", r1)
+    s2.add_reactor("echo", r2)
+    addr2 = await t2.listen("127.0.0.1:0")
+    await s1.start()
+    await s2.start()
+    return s1, s2, r1, r2, t2.node_key.id() + "@" + addr2
+
+
+async def wait_until(cond, timeout: float = 5.0, interval: float = 0.02):
+    async def poll():
+        while not cond():
+            await asyncio.sleep(interval)
+
+    await asyncio.wait_for(poll(), timeout)
+
+
+class TestSwitch:
+    def test_dial_send_receive(self):
+        async def main():
+            s1, s2, r1, r2, addr2 = await make_switch_pair()
+            try:
+                await s1.dial_peers_async([addr2])
+                await wait_until(lambda: s1.n_peers() and s2.n_peers())
+                peer = next(iter(s1.peers.values()))
+                assert await peer.send(0x01, b"ping-message")
+                await asyncio.wait_for(r2.got_msg.wait(), 5)
+                assert r2.received == [b"ping-message"]
+                await asyncio.wait_for(r1.got_msg.wait(), 5)
+                assert r1.received == [b"echo:ping-message"]
+            finally:
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())
+
+    def test_large_message_multiplexed(self):
+        async def main():
+            s1, s2, r1, r2, addr2 = await make_switch_pair()
+            try:
+                await s1.dial_peers_async([addr2])
+                await wait_until(lambda: s1.n_peers())
+                big = b"x" * 300_000  # ~293 packets
+                peer = next(iter(s1.peers.values()))
+                await peer.send(0x01, big)
+                await asyncio.wait_for(r2.got_msg.wait(), 10)
+                assert r2.received[0] == big
+            finally:
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())
+
+    def test_persistent_peer_reconnects(self):
+        async def main():
+            s1, s2, r1, r2, addr2 = await make_switch_pair()
+            try:
+                await s1.dial_peers_async([addr2], persistent=True)
+                await wait_until(lambda: s1.n_peers())
+                # kill from s2's side; s1 must redial
+                peer2 = next(iter(s2.peers.values()))
+                await s2.stop_peer_for_error(peer2, "test kill")
+                await wait_until(
+                    lambda: s1.n_peers() == 1 and s2.n_peers() == 1
+                    and len(r2.peers_added) >= 2,
+                    timeout=10,
+                )
+            finally:
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())
+
+    def test_wrong_network_rejected(self):
+        async def main():
+            t1 = make_transport(network="chain-A")
+            t2 = make_transport(network="chain-B")
+            addr2 = await t2.listen("127.0.0.1:0")
+            try:
+                with pytest.raises((ErrRejected, ValueError)):
+                    await t1.dial(t2.node_key.id() + "@" + addr2)
+            finally:
+                t2.close()
+
+        asyncio.run(main())
+
+    def test_wrong_peer_id_rejected(self):
+        async def main():
+            t1 = make_transport()
+            t2 = make_transport()
+            imposter = NodeKey(ed25519.gen_priv_key()).id()
+            addr2 = await t2.listen("127.0.0.1:0")
+            try:
+                with pytest.raises(ErrRejected):
+                    await t1.dial(imposter + "@" + addr2)
+            finally:
+                t2.close()
+
+        asyncio.run(main())
