@@ -1,0 +1,252 @@
+"""Per-peer consensus state tracking.
+
+Reference: consensus/reactor.go:1069 PeerState +
+consensus/types/peer_round_state.go. The gossip routines consult this to
+decide what the peer still needs (parts, votes, proposal); Receive handlers
+update it from the peer's own announcements. Single event loop — no locks
+(the reference needs a mutex because goroutines race; reactor.go:1075).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from cometbft_tpu.consensus import messages as M
+from cometbft_tpu.consensus.round_state import RoundStepType
+from cometbft_tpu.libs.bits import BitArray
+from cometbft_tpu.types.basic import PartSetHeader, SignedMsgType
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.utils import cmttime
+
+
+@dataclass
+class PeerRoundState:
+    """consensus/types/peer_round_state.go:9-42."""
+
+    height: int = 0
+    round_: int = -1
+    step: RoundStepType = RoundStepType.NEW_HEIGHT
+    start_time: cmttime.Timestamp = field(default_factory=cmttime.Timestamp.zero)
+    proposal: bool = False
+    proposal_block_part_set_header: PartSetHeader = field(default_factory=PartSetHeader)
+    proposal_block_parts: BitArray | None = None
+    proposal_pol_round: int = -1
+    proposal_pol: BitArray | None = None
+    prevotes: BitArray | None = None
+    precommits: BitArray | None = None
+    last_commit_round: int = -1
+    last_commit: BitArray | None = None
+    catchup_commit_round: int = -1
+    catchup_commit: BitArray | None = None
+
+
+class PeerState:
+    def __init__(self, peer_id: str):
+        self.peer_id = peer_id
+        self.prs = PeerRoundState()
+
+    # -------------------------------------------------------------- queries
+
+    def get_height(self) -> int:
+        return self.prs.height
+
+    def get_vote_bit_array(
+        self, height: int, round_: int, type_: SignedMsgType
+    ) -> Optional[BitArray]:
+        """reactor.go:1220 getVoteBitArray."""
+        prs = self.prs
+        if height == prs.height:
+            if round_ == prs.round_:
+                return prs.prevotes if type_ == SignedMsgType.PREVOTE else prs.precommits
+            if round_ == prs.catchup_commit_round and type_ == SignedMsgType.PRECOMMIT:
+                return prs.catchup_commit
+            if round_ == prs.proposal_pol_round and type_ == SignedMsgType.PREVOTE:
+                return prs.proposal_pol
+            return None
+        if height == prs.height - 1:
+            if round_ == prs.last_commit_round and type_ == SignedMsgType.PRECOMMIT:
+                return prs.last_commit
+            return None
+        return None
+
+    # -------------------------------------------------------------- updates
+
+    def set_has_proposal(self, proposal: Proposal) -> None:
+        """reactor.go:1127."""
+        prs = self.prs
+        if prs.height != proposal.height or prs.round_ != proposal.round_:
+            return
+        if prs.proposal:
+            return
+        prs.proposal = True
+        if prs.proposal_block_parts is not None:
+            return  # NewValidBlock already set it
+        prs.proposal_block_part_set_header = proposal.block_id.part_set_header
+        prs.proposal_block_parts = BitArray(proposal.block_id.part_set_header.total)
+        prs.proposal_pol_round = proposal.pol_round
+        prs.proposal_pol = None  # until ProposalPOLMessage arrives
+
+    def init_proposal_block_parts(self, header: PartSetHeader) -> None:
+        """reactor.go:1147."""
+        if self.prs.proposal_block_parts is not None:
+            return
+        self.prs.proposal_block_part_set_header = header
+        self.prs.proposal_block_parts = BitArray(header.total)
+
+    def set_has_proposal_block_part(self, height: int, round_: int, index: int) -> None:
+        """reactor.go:1159."""
+        prs = self.prs
+        if prs.height != height or prs.round_ != round_:
+            return
+        if prs.proposal_block_parts is None:
+            prs.proposal_block_parts = BitArray(index + 1)
+        if index < prs.proposal_block_parts.size():
+            prs.proposal_block_parts.set_index(index, True)
+
+    def set_has_vote(self, height: int, round_: int, type_: SignedMsgType, index: int) -> None:
+        """reactor.go:1288 setHasVote."""
+        ba = self.get_vote_bit_array(height, round_, type_)
+        if ba is not None and 0 <= index < ba.size():
+            ba.set_index(index, True)
+
+    def ensure_vote_bit_arrays(self, height: int, num_validators: int) -> None:
+        """reactor.go:1249 EnsureVoteBitArrays."""
+        prs = self.prs
+        if prs.height == height:
+            if prs.prevotes is None:
+                prs.prevotes = BitArray(num_validators)
+            if prs.precommits is None:
+                prs.precommits = BitArray(num_validators)
+            if prs.catchup_commit is None:
+                prs.catchup_commit = BitArray(num_validators)
+            if prs.proposal_pol is None:
+                prs.proposal_pol = BitArray(num_validators)
+        elif prs.height == height + 1:
+            if prs.last_commit is None:
+                prs.last_commit = BitArray(num_validators)
+
+    def ensure_catchup_commit_round(self, height: int, round_: int, num_validators: int) -> None:
+        """reactor.go:1233."""
+        prs = self.prs
+        if prs.height != height:
+            return
+        if prs.catchup_commit_round == round_:
+            return
+        prs.catchup_commit_round = round_
+        if round_ == prs.round_:
+            prs.catchup_commit = prs.precommits
+        else:
+            prs.catchup_commit = BitArray(num_validators)
+
+    # ------------------------------------------------------- vote picking
+
+    def pick_vote_to_send(self, votes) -> Optional[Vote]:
+        """reactor.go:1185 PickVoteToSend: a random verified vote the peer
+        does not have. `votes` is any vote-set reader: size() +
+        bit_array() + get_by_index() + .height/.round_/.signed_msg_type."""
+        prs = self.prs
+        if votes.size() == 0:
+            return None
+        height, round_, type_ = votes.height, votes.round_, votes.signed_msg_type
+        # lazily init the peer's bit arrays from the vote set's shape
+        # (reactor.go:1185-1204: ensureCatchupCommitRound + ensureVoteBitArrays)
+        if type_ == SignedMsgType.PRECOMMIT and height == prs.height and round_ != prs.round_:
+            self.ensure_catchup_commit_round(height, round_, votes.size())
+        self.ensure_vote_bit_arrays(height, votes.size())
+        ps_votes = self.get_vote_bit_array(height, round_, type_)
+        if ps_votes is None:
+            return None
+        gap = votes.bit_array().sub(ps_votes)
+        idx, ok = gap.pick_random()
+        if not ok:
+            return None
+        return votes.get_by_index(idx)
+
+    # ------------------------------------------------- message application
+
+    def apply_new_round_step(self, msg: M.NewRoundStepMessage) -> None:
+        """reactor.go:1313 ApplyNewRoundStepMessage."""
+        prs = self.prs
+        # ignore stale announcements
+        if (
+            msg.height < prs.height
+            or (msg.height == prs.height and msg.round_ < prs.round_)
+            or (
+                msg.height == prs.height
+                and msg.round_ == prs.round_
+                and msg.step < int(prs.step)
+            )
+        ):
+            return
+        psh_round = prs.round_
+        ps_catchup_round = prs.catchup_commit_round
+        ps_precommits = prs.precommits
+        start_height, start_round = prs.height, prs.round_
+
+        prs.height = msg.height
+        prs.round_ = msg.round_
+        prs.step = RoundStepType(msg.step)
+        prs.start_time = cmttime.now().add_seconds(-msg.seconds_since_start_time)
+
+        if start_height != msg.height or start_round != msg.round_:
+            prs.proposal = False
+            prs.proposal_block_part_set_header = PartSetHeader()
+            prs.proposal_block_parts = None
+            prs.proposal_pol_round = -1
+            prs.proposal_pol = None
+            prs.prevotes = None
+            prs.precommits = None
+        if start_height == msg.height and start_round != msg.round_ and msg.round_ == ps_catchup_round:
+            # peer caught up to the round we tracked as its catchup commit
+            prs.precommits = prs.catchup_commit
+        if start_height != msg.height:
+            # shift precommits to last_commit
+            if start_height == msg.height - 1 and psh_round == msg.last_commit_round:
+                prs.last_commit_round = msg.last_commit_round
+                prs.last_commit = ps_precommits
+            else:
+                prs.last_commit_round = msg.last_commit_round
+                prs.last_commit = None
+            prs.catchup_commit_round = -1
+            prs.catchup_commit = None
+
+    def apply_new_valid_block(self, msg: M.NewValidBlockMessage) -> None:
+        """reactor.go:1370."""
+        prs = self.prs
+        if prs.height != msg.height:
+            return
+        if prs.round_ != msg.round_ and not msg.is_commit:
+            return
+        prs.proposal_block_part_set_header = msg.block_part_set_header
+        prs.proposal_block_parts = msg.block_parts
+
+    def apply_proposal_pol(self, msg: M.ProposalPOLMessage) -> None:
+        """reactor.go:1389."""
+        prs = self.prs
+        if prs.height != msg.height:
+            return
+        if prs.proposal_pol_round != msg.proposal_pol_round:
+            return
+        prs.proposal_pol = msg.proposal_pol
+
+    def apply_has_vote(self, msg: M.HasVoteMessage) -> None:
+        """reactor.go:1402."""
+        if self.prs.height != msg.height:
+            return
+        self.set_has_vote(msg.height, msg.round_, msg.type_, msg.index)
+
+    def apply_vote_set_bits(self, msg: M.VoteSetBitsMessage, our_votes: BitArray | None) -> None:
+        """reactor.go:1412: if we know our votes for that block id, the
+        peer's claimed bits are OR'd restricted to what it can prove;
+        otherwise taken as-is."""
+        ba = self.get_vote_bit_array(msg.height, msg.round_, msg.type_)
+        if ba is None or msg.votes is None:
+            return
+        if our_votes is not None:
+            other_votes = ba.sub(our_votes)
+            has_votes = other_votes.or_(msg.votes)
+            ba.update(has_votes)
+        else:
+            ba.update(msg.votes)

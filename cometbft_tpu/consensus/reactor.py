@@ -1,0 +1,503 @@
+"""Consensus reactor: gossips proposals, block parts, and votes.
+
+Reference: consensus/reactor.go. Four p2p channels (reactor.go:154-192):
+  State 0x20        round-step announcements, HasVote, VoteSetMaj23
+  Data 0x21         proposals + block parts (+ catchup parts)
+  Vote 0x22         votes
+  VoteSetBits 0x23  vote-presence bitmap exchange
+
+Per peer, three routines (reactor.go:208-218): gossip_data (parts +
+proposal), gossip_votes, query_maj23. Broadcasts ride the consensus
+EventSwitch: every step change -> NewRoundStep (reactor.go:421), every
+added vote -> HasVote (reactor.go:466).
+
+The state machine itself never touches the network (SURVEY §1): inbound
+messages go through cs.add_*_from_peer queues; outbound gossip reads the
+shared RoundState + per-peer PeerState.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from cometbft_tpu.consensus import messages as M
+from cometbft_tpu.consensus import reactor_codec as codec
+from cometbft_tpu.consensus.peer_state import PeerState
+from cometbft_tpu.consensus.round_state import RoundStepType
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.libs.bits import BitArray
+from cometbft_tpu.p2p.base_reactor import Envelope, Reactor
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.types.basic import SignedMsgType
+from cometbft_tpu.utils import cmttime
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+PEER_STATE_KEY = "consensus.peer_state"
+
+
+class _CommitVoteSource:
+    """Adapter letting pick_vote_to_send serve votes out of a stored Commit
+    (the reference's Commit-implements-VoteSetReader, types/block.go:846)."""
+
+    def __init__(self, commit):
+        self.commit = commit
+        self.height = commit.height
+        self.round_ = commit.round_
+        self.signed_msg_type = SignedMsgType.PRECOMMIT
+
+    def size(self) -> int:
+        return len(self.commit.signatures)
+
+    def bit_array(self) -> BitArray:
+        ba = BitArray(len(self.commit.signatures))
+        for i, cs in enumerate(self.commit.signatures):
+            ba.set_index(i, bool(cs.signature))
+        return ba
+
+    def get_by_index(self, idx: int):
+        cs = self.commit.signatures[idx]
+        if not cs.signature:
+            return None
+        return self.commit.get_vote(idx)
+
+
+class ConsensusReactor(Reactor):
+    def __init__(
+        self,
+        cs: ConsensusState,
+        wait_sync: bool = False,
+        logger: cmtlog.Logger | None = None,
+    ):
+        super().__init__("Consensus", logger)
+        self.cs = cs
+        self.wait_sync = wait_sync
+        # keyed by peer OBJECT: a replaced duplicate conn's teardown must
+        # not cancel the replacement's routines (same node id)
+        self._peer_tasks: dict[object, list[asyncio.Task]] = {}
+        self._subscribed = False
+
+    # ------------------------------------------------------------- channels
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        """reactor.go:154-192."""
+        return [
+            ChannelDescriptor(id=STATE_CHANNEL, priority=6, send_queue_capacity=64),
+            ChannelDescriptor(id=DATA_CHANNEL, priority=10, send_queue_capacity=64,
+                              recv_message_capacity=1 << 22),
+            ChannelDescriptor(id=VOTE_CHANNEL, priority=7, send_queue_capacity=256),
+            ChannelDescriptor(id=VOTE_SET_BITS_CHANNEL, priority=1, send_queue_capacity=8),
+        ]
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def on_start(self) -> None:
+        self._subscribe_events()
+        if not self.wait_sync:
+            await self.cs.start()
+
+    async def on_stop(self) -> None:
+        for tasks in self._peer_tasks.values():
+            for t in tasks:
+                t.cancel()
+        self._peer_tasks.clear()
+        if self.cs.is_running:
+            await self.cs.stop()
+
+    async def switch_to_consensus(self, state) -> None:
+        """blocksync -> consensus handoff (reactor.go:115 SwitchToConsensus)."""
+        self.cs.update_to_state(state)
+        self.wait_sync = False
+        await self.cs.start()
+
+    def _subscribe_events(self) -> None:
+        """reactor.go:390 subscribeToBroadcastEvents."""
+        if self._subscribed or self.cs.event_switch is None:
+            return
+        self._subscribed = True
+        es = self.cs.event_switch
+        es.add_listener("cons-reactor", "NewRoundStep",
+                        lambda rs: self._broadcast_new_round_step(rs))
+        es.add_listener("cons-reactor", "Vote",
+                        lambda vote: self._broadcast_has_vote(vote))
+        es.add_listener("cons-reactor", "ValidBlock",
+                        lambda rs: self._broadcast_new_valid_block(rs))
+
+    # ----------------------------------------------------------- broadcasts
+
+    def _broadcast(self, chan_id: int, msg_bytes: bytes) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(chan_id, msg_bytes)
+
+    def _new_round_step_msg(self, rs) -> M.NewRoundStepMessage:
+        elapsed = max(0, (cmttime.now().unix_ns() - rs.start_time.unix_ns()) // 10**9)
+        return M.NewRoundStepMessage(
+            height=rs.height,
+            round_=rs.round_,
+            step=int(rs.step),
+            seconds_since_start_time=int(elapsed),
+            last_commit_round=rs.last_commit.round_ if rs.last_commit is not None else -1,
+        )
+
+    def _broadcast_new_round_step(self, rs) -> None:
+        """reactor.go:421 broadcastNewRoundStepMessage."""
+        self._broadcast(STATE_CHANNEL, codec.encode(self._new_round_step_msg(rs)))
+
+    def _broadcast_new_valid_block(self, rs) -> None:
+        """reactor.go:434."""
+        if rs.proposal_block_parts is None:
+            return
+        msg = M.NewValidBlockMessage(
+            height=rs.height,
+            round_=rs.round_,
+            block_part_set_header=rs.proposal_block_parts.header(),
+            block_parts=rs.proposal_block_parts.bit_array(),
+            is_commit=rs.step == RoundStepType.COMMIT,
+        )
+        self._broadcast(STATE_CHANNEL, codec.encode(msg))
+
+    def _broadcast_has_vote(self, vote) -> None:
+        """reactor.go:466."""
+        msg = M.HasVoteMessage(
+            height=vote.height, round_=vote.round_, type_=vote.type_,
+            index=vote.validator_index,
+        )
+        self._broadcast(STATE_CHANNEL, codec.encode(msg))
+
+    # ------------------------------------------------------- peer lifecycle
+
+    def init_peer(self, peer) -> None:
+        peer.set(PEER_STATE_KEY, PeerState(peer.id))
+
+    async def add_peer(self, peer) -> None:
+        """reactor.go:208-230: start gossip routines + announce our step."""
+        ps: PeerState = peer.get(PEER_STATE_KEY)
+        loop = asyncio.get_running_loop()
+        tasks = [
+            loop.create_task(self._gossip_data_routine(peer, ps)),
+            loop.create_task(self._gossip_votes_routine(peer, ps)),
+            loop.create_task(self._query_maj23_routine(peer, ps)),
+        ]
+        self._peer_tasks[peer] = tasks
+        if not self.wait_sync:
+            peer.try_send(
+                STATE_CHANNEL, codec.encode(self._new_round_step_msg(self.cs.rs))
+            )
+
+    async def remove_peer(self, peer, reason) -> None:
+        for t in self._peer_tasks.pop(peer, []):
+            t.cancel()
+
+    # --------------------------------------------------------------- receive
+
+    async def receive(self, e: Envelope) -> None:
+        """reactor.go:241-385."""
+        msg = codec.decode(e.message)
+        peer = e.src
+        ps: PeerState = peer.get(PEER_STATE_KEY)
+        if ps is None:
+            return
+        rs = self.cs.rs
+
+        if e.channel_id == STATE_CHANNEL:
+            if isinstance(msg, M.NewRoundStepMessage):
+                if msg.height < self.cs.state.initial_height:
+                    raise ValueError("peer claims height below initial height")
+                ps.apply_new_round_step(msg)
+            elif isinstance(msg, M.NewValidBlockMessage):
+                ps.apply_new_valid_block(msg)
+            elif isinstance(msg, M.HasVoteMessage):
+                ps.apply_has_vote(msg)
+            elif isinstance(msg, M.VoteSetMaj23Message):
+                await self._handle_vote_set_maj23(peer, ps, msg)
+            else:
+                raise ValueError(f"unexpected message on state channel: {type(msg)}")
+
+        elif e.channel_id == DATA_CHANNEL:
+            if self.wait_sync:
+                return
+            if isinstance(msg, M.ProposalMessage):
+                ps.set_has_proposal(msg.proposal)
+                await self.cs.add_proposal_from_peer(msg.proposal, peer.id)
+            elif isinstance(msg, M.ProposalPOLMessage):
+                ps.apply_proposal_pol(msg)
+            elif isinstance(msg, M.BlockPartMessage):
+                ps.set_has_proposal_block_part(msg.height, msg.round_, msg.part.index)
+                await self.cs.add_block_part_from_peer(
+                    msg.height, msg.round_, msg.part, peer.id
+                )
+            else:
+                raise ValueError(f"unexpected message on data channel: {type(msg)}")
+
+        elif e.channel_id == VOTE_CHANNEL:
+            if self.wait_sync:
+                return
+            if isinstance(msg, M.VoteMessage):
+                height = rs.height
+                valsize = len(rs.validators) if rs.validators else 0
+                last_size = rs.last_commit.size() if rs.last_commit is not None else 0
+                ps.ensure_vote_bit_arrays(height, valsize)
+                ps.ensure_vote_bit_arrays(height - 1, last_size)
+                ps.set_has_vote(
+                    msg.vote.height, msg.vote.round_, msg.vote.type_,
+                    msg.vote.validator_index,
+                )
+                await self.cs.add_vote_from_peer(msg.vote, peer.id)
+            else:
+                raise ValueError(f"unexpected message on vote channel: {type(msg)}")
+
+        elif e.channel_id == VOTE_SET_BITS_CHANNEL:
+            if self.wait_sync:
+                return
+            if isinstance(msg, M.VoteSetBitsMessage):
+                our_votes = None
+                if rs.height == msg.height and rs.votes is not None:
+                    vs = (
+                        rs.votes.prevotes(msg.round_)
+                        if msg.type_ == SignedMsgType.PREVOTE
+                        else rs.votes.precommits(msg.round_)
+                    )
+                    if vs is not None:
+                        our_votes = vs.bit_array_by_block_id(msg.block_id)
+                ps.apply_vote_set_bits(msg, our_votes)
+            else:
+                raise ValueError(f"unexpected message on vote-set-bits channel: {type(msg)}")
+
+    async def _handle_vote_set_maj23(self, peer, ps: PeerState, msg: M.VoteSetMaj23Message) -> None:
+        """reactor.go:316-361: record the peer's +2/3 claim, answer with our
+        vote bits for that BlockID."""
+        rs = self.cs.rs
+        if rs.height != msg.height or rs.votes is None:
+            return
+        vs = (
+            rs.votes.prevotes(msg.round_)
+            if msg.type_ == SignedMsgType.PREVOTE
+            else rs.votes.precommits(msg.round_)
+        )
+        if vs is None:
+            return
+        vs.set_peer_maj23(peer.id, msg.block_id)
+        our_votes = vs.bit_array_by_block_id(msg.block_id)
+        resp = M.VoteSetBitsMessage(
+            height=msg.height, round_=msg.round_, type_=msg.type_,
+            block_id=msg.block_id, votes=our_votes,
+        )
+        peer.try_send(VOTE_SET_BITS_CHANNEL, codec.encode(resp))
+
+    # ------------------------------------------------------- gossip routines
+
+    async def _gossip_data_routine(self, peer, ps: PeerState) -> None:
+        """reactor.go:569-650."""
+        sleep = self.cs.config.peer_gossip_sleep_duration
+        try:
+            while peer.is_running:
+                if self.wait_sync:
+                    await asyncio.sleep(sleep)
+                    continue
+                rs = self.cs.rs
+                prs = ps.prs
+
+                # 1. send a block part for the current proposal
+                if (
+                    rs.proposal_block_parts is not None
+                    and prs.proposal_block_parts is not None
+                    and rs.proposal_block_parts.has_header(prs.proposal_block_part_set_header)
+                ):
+                    gap = rs.proposal_block_parts.bit_array().sub(prs.proposal_block_parts)
+                    index, ok = gap.pick_random()
+                    if ok:
+                        part = rs.proposal_block_parts.get_part(index)
+                        sent = await peer.send(
+                            DATA_CHANNEL,
+                            codec.encode(M.BlockPartMessage(
+                                height=rs.height, round_=rs.round_, part=part)),
+                        )
+                        if sent:
+                            ps.set_has_proposal_block_part(prs.height, prs.round_, index)
+                        continue
+
+                # 2. peer is on an older height: serve committed-block parts
+                if (
+                    prs.height != 0
+                    and rs.height != prs.height
+                    and self.cs.block_store.base() <= prs.height <= self.cs.block_store.height()
+                ):
+                    await self._gossip_catchup_part(peer, ps)
+                    await asyncio.sleep(sleep)
+                    continue
+
+                # 3. different height/round: nothing to send
+                if rs.height != prs.height or rs.round_ != prs.round_:
+                    await asyncio.sleep(sleep)
+                    continue
+
+                # 4. send the proposal (+POL)
+                if rs.proposal is not None and not prs.proposal:
+                    await peer.send(
+                        DATA_CHANNEL, codec.encode(M.ProposalMessage(proposal=rs.proposal))
+                    )
+                    ps.set_has_proposal(rs.proposal)
+                    if 0 <= rs.proposal.pol_round:
+                        pol = rs.votes.prevotes(rs.proposal.pol_round)
+                        if pol is not None:
+                            await peer.send(
+                                DATA_CHANNEL,
+                                codec.encode(M.ProposalPOLMessage(
+                                    height=rs.height,
+                                    proposal_pol_round=rs.proposal.pol_round,
+                                    proposal_pol=pol.bit_array(),
+                                )),
+                            )
+                    continue
+                await asyncio.sleep(sleep)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 - gossip must not crash the reactor
+            self.logger.error("gossip_data routine failed", peer=peer.id[:10], err=str(e))
+
+    async def _gossip_catchup_part(self, peer, ps: PeerState) -> None:
+        """reactor.go:652-735 gossipDataForCatchup."""
+        prs = ps.prs
+        meta = self.cs.block_store.load_block_meta(prs.height)
+        if meta is None:
+            return
+        # make sure the peer's part-set header matches the stored block
+        if prs.proposal_block_parts is None:
+            ps.init_proposal_block_parts(meta.block_id.part_set_header)
+            return
+        if prs.proposal_block_part_set_header != meta.block_id.part_set_header:
+            return
+        # any part index the peer lacks
+        index, ok = prs.proposal_block_parts.not_().pick_random()
+        if not ok:
+            return
+        part = self.cs.block_store.load_block_part(prs.height, index)
+        if part is None:
+            return
+        sent = await peer.send(
+            DATA_CHANNEL,
+            codec.encode(M.BlockPartMessage(height=prs.height, round_=prs.round_, part=part)),
+        )
+        if sent:
+            ps.set_has_proposal_block_part(prs.height, prs.round_, index)
+
+    async def _gossip_votes_routine(self, peer, ps: PeerState) -> None:
+        """reactor.go:737-830."""
+        sleep = self.cs.config.peer_gossip_sleep_duration
+        try:
+            while peer.is_running:
+                if self.wait_sync:
+                    await asyncio.sleep(sleep)
+                    continue
+                rs = self.cs.rs
+                prs = ps.prs
+
+                if rs.height == prs.height:
+                    if await self._gossip_votes_for_height(peer, ps):
+                        continue
+                # peer one height behind: our last commit has what it needs
+                elif prs.height != 0 and rs.height == prs.height + 1 and rs.last_commit is not None:
+                    if await self._pick_send_vote(peer, ps, rs.last_commit):
+                        continue
+                # peer further behind: serve the stored commit at its height
+                elif (
+                    prs.height != 0
+                    and rs.height >= prs.height + 2
+                    and self.cs.block_store.base() <= prs.height
+                ):
+                    commit = self.cs.block_store.load_block_commit(prs.height)
+                    if commit is not None and await self._pick_send_vote(
+                        peer, ps, _CommitVoteSource(commit)
+                    ):
+                        continue
+                await asyncio.sleep(sleep)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            self.logger.error("gossip_votes routine failed", peer=peer.id[:10], err=str(e))
+
+    async def _gossip_votes_for_height(self, peer, ps: PeerState) -> bool:
+        """reactor.go:832-894."""
+        rs = self.cs.rs
+        prs = ps.prs
+        # peer still in NewHeight: needs our last commit
+        if prs.step == RoundStepType.NEW_HEIGHT and rs.last_commit is not None:
+            if await self._pick_send_vote(peer, ps, rs.last_commit):
+                return True
+        # peer in Propose, has declared a POL round: send those prevotes
+        if (
+            prs.step <= RoundStepType.PROPOSE
+            and prs.round_ != -1
+            and prs.round_ <= rs.round_
+            and prs.proposal_pol_round != -1
+        ):
+            pol = rs.votes.prevotes(prs.proposal_pol_round)
+            if pol is not None and await self._pick_send_vote(peer, ps, pol):
+                return True
+        # prevotes for the peer's round
+        if prs.step <= RoundStepType.PREVOTE_WAIT and -1 != prs.round_ <= rs.round_:
+            vs = rs.votes.prevotes(prs.round_)
+            if vs is not None and await self._pick_send_vote(peer, ps, vs):
+                return True
+        # precommits for the peer's round
+        if prs.step <= RoundStepType.PRECOMMIT_WAIT and -1 != prs.round_ <= rs.round_:
+            vs = rs.votes.precommits(prs.round_)
+            if vs is not None and await self._pick_send_vote(peer, ps, vs):
+                return True
+        # any round's prevotes the peer can use
+        if prs.round_ != -1 and prs.round_ <= rs.round_:
+            vs = rs.votes.prevotes(prs.round_)
+            if vs is not None and await self._pick_send_vote(peer, ps, vs):
+                return True
+        if prs.proposal_pol_round != -1:
+            pol = rs.votes.prevotes(prs.proposal_pol_round)
+            if pol is not None and await self._pick_send_vote(peer, ps, pol):
+                return True
+        return False
+
+    async def _pick_send_vote(self, peer, ps: PeerState, votes) -> bool:
+        """reactor.go:1171 PickSendVote."""
+        vote = ps.pick_vote_to_send(votes)
+        if vote is None:
+            return False
+        sent = await peer.send(VOTE_CHANNEL, codec.encode(M.VoteMessage(vote=vote)))
+        if sent:
+            ps.set_has_vote(vote.height, vote.round_, vote.type_, vote.validator_index)
+        return sent
+
+    async def _query_maj23_routine(self, peer, ps: PeerState) -> None:
+        """reactor.go:896-1000: periodically tell peers about our +2/3
+        majorities so they can return any votes we miss."""
+        sleep = self.cs.config.peer_query_maj23_sleep_duration
+        try:
+            while peer.is_running:
+                await asyncio.sleep(sleep)
+                if self.wait_sync:
+                    continue
+                rs = self.cs.rs
+                prs = ps.prs
+                if rs.height != prs.height or rs.votes is None:
+                    continue
+                for type_, vs in (
+                    (SignedMsgType.PREVOTE, rs.votes.prevotes(prs.round_)),
+                    (SignedMsgType.PRECOMMIT, rs.votes.precommits(prs.round_)),
+                ):
+                    if vs is None:
+                        continue
+                    block_id, ok = vs.two_thirds_majority()
+                    if ok:
+                        peer.try_send(
+                            STATE_CHANNEL,
+                            codec.encode(M.VoteSetMaj23Message(
+                                height=prs.height, round_=prs.round_,
+                                type_=type_, block_id=block_id,
+                            )),
+                        )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            self.logger.error("query_maj23 routine failed", peer=peer.id[:10], err=str(e))

@@ -161,6 +161,10 @@ class ConsensusState(BaseService):
             commit_round=-1,
         )
         self.state = state
+        if self.event_switch is not None:
+            # announce the height transition (reference updateToState ->
+            # newStep -> EventNewRoundStep) so peers learn we moved on
+            self.event_switch.fire("NewRoundStep", self.rs)
 
     def _schedule_round_0(self, rs: RoundState) -> None:
         sleep = max(0.0, (rs.start_time.unix_ns() - cmttime.now().unix_ns()) / 1e9)
@@ -423,6 +427,8 @@ class ConsensusState(BaseService):
                 rs.valid_round = rs.round_
                 rs.valid_block = rs.proposal_block
                 rs.valid_block_parts = rs.proposal_block_parts
+                if self.event_switch is not None:
+                    self.event_switch.fire("ValidBlock", rs)
         if rs.step <= RoundStepType.PROPOSE and self._is_proposal_complete():
             await self._enter_prevote(height, rs.round_)
             if has_maj:
@@ -568,6 +574,10 @@ class ConsensusState(BaseService):
         if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
             rs.proposal_block = None
             rs.proposal_block_parts = PartSet.from_header(block_id.part_set_header)
+        if self.event_switch is not None:
+            # announce the committed block's part-set so lagging peers fetch
+            # the right parts (reference EventValidBlock in enterCommit)
+            self.event_switch.fire("ValidBlock", rs)
         await self._try_finalize_commit(height)
 
     async def _try_finalize_commit(self, height: int) -> None:

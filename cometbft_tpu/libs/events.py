@@ -32,3 +32,6 @@ class EventSwitch:
     def fire_event(self, event: str, data: Any = None) -> None:
         for cb in list(self._listeners.get(event, {}).values()):
             cb(data)
+
+    # short alias used by the consensus hot path
+    fire = fire_event

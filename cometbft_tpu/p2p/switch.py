@@ -131,9 +131,19 @@ class Switch(BaseService):
 
     async def _add_peer(self, up: UpgradedConn, persistent: bool = False) -> Peer:
         node_id = up.node_info.node_id
-        if node_id in self.peers:
-            up.conn.close()
-            raise ErrDuplicatePeer(node_id)
+        existing = self.peers.get(node_id)
+        if existing is not None:
+            # Simultaneous-dial tie-break: both sides keep ONLY the
+            # connection dialed by the lower node id, so they agree on
+            # which TCP conn survives and the mutual-close livelock of
+            # naive dedup cannot happen (switch.go addPeer dedup, with a
+            # deterministic winner instead of first-wins).
+            my_id = self.transport.node_key.id()
+            new_is_canonical = (my_id < node_id) == up.outbound
+            if not new_is_canonical:
+                up.conn.close()
+                raise ErrDuplicatePeer(node_id)
+            await self._stop_peer(existing, "replaced by canonical duplicate conn")
         persistent = persistent or node_id in self.persistent_addrs
         peer = Peer(
             conn=up.conn,
@@ -172,7 +182,9 @@ class Switch(BaseService):
 
     async def stop_peer_for_error(self, peer: Peer, reason: object) -> None:
         """switch.go:335: drop the peer; redial if persistent."""
-        if peer.id not in self.peers:
+        if self.peers.get(peer.id) is not peer:
+            # a late error from an already-replaced conn (duplicate
+            # tie-break) must not tear down the canonical replacement
             return
         self.logger.info("stopping peer for error", peer=peer.id[:10], err=str(reason))
         await self._stop_peer(peer, reason)
@@ -191,7 +203,8 @@ class Switch(BaseService):
             self._reconnecting.discard(node_id)
 
     async def _stop_peer(self, peer: Peer, reason: object) -> None:
-        self.peers.pop(peer.id, None)
+        if self.peers.get(peer.id) is peer:
+            self.peers.pop(peer.id, None)
         try:
             await peer.stop()
         except Exception:  # noqa: BLE001
@@ -204,9 +217,10 @@ class Switch(BaseService):
 
     # ------------------------------------------------------------ broadcast
 
-    async def broadcast(self, chan_id: int, msg: bytes) -> None:
+    def broadcast(self, chan_id: int, msg: bytes) -> None:
         """switch.go:274 Broadcast: try_send to every peer (drops on full
-        queues — gossip routines provide reliability)."""
+        queues — gossip routines provide reliability). Sync so event-switch
+        callbacks can call it inline."""
         for peer in list(self.peers.values()):
             peer.try_send(chan_id, msg)
 

@@ -16,7 +16,8 @@ from dataclasses import dataclass, field
 from cometbft_tpu.abci.kvstore import KVStoreApplication
 from cometbft_tpu.consensus import ConsensusState
 from cometbft_tpu.consensus import messages as M
-from cometbft_tpu.consensus.config import ConsensusConfig, test_consensus_config
+from cometbft_tpu.consensus.config import ConsensusConfig
+from cometbft_tpu.consensus.config import test_consensus_config as make_test_config
 from cometbft_tpu.crypto import ed25519
 from cometbft_tpu.evidence import EvidencePool
 from cometbft_tpu.mempool.mempool import CListMempool, MempoolConfig
@@ -122,7 +123,7 @@ async def make_net(
             state_store, conns.consensus, mempool, evidence_pool=ev_pool
         )
         cs = ConsensusState(
-            config=config or test_consensus_config(),
+            config=config or make_test_config(),
             state=state,
             block_exec=block_exec,
             block_store=block_store,

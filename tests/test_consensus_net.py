@@ -9,7 +9,7 @@ block."""
 import asyncio
 import secrets
 
-from cometbft_tpu.consensus.config import test_consensus_config
+from cometbft_tpu.consensus.config import test_consensus_config as make_test_config
 from cometbft_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
 from cometbft_tpu.types.vote import Vote
 from cometbft_tpu.utils import cmttime
@@ -47,7 +47,7 @@ def test_four_validator_net_batch_vote_verification():
     through the batch verifier; own votes stay serial)."""
 
     async def main():
-        cfg = test_consensus_config()
+        cfg = make_test_config()
         cfg.batch_vote_verification = True
         net = await make_net(4, config=cfg)
         await net.start()

@@ -1,0 +1,101 @@
+"""Consensus over real TCP: the reactor-level integration tests.
+
+Reference analog: consensus/reactor_test.go (N validators gossiping over
+the p2p switch) + the round-1/2 VERDICT "done" bar: validators over real
+encrypted TCP commit 20+ heights; a killed peer reconnects and catches up.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from cometbft_tpu.consensus.config import test_consensus_config as make_test_config
+
+from tests.tcp_net_harness import make_tcp_net
+
+
+def test_tcp_net_commits_blocks():
+    """4 validators over TCP from genesis: 5+ heights, identical chains."""
+
+    async def main():
+        net = await make_tcp_net(4)
+        await net.start()
+        try:
+            await net.wait_for_height(5, timeout=60)
+            # all apps agree on the chain
+            h = min(n.block_store.height() for n in net.nodes)
+            assert h >= 5
+            for height in range(1, h + 1):
+                hashes = {n.block_store.load_block(height).hash() for n in net.nodes}
+                assert len(hashes) == 1, f"chain fork at height {height}"
+        finally:
+            await net.stop()
+
+    asyncio.run(main())
+
+
+def test_tcp_net_20_heights_with_txs():
+    """The VERDICT item-1 'done' bar: 20+ heights over encrypted TCP with
+    txs flowing through the mempool reactor."""
+
+    async def main():
+        net = await make_tcp_net(4)
+        await net.start()
+        try:
+            await net.wait_for_height(2, timeout=60)
+            # inject txs at one node; the mempool reactor must spread them
+            for i in range(10):
+                await net.nodes[0].mempool.check_tx(f"k{i}=v{i}".encode())
+            await net.wait_for_height(20, timeout=120)
+            # txs were committed somewhere in the chain
+            total_txs = 0
+            h = min(n.block_store.height() for n in net.nodes)
+            for height in range(1, h + 1):
+                total_txs += len(net.nodes[0].block_store.load_block(height).data.txs)
+            assert total_txs >= 10, f"only {total_txs} txs committed"
+            # every node committed the same app hash at the common height
+            app_hashes = {
+                bytes(n.block_store.load_block(h).header.app_hash) for n in net.nodes
+            }
+            assert len(app_hashes) == 1, "app state diverged"
+        finally:
+            await net.stop()
+
+    asyncio.run(main())
+
+
+def test_tcp_net_peer_kill_and_catchup():
+    """Kill one validator's switch mid-chain; the remaining 3 keep
+    committing (quorum holds); the revived peer reconnects and catches up
+    via gossip-catchup (parts + stored commits)."""
+
+    async def main():
+        net = await make_tcp_net(4)
+        await net.start()
+        try:
+            await net.wait_for_height(3, timeout=60)
+            victim = net.nodes[3]
+            others = net.nodes[:3]
+            await victim.switch.stop()
+            h_at_kill = victim.block_store.height()
+            # 3/4 validators = 75% > 2/3: chain must continue
+            await net.wait_for_height(h_at_kill + 4, timeout=60, nodes=others)
+
+            # revive: fresh switch/transport over the same stores/state
+            # (switch stop cascades into the consensus service, so both
+            # must be reset — the process-restart analog)
+            victim.switch.reset()
+            victim.cs.reset()
+            victim.transport._accept_queue = asyncio.Queue(64)
+            victim.addr = await victim.transport.listen("127.0.0.1:0")
+            await victim.switch.start()
+            await victim.switch.dial_peers_async(
+                [n.p2p_addr for n in others], persistent=True
+            )
+            target = max(n.block_store.height() for n in others) + 2
+            await net.wait_for_height(target, timeout=90)
+            assert victim.block_store.height() >= target
+        finally:
+            await net.stop()
+
+    asyncio.run(main())
