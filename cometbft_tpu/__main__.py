@@ -1,0 +1,5 @@
+import sys
+
+from cometbft_tpu.cmd import main
+
+sys.exit(main())

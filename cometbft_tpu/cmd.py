@@ -1,0 +1,204 @@
+"""Command-line interface.
+
+Reference: cmd/cometbft/main.go:16-46 (cobra command tree). argparse is
+the idiomatic Python analog. Commands:
+
+  init        write config.toml, genesis.json, node + validator keys
+  start       run a node from the home dir
+  testnet     generate N validator home dirs wired as persistent peers
+  show-node-id
+  show-validator
+  version
+
+Env: CMT_HOME overrides --home (main.go:48 env prefix analog).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+
+from cometbft_tpu.version import CMTSemVer as VERSION
+
+
+def _home(args) -> str:
+    return args.home or os.environ.get("CMT_HOME", os.path.expanduser("~/.cometbft_tpu"))
+
+
+def cmd_init(args) -> int:
+    from cometbft_tpu.node import init_files
+
+    home = _home(args)
+    init_files(home, chain_id=args.chain_id, moniker=args.moniker)
+    print(f"Initialized node home at {home}")
+    return 0
+
+
+def cmd_start(args) -> int:
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.node import Node
+
+    home = _home(args)
+    config = Config.load(home)
+    if args.proxy_app:
+        config.base.proxy_app = args.proxy_app
+    if args.p2p_laddr:
+        config.p2p.laddr = args.p2p_laddr
+    if args.rpc_laddr:
+        config.rpc.laddr = args.rpc_laddr
+    if args.persistent_peers:
+        config.p2p.persistent_peers = args.persistent_peers
+    if args.crypto_backend:
+        config.crypto.backend = args.crypto_backend
+    if args.log_level:
+        config.base.log_level = args.log_level
+
+    async def run():
+        node = Node(config)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await node.start()
+        node.logger.info("node started", node_id=node.node_key.id(),
+                         chain=node.genesis_doc.chain_id)
+        await stop.wait()
+        node.logger.info("shutting down")
+        await node.stop()
+
+    asyncio.run(run())
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """cmd/cometbft/commands/testnet.go: N validator homes under --o, each
+    with the full genesis and persistent_peers pointing at the others."""
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.node import init_files
+    from cometbft_tpu.p2p.key import NodeKey
+    from cometbft_tpu.privval.file_pv import FilePV
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.utils import cmttime
+
+    n = args.v
+    out = args.o
+    chain_id = args.chain_id or f"chain-{os.urandom(3).hex()}"
+    homes = [os.path.join(out, f"node{i}") for i in range(n)]
+    pvs, node_keys = [], []
+    for home in homes:
+        cfg = Config(home=home)
+        os.makedirs(os.path.join(home, "config"), exist_ok=True)
+        os.makedirs(os.path.join(home, "data"), exist_ok=True)
+        pvs.append(FilePV.load_or_generate(
+            cfg.priv_validator_key_path(), cfg.priv_validator_state_path()))
+        node_keys.append(NodeKey.load_or_gen(cfg.node_key_path()))
+
+    gdoc = GenesisDoc(
+        genesis_time=cmttime.canonical_now_ms(),
+        chain_id=chain_id,
+        validators=[
+            GenesisValidator(
+                address=pv.get_pub_key().address(),
+                pub_key=pv.get_pub_key(),
+                power=1,
+                name=f"node{i}",
+            )
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    gdoc.validate_and_complete()
+
+    base_p2p, base_rpc = args.starting_port, args.starting_port + 1000
+    addrs = [
+        f"{node_keys[i].id()}@127.0.0.1:{base_p2p + i}" for i in range(n)
+    ]
+    for i, home in enumerate(homes):
+        cfg = Config(home=home)
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{base_p2p + i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{base_rpc + i}"
+        cfg.p2p.persistent_peers = ",".join(a for j, a in enumerate(addrs) if j != i)
+        # N processes sharing one host cannot share one TPU chip; local
+        # testnets verify on CPU (flip per-node for a real multi-host net)
+        cfg.crypto.backend = "cpu"
+        cfg.save()
+        with open(cfg.genesis_path(), "w") as f:
+            f.write(gdoc.to_json())
+    print(f"Successfully initialized {n} node directories under {out} (chain {chain_id})")
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.p2p.key import NodeKey
+
+    cfg = Config.load(_home(args))
+    print(NodeKey.load_or_gen(cfg.node_key_path()).id())
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    import base64
+
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.privval.file_pv import FilePV
+
+    cfg = Config.load(_home(args))
+    pv = FilePV.load_or_generate(
+        cfg.priv_validator_key_path(), cfg.priv_validator_state_path())
+    pk = pv.get_pub_key()
+    print(json.dumps({"type": pk.type_(),
+                      "value": base64.b64encode(pk.bytes_()).decode()}))
+    return 0
+
+
+def cmd_version(_args) -> int:
+    print(VERSION)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="cometbft_tpu",
+                                description="TPU-native BFT consensus engine")
+    p.add_argument("--home", default=None, help="node home directory")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("init", help="initialize a node home dir")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--moniker", default="node")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("start", help="run the node")
+    sp.add_argument("--proxy_app", default="")
+    sp.add_argument("--p2p.laddr", dest="p2p_laddr", default="")
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
+    sp.add_argument("--p2p.persistent_peers", dest="persistent_peers", default="")
+    sp.add_argument("--crypto.backend", dest="crypto_backend", default="",
+                    choices=["", "cpu", "tpu", "auto"])
+    sp.add_argument("--log_level", default="")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("testnet", help="generate a local testnet")
+    sp.add_argument("--v", type=int, default=4, help="number of validators")
+    sp.add_argument("--o", default="./mytestnet", help="output directory")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--starting-port", type=int, default=26656)
+    sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("show-node-id")
+    sp.set_defaults(fn=cmd_show_node_id)
+    sp = sub.add_parser("show-validator")
+    sp.set_defaults(fn=cmd_show_validator)
+    sp = sub.add_parser("version")
+    sp.set_defaults(fn=cmd_version)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+from cometbft_tpu.config.config import (
+    BaseConfig,
+    BlockSyncConfig,
+    Config,
+    InstrumentationConfig,
+    P2PConfig,
+    RPCConfig,
+    StateSyncConfig,
+    StorageConfig,
+    TxIndexConfig,
+    default_config,
+    test_config,
+)
+
+__all__ = [
+    "BaseConfig",
+    "BlockSyncConfig",
+    "Config",
+    "InstrumentationConfig",
+    "P2PConfig",
+    "RPCConfig",
+    "StateSyncConfig",
+    "StorageConfig",
+    "TxIndexConfig",
+    "default_config",
+    "test_config",
+]

@@ -1,0 +1,323 @@
+"""Unified node configuration tree.
+
+Reference: config/config.go:76-1445 — one Config struct with 12 sections,
+per-section ValidateBasic, serialized to config.toml (config/toml.go) and
+loaded with flag/env layering. Here: dataclass sections, tomllib loading,
+a hand-rolled TOML writer (stdlib has no writer), and `crypto.backend`
+as the TPU framework's addition (SURVEY §5.6).
+
+Layout under the node home (config.go:208-236):
+  config/config.toml            this file
+  config/genesis.json           genesis doc
+  config/node_key.json          p2p identity
+  config/priv_validator_key.json
+  data/priv_validator_state.json
+  data/blockstore.db, data/state.db, data/evidence.db
+  data/cs.wal/                  consensus WAL
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field, fields
+
+from cometbft_tpu.consensus.config import ConsensusConfig
+from cometbft_tpu.mempool.mempool import MempoolConfig
+
+
+@dataclass
+class BaseConfig:
+    """config.go:76-206."""
+
+    moniker: str = "anonymous"
+    proxy_app: str = "kvstore"  # "kvstore", "noop", or "tcp://host:port"
+    abci: str = "local"  # "local" | "socket"
+    db_backend: str = "sqlite"  # "sqlite" | "memdb"
+    db_dir: str = "data"
+    log_level: str = "info"
+    log_format: str = "logfmt"  # "logfmt" | "json"
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    priv_validator_laddr: str = ""  # remote signer listen addr
+    node_key_file: str = "config/node_key.json"
+    filter_peers: bool = False
+
+    def validate_basic(self) -> None:
+        if self.abci not in ("local", "socket"):
+            raise ValueError(f"unknown abci transport {self.abci!r}")
+        if self.db_backend not in ("sqlite", "memdb"):
+            raise ValueError(f"unknown db_backend {self.db_backend!r}")
+
+
+@dataclass
+class CryptoConfig:
+    """The TPU framework's addition (SURVEY §5.6, BASELINE.json): which
+    backend verifies signature batches."""
+
+    backend: str = "auto"  # "cpu" | "tpu" | "auto"
+    # coalesce at most this many signatures into one device batch
+    max_batch_size: int = 16384
+
+    def validate_basic(self) -> None:
+        if self.backend not in ("cpu", "tpu", "auto"):
+            raise ValueError(f"unknown crypto backend {self.backend!r}")
+
+
+@dataclass
+class RPCConfig:
+    """config.go:392-576."""
+
+    laddr: str = "tcp://127.0.0.1:26657"
+    cors_allowed_origins: list[str] = field(default_factory=list)
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit: float = 10.0
+    max_body_bytes: int = 1_000_000
+    max_header_bytes: int = 1 << 20
+    pprof_laddr: str = ""
+
+    def validate_basic(self) -> None:
+        if self.max_open_connections < 0:
+            raise ValueError("max_open_connections cannot be negative")
+        if self.timeout_broadcast_tx_commit <= 0:
+            raise ValueError("timeout_broadcast_tx_commit must be positive")
+
+
+@dataclass
+class P2PConfig:
+    """config.go:592-810."""
+
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""  # comma-separated id@host:port
+    persistent_peers: str = ""
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    flush_throttle_timeout: float = 0.1
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5_120_000
+    recv_rate: int = 5_120_000
+    pex: bool = True
+    seed_mode: bool = False
+    addr_book_file: str = "config/addrbook.json"
+    addr_book_strict: bool = True
+    handshake_timeout: float = 20.0
+    dial_timeout: float = 3.0
+
+    def validate_basic(self) -> None:
+        if self.max_num_inbound_peers < 0 or self.max_num_outbound_peers < 0:
+            raise ValueError("peer limits cannot be negative")
+        if self.send_rate < 0 or self.recv_rate < 0:
+            raise ValueError("rates cannot be negative")
+
+    def persistent_peer_list(self) -> list[str]:
+        return [p.strip() for p in self.persistent_peers.split(",") if p.strip()]
+
+    def seed_list(self) -> list[str]:
+        return [p.strip() for p in self.seeds.split(",") if p.strip()]
+
+
+@dataclass
+class BlockSyncConfig:
+    """config.go:1064-1086."""
+
+    enable: bool = True
+    version: str = "v0"
+
+    def validate_basic(self) -> None:
+        if self.version != "v0":
+            raise ValueError(f"unknown blocksync version {self.version!r}")
+
+
+@dataclass
+class StateSyncConfig:
+    """config.go:966-1062."""
+
+    enable: bool = False
+    rpc_servers: list[str] = field(default_factory=list)
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period: float = 168 * 3600.0  # 1 week
+    discovery_time: float = 15.0
+    chunk_request_timeout: float = 10.0
+
+    def validate_basic(self) -> None:
+        if not self.enable:
+            return
+        if len(self.rpc_servers) < 2:
+            raise ValueError("statesync requires >=2 rpc_servers")
+        if self.trust_height <= 0:
+            raise ValueError("statesync requires trust_height > 0")
+        if not self.trust_hash:
+            raise ValueError("statesync requires trust_hash")
+
+
+@dataclass
+class StorageConfig:
+    """config.go:1240-1265."""
+
+    discard_abci_responses: bool = False
+
+
+@dataclass
+class TxIndexConfig:
+    """config.go:1279-1302."""
+
+    indexer: str = "kv"  # "kv" | "null"
+
+    def validate_basic(self) -> None:
+        if self.indexer not in ("kv", "null"):
+            raise ValueError(f"unknown indexer {self.indexer!r}")
+
+
+@dataclass
+class InstrumentationConfig:
+    """config.go:1333-1378."""
+
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    namespace: str = "cometbft"
+
+
+@dataclass
+class WALConfig:
+    """Consensus WAL file knobs (reference: part of ConsensusConfig,
+    config.go:1096 WalPath + libs/autofile group limits)."""
+
+    wal_dir: str = "data/cs.wal"
+    segment_size_bytes: int = 8 << 20  # rotate segments at 8 MB
+    max_segments: int = 32
+
+
+@dataclass
+class Config:
+    """The root tree (config.go:76)."""
+
+    base: BaseConfig = field(default_factory=BaseConfig)
+    crypto: CryptoConfig = field(default_factory=CryptoConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    wal: WALConfig = field(default_factory=WALConfig)
+    block_sync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    state_sync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
+    home: str = "."  # set at load time, not serialized
+
+    def validate_basic(self) -> None:
+        """config.go:318 ValidateBasic: every section that defines one."""
+        for section in (self.base, self.crypto, self.rpc, self.p2p,
+                        self.block_sync, self.state_sync, self.tx_index):
+            section.validate_basic()
+
+    # ------------------------------------------------------------ paths
+
+    def _abs(self, rel: str) -> str:
+        return rel if os.path.isabs(rel) else os.path.join(self.home, rel)
+
+    def genesis_path(self) -> str:
+        return self._abs(self.base.genesis_file)
+
+    def node_key_path(self) -> str:
+        return self._abs(self.base.node_key_file)
+
+    def priv_validator_key_path(self) -> str:
+        return self._abs(self.base.priv_validator_key_file)
+
+    def priv_validator_state_path(self) -> str:
+        return self._abs(self.base.priv_validator_state_file)
+
+    def db_path(self, name: str) -> str:
+        return self._abs(os.path.join(self.base.db_dir, f"{name}.db"))
+
+    def wal_path(self) -> str:
+        return self._abs(self.wal.wal_dir)
+
+    # ------------------------------------------------------------- TOML
+
+    _SECTIONS = (
+        ("base", ""),  # base fields live at top level, like the reference
+        ("crypto", "crypto"),
+        ("rpc", "rpc"),
+        ("p2p", "p2p"),
+        ("mempool", "mempool"),
+        ("consensus", "consensus"),
+        ("wal", "wal"),
+        ("block_sync", "blocksync"),
+        ("state_sync", "statesync"),
+        ("storage", "storage"),
+        ("tx_index", "tx_index"),
+        ("instrumentation", "instrumentation"),
+    )
+
+    def to_toml(self) -> str:
+        out = ["# cometbft_tpu node configuration\n"]
+        for attr, section in self._SECTIONS:
+            obj = getattr(self, attr)
+            if section:
+                out.append(f"\n[{section}]\n")
+            for f in fields(obj):
+                out.append(f"{f.name} = {_toml_value(getattr(obj, f.name))}\n")
+        return "".join(out)
+
+    def save(self, path: str | None = None) -> str:
+        path = path or os.path.join(self.home, "config", "config.toml")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.to_toml())
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def load(cls, home: str) -> "Config":
+        """Load config/config.toml under home; missing keys keep defaults
+        (the reference's viper layering, minus env/flags which the CLI
+        applies on top)."""
+        cfg = cls(home=home)
+        path = os.path.join(home, "config", "config.toml")
+        if not os.path.exists(path):
+            return cfg
+        with open(path, "rb") as f:
+            doc = tomllib.load(f)
+        for attr, section in cls._SECTIONS:
+            obj = getattr(cfg, attr)
+            src = doc if not section else doc.get(section, {})
+            for fld in fields(obj):
+                if fld.name in src:
+                    setattr(obj, fld.name, src[fld.name])
+        return cfg
+
+
+def _toml_value(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, list):
+        return "[" + ", ".join(_toml_value(x) for x in v) + "]"
+    raise TypeError(f"cannot TOML-encode {type(v)}")
+
+
+def default_config(home: str = ".") -> Config:
+    return Config(home=home)
+
+
+def test_config(home: str = ".") -> Config:
+    """Millisecond-scale timeouts (reference config.TestConfig)."""
+    from cometbft_tpu.consensus.config import test_consensus_config
+
+    cfg = Config(home=home, consensus=test_consensus_config())
+    cfg.base.db_backend = "memdb"
+    cfg.crypto.backend = "cpu"
+    cfg.p2p.send_rate = 50_000_000
+    cfg.p2p.recv_rate = 50_000_000
+    return cfg

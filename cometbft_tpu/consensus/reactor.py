@@ -109,8 +109,10 @@ class ConsensusReactor(Reactor):
             await self.cs.stop()
 
     async def switch_to_consensus(self, state) -> None:
-        """blocksync -> consensus handoff (reactor.go:115 SwitchToConsensus)."""
-        self.cs.update_to_state(state)
+        """blocksync -> consensus handoff (reactor.go:115 SwitchToConsensus).
+        sync_to_state also reconstructs LastCommit so this node can propose
+        (reference calls reconstructLastCommit here)."""
+        self.cs.sync_to_state(state)
         self.wait_sync = False
         await self.cs.start()
 

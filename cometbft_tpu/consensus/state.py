@@ -31,6 +31,7 @@ from cometbft_tpu.consensus.height_vote_set import HeightVoteSet
 from cometbft_tpu.consensus.round_state import RoundState, RoundStepType
 from cometbft_tpu.consensus.ticker import TimeoutInfo, TimeoutTicker
 from cometbft_tpu.consensus.wal import WAL, EndHeightMessage
+from cometbft_tpu.libs import fail
 from cometbft_tpu.libs import log as cmtlog
 from cometbft_tpu.libs.service import BaseService, TaskRunner
 from cometbft_tpu.privval.file_pv import PrivValidator
@@ -42,7 +43,12 @@ from cometbft_tpu.types.commit import Commit, ExtendedCommit, ExtendedCommitSig
 from cometbft_tpu.types.part_set import PartSet
 from cometbft_tpu.types.proposal import Proposal
 from cometbft_tpu.types.vote import Vote
-from cometbft_tpu.types.vote_set import ErrVoteConflictingVotes, VoteSet
+from cometbft_tpu.types.vote_set import (
+    ErrVoteConflictingVotes,
+    VoteSet,
+    commit_to_vote_set,
+    extended_commit_to_vote_set,
+)
 from cometbft_tpu.utils import cmttime
 
 BLOCK_PART_SIZE = 65536
@@ -106,7 +112,7 @@ class ConsensusState(BaseService):
         self.do_prevote: Callable = self._default_do_prevote
         self.set_proposal_fn: Callable = self._default_set_proposal
 
-        self.update_to_state(state)
+        self.sync_to_state(state)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -131,6 +137,23 @@ class ConsensusState(BaseService):
             raise RuntimeError(
                 f"updateToState expected state height {self.rs.height}, got {state.last_block_height}"
             )
+        if (
+            self.state is not None
+            and self.state.last_block_height > 0
+            and state.last_block_height <= self.state.last_block_height
+        ):
+            # reference state.go updateToState: a non-advancing state (e.g.
+            # the post-handshake re-sync on restart) must not reset the
+            # RoundState — it would wipe the reconstructed LastCommit.
+            self.logger.debug(
+                "ignoring update_to_state; state height not greater",
+                new=state.last_block_height, old=self.state.last_block_height,
+            )
+            # still signal the step: peers depend on an up-to-date view
+            # (reference updateToState calls newStep() in this branch)
+            if self.event_switch is not None:
+                self.event_switch.fire("NewRoundStep", self.rs)
+            return
         validators = state.validators
         last_precommits: VoteSet | None = None
         if self.rs.commit_round > -1 and self.rs.votes is not None:
@@ -165,6 +188,40 @@ class ConsensusState(BaseService):
             # announce the height transition (reference updateToState ->
             # newStep -> EventNewRoundStep) so peers learn we moved on
             self.event_switch.fire("NewRoundStep", self.rs)
+
+    def sync_to_state(self, state: State) -> None:
+        """Boot-time state sync (NewState / post-handshake): update the
+        RoundState and, if the resulting height needs a LastCommit the
+        RoundState doesn't carry, reconstruct it from the block store.
+        The single entry point for constructor, node handshake, and the
+        blocksync handoff."""
+        self.update_to_state(state)
+        if self.rs.last_commit is None and self.state.last_block_height > 0:
+            self._reconstruct_last_commit(self.state)
+
+    def _reconstruct_last_commit(self, state: State) -> None:
+        """state.go reconstructLastCommit: on restart, rebuild the LastCommit
+        precommit VoteSet from the block store's seen (extended) commit so the
+        proposer can build height last_block_height+1."""
+        h = state.last_block_height
+        ext_enabled = state.consensus_params.abci.vote_extensions_enabled(h)
+        if ext_enabled:
+            ec = self.block_store.load_block_extended_commit(h)
+            if ec is None:
+                raise RuntimeError(
+                    f"failed to reconstruct last extended commit; commit for height {h} not found"
+                )
+            votes = extended_commit_to_vote_set(state.chain_id, ec, state.last_validators)
+        else:
+            sc = self.block_store.load_seen_commit(h)
+            if sc is None:
+                raise RuntimeError(
+                    f"failed to reconstruct last commit; seen commit for height {h} not found"
+                )
+            votes = commit_to_vote_set(state.chain_id, sc, state.last_validators)
+        if not votes.has_two_thirds_majority():
+            raise RuntimeError("failed to reconstruct last commit; does not have +2/3 maj")
+        self.rs.last_commit = votes
 
     def _schedule_round_0(self, rs: RoundState) -> None:
         sleep = max(0.0, (rs.start_time.unix_ns() - cmttime.now().unix_ns()) / 1e9)
@@ -601,6 +658,7 @@ class ConsensusState(BaseService):
         block_id, _ = precommits.two_thirds_majority()
         self.block_exec.validate_block(self.state, block)
 
+        fail.fail(0)  # state.go:1777
         if self.block_store.height() < block.header.height:
             seen_extended = rs.votes.precommits(rs.commit_round).make_extended_commit()
             if self.state.consensus_params.abci.vote_extensions_enabled(block.header.height):
@@ -608,8 +666,10 @@ class ConsensusState(BaseService):
             else:
                 self.block_store.save_block(block, block_parts, seen_extended.to_commit())
 
+        fail.fail(1)  # state.go:1794
         if self.wal is not None:
             self.wal.write_sync(EndHeightMessage(height))  # state.go:1810 fsync
+        fail.fail(2)  # state.go:1817 — the committed-but-unsaved crash window
 
         new_state = await self.block_exec.apply_block(self.state, block_id, block)
         self.logger.info(

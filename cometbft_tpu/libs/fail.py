@@ -1,0 +1,41 @@
+"""Crash-point injection for WAL/handshake recovery testing.
+
+Reference: libs/fail/fail.go:28-38 — `fail.Fail()` call sites are indexed
+in program order by the FAIL_TEST_INDEX env var; when the running counter
+hits the configured index the process dies immediately (os._exit, no
+cleanup — simulating kill -9 at a precise point in the commit path).
+
+Call sites (mirroring consensus/state.go:1777,1794,1817 and
+state/execution.go:251,258):
+  0  before the block is saved to the block store
+  1  after block save, before the WAL EndHeight fsync
+  2  after the EndHeight fsync, before ApplyBlock   <- the crash window
+  3  after the FinalizeBlock response is persisted, before the state save
+  4  after the state save, before the app Commit
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_ENV = "FAIL_TEST_INDEX"
+_index: int | None = None
+
+
+def _target() -> int:
+    global _index
+    if _index is None:
+        try:
+            _index = int(os.environ.get(_ENV, "-1"))
+        except ValueError:
+            _index = -1
+    return _index
+
+
+def fail(call_index: int) -> None:
+    """Die iff this call site's index matches FAIL_TEST_INDEX."""
+    if call_index == _target():
+        sys.stderr.write(f"*** fail-point {call_index} triggered ***\n")
+        sys.stderr.flush()
+        os._exit(99)

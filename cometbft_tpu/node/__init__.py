@@ -1,0 +1,3 @@
+from cometbft_tpu.node.node import Node, init_files
+
+__all__ = ["Node", "init_files"]

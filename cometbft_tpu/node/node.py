@@ -1,0 +1,236 @@
+"""Node assembly: the dependency-injection root.
+
+Reference: node/node.go:263-524 NewNode + OnStart (node.go:527). Boot
+order mirrors the reference call stack (SURVEY §3.1):
+
+  init DBs -> load state (db or genesis) -> start proxy app conns ->
+  event switch -> privval -> [handshake replay] -> mempool -> evidence ->
+  block executor -> consensus -> reactors -> transport/switch -> dial
+  persistent peers -> RPC
+
+`init_files` is the `cometbft init` analog (cmd/cometbft/commands/init.go):
+write genesis + node key + privval key under the home dir.
+"""
+
+from __future__ import annotations
+
+import os
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.config import Config
+from cometbft_tpu.consensus import ConsensusState
+from cometbft_tpu.consensus.reactor import ConsensusReactor
+from cometbft_tpu.consensus.wal import WAL
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.evidence import EvidencePool
+from cometbft_tpu.evidence.reactor import EvidenceReactor
+from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.libs.events import EventSwitch
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.mempool.mempool import CListMempool
+from cometbft_tpu.mempool.reactor import MempoolReactor
+from cometbft_tpu.p2p.conn.connection import MConnConfig
+from cometbft_tpu.p2p.key import NodeKey
+from cometbft_tpu.p2p.node_info import NodeInfo
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.p2p.transport import Transport
+from cometbft_tpu.privval.file_pv import FilePV
+from cometbft_tpu.proxy import AppConns, local_client_creator, socket_client_creator
+from cometbft_tpu.state import BlockExecutor, State, StateStore
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.store.db import open_db
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.utils import cmttime
+from cometbft_tpu.version import CMTSemVer as VERSION
+
+
+def _strip_tcp(addr: str) -> str:
+    return addr.removeprefix("tcp://")
+
+
+def init_files(home: str, chain_id: str = "", moniker: str = "node") -> Config:
+    """`init` command (cmd/cometbft/commands/init.go): write config.toml,
+    genesis.json (single validator = this node), node key, privval key."""
+    cfg = Config(home=home)
+    cfg.base.moniker = moniker
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    cfg.save()
+
+    pv = FilePV.load_or_generate(
+        cfg.priv_validator_key_path(), cfg.priv_validator_state_path()
+    )
+    NodeKey.load_or_gen(cfg.node_key_path())
+
+    gpath = cfg.genesis_path()
+    if not os.path.exists(gpath):
+        gdoc = GenesisDoc(
+            genesis_time=cmttime.canonical_now_ms(),
+            chain_id=chain_id or f"test-chain-{os.urandom(3).hex()}",
+            validators=[
+                GenesisValidator(
+                    address=pv.get_pub_key().address(),
+                    pub_key=pv.get_pub_key(),
+                    power=10,
+                    name=moniker,
+                )
+            ],
+        )
+        gdoc.validate_and_complete()
+        with open(gpath, "w") as f:
+            f.write(gdoc.to_json())
+    return cfg
+
+
+class Node(BaseService):
+    """node/node.go:234 Node: owns every subsystem."""
+
+    def __init__(self, config: Config, logger: cmtlog.Logger | None = None,
+                 app=None, genesis_doc: GenesisDoc | None = None):
+        if logger is None:
+            logger = cmtlog.Logger(
+                level=cmtlog.parse_level(config.base.log_level),
+                fmt=config.base.log_format,
+            )
+        super().__init__("Node", logger)
+        self.config = config
+        config.validate_basic()
+
+        # crypto backend selection (BASELINE: --crypto.backend flag)
+        crypto_batch.set_backend(config.crypto.backend)
+
+        # ---- genesis + identity (node.go:274-300)
+        if genesis_doc is None:
+            with open(config.genesis_path()) as f:
+                genesis_doc = GenesisDoc.from_json(f.read())
+        self.genesis_doc = genesis_doc
+        self.node_key = NodeKey.load_or_gen(config.node_key_path())
+
+        # ---- storage (node/setup.go:127 initDBs)
+        backend = config.base.db_backend
+        self.block_store = BlockStore(open_db(backend, config.db_path("blockstore")))
+        self.state_store = StateStore(open_db(backend, config.db_path("state")))
+        state = self.state_store.load()
+        if state is None:
+            state = State.from_genesis(genesis_doc)
+            self.state_store.bootstrap(state)
+
+        # ---- application (node.go:302 createAndStartProxyAppConns)
+        if app is not None:
+            creator = local_client_creator(app)
+        elif config.base.proxy_app == "kvstore":
+            app = KVStoreApplication()
+            creator = local_client_creator(app)
+        elif config.base.proxy_app.startswith("tcp://") or config.base.proxy_app.startswith("unix://"):
+            creator = socket_client_creator(config.base.proxy_app)
+        else:
+            raise ValueError(f"unknown proxy_app {config.base.proxy_app!r}")
+        self.app = app
+        self.proxy_app = AppConns(creator)
+
+        # ---- privval (node.go:324)
+        self.priv_validator = FilePV.load_or_generate(
+            config.priv_validator_key_path(), config.priv_validator_state_path()
+        )
+
+        # ---- mempool + evidence (node.go:369-388)
+        self.mempool = CListMempool(config.mempool, None)  # app conn wired on start
+        self._evidence_db = open_db(backend, config.db_path("evidence"))
+        self.evidence_pool = EvidencePool(self._evidence_db, self.state_store)
+        self.event_switch = EventSwitch()
+
+        # ---- execution + consensus (node.go:391-425)
+        self.block_exec = BlockExecutor(
+            self.state_store, None, self.mempool, evidence_pool=self.evidence_pool
+        )
+        wal = WAL(os.path.join(config.wal_path(), "wal"))
+        self.consensus_state = ConsensusState(
+            config=config.consensus,
+            state=state,
+            block_exec=self.block_exec,
+            block_store=self.block_store,
+            wal=wal,
+            priv_validator=self.priv_validator,
+            event_switch=self.event_switch,
+            logger=self.logger.with_fields(module="consensus"),
+        )
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus_state,
+            logger=self.logger.with_fields(module="cons-reactor"),
+        )
+        self.mempool_reactor = MempoolReactor(
+            self.mempool, logger=self.logger.with_fields(module="mempool"))
+        self.evidence_reactor = EvidenceReactor(
+            self.evidence_pool, logger=self.logger.with_fields(module="evidence"))
+
+        # ---- p2p (node.go:443-482)
+        self.node_info = NodeInfo(
+            node_id=self.node_key.id(),
+            network=genesis_doc.chain_id,
+            version=VERSION,
+            moniker=config.base.moniker,
+            rpc_address=config.rpc.laddr,
+        )
+        self.transport = Transport(
+            self.node_key, self.node_info,
+            logger=self.logger.with_fields(module="p2p"),
+        )
+        self.switch = Switch(
+            self.transport,
+            mconn_config=MConnConfig(
+                send_rate=config.p2p.send_rate,
+                recv_rate=config.p2p.recv_rate,
+                max_packet_msg_payload_size=config.p2p.max_packet_msg_payload_size,
+                flush_throttle=config.p2p.flush_throttle_timeout,
+            ),
+            logger=self.logger.with_fields(module="p2p"),
+        )
+        self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
+        self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
+        self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
+
+        self.rpc_server = None  # attached on start when rpc.laddr set
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def on_start(self) -> None:
+        """node.go:527 OnStart."""
+        await self.proxy_app.start()
+        # wire the live app conns (created only at proxy start)
+        self.mempool.app_conn = self.proxy_app.mempool
+        self.block_exec.app_conn = self.proxy_app.consensus
+
+        # ABCI handshake: replay blocks the app missed (replay.go:241)
+        from cometbft_tpu.consensus.replay import Handshaker
+
+        hs = Handshaker(
+            self.state_store, self.block_store, self.genesis_doc,
+            logger=self.logger.with_fields(module="handshake"),
+        )
+        state = await hs.handshake(self.proxy_app)
+        self.consensus_state.sync_to_state(state)
+
+        addr = await self.transport.listen(_strip_tcp(self.config.p2p.laddr))
+        self.node_info.listen_addr = addr
+        await self.switch.start()
+        peers = self.config.p2p.persistent_peer_list()
+        if peers:
+            await self.switch.dial_peers_async(peers, persistent=True)
+
+        if self.config.rpc.laddr:
+            from cometbft_tpu.rpc.server import RPCServer
+
+            self.rpc_server = RPCServer(self, self.config.rpc)
+            await self.rpc_server.start()
+
+    async def on_stop(self) -> None:
+        if self.rpc_server is not None:
+            await self.rpc_server.stop()
+        await self.switch.stop()
+        await self.proxy_app.stop()
+        for db in (self.block_store.db, self.state_store.db, self._evidence_db):
+            try:
+                db.close()
+            except Exception:  # noqa: BLE001
+                pass

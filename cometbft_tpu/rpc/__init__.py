@@ -1,0 +1,3 @@
+from cometbft_tpu.rpc.server import RPCServer
+
+__all__ = ["RPCServer"]

@@ -1,0 +1,148 @@
+"""JSON-RPC 2.0 server over HTTP (+ URI GET convenience routes).
+
+Reference: rpc/jsonrpc/server/http_server.go + http_uri_handler.go. A
+hand-rolled asyncio HTTP/1.1 server (stdlib-only, like everything else):
+POST / with a JSON-RPC envelope, or GET /<route>?k=v with query params —
+both hit the same Environment handlers. WebSocket subscriptions arrive
+with the pubsub EventBus (rpc/core/events.go analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.parse
+
+from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.rpc.core import Environment, RPCError
+
+MAX_BODY = 1_000_000
+MAX_HEADERS = 64
+
+
+class RPCServer(BaseService):
+    def __init__(self, node, config, logger: cmtlog.Logger | None = None):
+        super().__init__("RPC", logger or node.logger.with_fields(module="rpc"))
+        self.node = node
+        self.config = config
+        self.env = Environment(node)
+        self.routes = self.env.routes()
+        self._server: asyncio.Server | None = None
+        self.bound_addr = ""
+
+    async def on_start(self) -> None:
+        addr = self.config.laddr.removeprefix("tcp://")
+        host, _, port = addr.rpartition(":")
+        self._server = await asyncio.start_server(
+            self._handle_conn, host or "127.0.0.1", int(port)
+        )
+        sock = self._server.sockets[0].getsockname()
+        self.bound_addr = f"{sock[0]}:{sock[1]}"
+        self.logger.info("RPC listening", addr=self.bound_addr)
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # ------------------------------------------------------------- serving
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                request_line = await reader.readline()
+                if not request_line:
+                    return
+                parts = request_line.decode("latin-1").split()
+                if len(parts) != 3:
+                    return
+                method, target, _version = parts
+                headers = {}
+                for _ in range(MAX_HEADERS):
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode("latin-1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                n = int(headers.get("content-length", 0) or 0)
+                if n > MAX_BODY:
+                    await self._respond(writer, 413, {"error": "body too large"})
+                    return
+                if n:
+                    body = await reader.readexactly(n)
+                keep_alive = headers.get("connection", "").lower() != "close"
+                status, payload = await self._dispatch(method, target, body)
+                await self._respond(writer, status, payload, keep_alive)
+                if not keep_alive:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception as e:  # noqa: BLE001 - a bad request must not kill the server
+            self.logger.error("rpc connection error", err=str(e))
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _dispatch(self, method: str, target: str, body: bytes):
+        if method == "POST":
+            try:
+                req = json.loads(body or b"{}")
+            except json.JSONDecodeError:
+                return 400, _err_envelope(None, -32700, "parse error")
+            if isinstance(req, list):  # batch
+                out = [await self._call_one(r) for r in req]
+                return 200, out
+            return 200, await self._call_one(req)
+        if method == "GET":
+            path, _, query = target.partition("?")
+            route = path.strip("/")
+            if route == "":
+                return 200, {"routes": sorted(self.routes)}
+            params = {k: v[0] for k, v in urllib.parse.parse_qs(query).items()}
+            # URI params arrive quoted (reference http_uri_handler.go)
+            params = {k: v.strip('"') for k, v in params.items()}
+            envelope = {"jsonrpc": "2.0", "id": -1, "method": route, "params": params}
+            return 200, await self._call_one(envelope)
+        return 405, {"error": "method not allowed"}
+
+    async def _call_one(self, req: dict) -> dict:
+        rid = req.get("id", -1)
+        method = req.get("method", "")
+        handler = self.routes.get(method)
+        if handler is None:
+            return _err_envelope(rid, -32601, f"method {method!r} not found")
+        params = req.get("params") or {}
+        if not isinstance(params, dict):
+            return _err_envelope(rid, -32602, "params must be a map")
+        try:
+            result = await handler(params)
+        except RPCError as e:
+            return _err_envelope(rid, e.code, str(e))
+        except Exception as e:  # noqa: BLE001
+            self.logger.error("rpc handler failed", method=method, err=str(e))
+            return _err_envelope(rid, -32603, f"internal error: {e}")
+        return {"jsonrpc": "2.0", "id": rid, "result": result}
+
+    async def _respond(self, writer: asyncio.StreamWriter, status: int,
+                       payload, keep_alive: bool = False) -> None:
+        body = json.dumps(payload).encode()
+        reason = {200: "OK", 400: "Bad Request", 405: "Method Not Allowed",
+                  413: "Payload Too Large"}.get(status, "Error")
+        conn = "keep-alive" if keep_alive else "close"
+        head = (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: {conn}\r\n\r\n"
+        )
+        writer.write(head.encode() + body)
+        await writer.drain()
+
+
+def _err_envelope(rid, code: int, message: str) -> dict:
+    return {"jsonrpc": "2.0", "id": rid, "error": {"code": code, "message": message}}

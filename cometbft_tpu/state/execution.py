@@ -14,6 +14,7 @@ from __future__ import annotations
 from cometbft_tpu.abci import types as abci
 from cometbft_tpu.abci.client import Client
 from cometbft_tpu.crypto import merkle
+from cometbft_tpu.libs import fail
 from cometbft_tpu.libs import log as cmtlog
 from cometbft_tpu.mempool.mempool import CListMempool
 from cometbft_tpu.state.state import State
@@ -260,9 +261,11 @@ class BlockExecutor:
                 f"app returned {len(resp.tx_results)} tx results for {len(block.data.txs)} txs"
             )
         self.state_store.save_finalize_block_response(block.header.height, resp)
+        fail.fail(3)  # execution.go:251
 
         new_state = self._update_state(state, block_id, block, resp)
         self.state_store.save(new_state)
+        fail.fail(4)  # execution.go:258
 
         # Commit: app state persistence + mempool maintenance
         commit_resp = await self.app_conn.commit(abci.RequestCommit())

@@ -468,3 +468,45 @@ class VoteSet:
             timestamp=v.timestamp,
             signature=v.signature,
         )
+
+
+def commit_to_vote_set(chain_id: str, commit: Commit, val_set: ValidatorSet) -> VoteSet:
+    """Rebuild the precommit VoteSet a Commit was distilled from, verifying
+    every signature (types/vote_set.go CommitToVoteSet). Used on restart to
+    reconstruct LastCommit from the block store's seen commit."""
+    vote_set = VoteSet(
+        chain_id, commit.height, commit.round_, SignedMsgType.PRECOMMIT, val_set
+    )
+    for idx, cs in enumerate(commit.signatures):
+        if not cs.for_block() and not cs.signature:
+            continue  # OK, absent — no vote to reconstruct
+        added = vote_set.add_vote(commit.get_vote(idx))
+        if not added:
+            raise RuntimeError(f"failed to reconstruct vote {idx} from commit")
+    return vote_set
+
+
+def extended_commit_to_vote_set(
+    chain_id: str, ext_commit: ExtendedCommit, val_set: ValidatorSet
+) -> VoteSet:
+    """types/vote_set.go ExtendedCommit.ToExtendedVoteSet: like
+    commit_to_vote_set but carrying (and verifying) vote extensions."""
+    vote_set = VoteSet(
+        chain_id,
+        ext_commit.height,
+        ext_commit.round_,
+        SignedMsgType.PRECOMMIT,
+        val_set,
+        extensions_enabled=True,
+    )
+    commit = ext_commit.to_commit()  # hoisted: get_extended_vote rebuilds it per call
+    for idx, ecs in enumerate(ext_commit.extended_signatures):
+        if not ecs.commit_sig.for_block() and not ecs.commit_sig.signature:
+            continue
+        vote = commit.get_vote(idx)
+        vote.extension = ecs.extension
+        vote.extension_signature = ecs.extension_signature
+        added = vote_set.add_vote(vote)
+        if not added:
+            raise RuntimeError(f"failed to reconstruct extended vote {idx}")
+    return vote_set

@@ -1,0 +1,177 @@
+"""Node assembly, config tree, CLI, and handshake-replay tests.
+
+Reference test analog: node/node_test.go (boot/restart), config tests,
+consensus/replay_test.go (handshake cases).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from cometbft_tpu.cmd import main as cli_main
+from cometbft_tpu.config import Config
+from cometbft_tpu.config.config import test_config as make_node_test_config
+from cometbft_tpu.node import Node, init_files
+
+
+def _node_config(home: str) -> Config:
+    cfg = make_node_test_config(home=home)
+    cfg.base.db_backend = "sqlite"  # restart tests need persistence
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    return cfg
+
+
+# ------------------------------------------------------------------- config
+
+
+def test_config_toml_roundtrip(tmp_path):
+    cfg = Config(home=str(tmp_path))
+    cfg.base.moniker = "round-trip"
+    cfg.crypto.backend = "cpu"
+    cfg.p2p.persistent_peers = "aa@1.2.3.4:26656,bb@5.6.7.8:26656"
+    cfg.consensus.timeout_propose = 7.25
+    cfg.rpc.cors_allowed_origins = ["*"]
+    cfg.save()
+
+    loaded = Config.load(str(tmp_path))
+    assert loaded.base.moniker == "round-trip"
+    assert loaded.crypto.backend == "cpu"
+    assert loaded.p2p.persistent_peer_list() == [
+        "aa@1.2.3.4:26656", "bb@5.6.7.8:26656"]
+    assert loaded.consensus.timeout_propose == 7.25
+    assert loaded.rpc.cors_allowed_origins == ["*"]
+
+
+def test_config_validate_rejects_bad_backend(tmp_path):
+    cfg = Config(home=str(tmp_path))
+    cfg.crypto.backend = "gpu"
+    with pytest.raises(ValueError):
+        cfg.validate_basic()
+
+
+def test_init_files_creates_layout(tmp_path):
+    home = str(tmp_path / "home")
+    init_files(home, chain_id="unit-chain", moniker="m0")
+    for rel in ("config/config.toml", "config/genesis.json",
+                "config/node_key.json", "config/priv_validator_key.json"):
+        assert os.path.exists(os.path.join(home, rel)), rel
+    gdoc = json.load(open(os.path.join(home, "config/genesis.json")))
+    assert gdoc["chain_id"] == "unit-chain"
+    assert len(gdoc["validators"]) == 1
+    # idempotent: re-init must not overwrite identity
+    key1 = open(os.path.join(home, "config/node_key.json")).read()
+    init_files(home, chain_id="other", moniker="m1")
+    assert open(os.path.join(home, "config/node_key.json")).read() == key1
+
+
+# ------------------------------------------------------------ CLI commands
+
+
+def test_cli_testnet_generates_wired_homes(tmp_path):
+    out = str(tmp_path / "tn")
+    rc = cli_main(["testnet", "--v", "3", "--o", out,
+                   "--chain-id", "tn-chain", "--starting-port", "29656"])
+    assert rc == 0
+    genesis = None
+    for i in range(3):
+        home = os.path.join(out, f"node{i}")
+        cfg = Config.load(home)
+        assert cfg.p2p.laddr == f"tcp://127.0.0.1:{29656 + i}"
+        peers = cfg.p2p.persistent_peer_list()
+        assert len(peers) == 2 and all("@127.0.0.1:" in p for p in peers)
+        g = open(os.path.join(home, "config/genesis.json")).read()
+        if genesis is None:
+            genesis = g
+        assert g == genesis  # all nodes share one genesis
+    gdoc = json.loads(genesis)
+    assert gdoc["chain_id"] == "tn-chain"
+    assert len(gdoc["validators"]) == 3
+
+
+def test_cli_show_commands(tmp_path, capsys):
+    home = str(tmp_path / "home")
+    cli_main(["--home", home, "init"])
+    capsys.readouterr()
+    assert cli_main(["--home", home, "show-node-id"]) == 0
+    node_id = capsys.readouterr().out.strip()
+    assert len(node_id) == 40  # hex address of the node key
+    assert cli_main(["--home", home, "show-validator"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["type"] == "ed25519"
+
+
+# --------------------------------------------------- node boot + restart
+
+
+async def _wait_height(node: Node, h: int, timeout: float = 30.0) -> None:
+    async def poll():
+        while node.block_store.height() < h:
+            await asyncio.sleep(0.02)
+
+    await asyncio.wait_for(poll(), timeout)
+
+
+async def _rpc_call(addr: str, method: str, params: dict | None = None) -> dict:
+    reader, writer = await asyncio.open_connection(*addr.rsplit(":", 1))
+    body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                       "params": params or {}}).encode()
+    writer.write(
+        b"POST / HTTP/1.1\r\nHost: x\r\nConnection: close\r\n"
+        b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body)
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    assert b"200" in head.split(b"\r\n")[0]
+    return json.loads(payload)
+
+
+def test_node_boot_commit_rpc_restart(tmp_path):
+    """Single-validator node: boots from disk, commits, serves RPC, and on
+    restart reconstructs LastCommit (state.go reconstructLastCommit) +
+    replays blocks into the fresh app (replay.go Handshake) and keeps
+    committing past the pre-restart height."""
+    home = str(tmp_path / "home")
+    init_files(home, chain_id="boot-chain", moniker="n0")
+
+    async def phase1():
+        node = Node(_node_config(home))
+        await node.start()
+        try:
+            await _wait_height(node, 3)
+            status = await _rpc_call(node.rpc_server.bound_addr, "status")
+            assert status["result"]["node_info"]["network"] == "boot-chain"
+            assert int(status["result"]["sync_info"]["latest_block_height"]) >= 3
+        finally:
+            await node.stop()
+        return node.block_store.height(), node.state_store.load().app_hash
+
+    h1, app_hash_1 = asyncio.run(phase1())
+
+    async def phase2():
+        # restart from the same home: fresh Node, fresh in-proc kvstore app
+        # (height 0) -> handshake must replay all h1 blocks into it
+        node2 = Node(_node_config(home))
+        assert node2.consensus_state.rs.last_commit is not None  # reconstructed
+        assert node2.consensus_state.rs.height == h1 + 1
+        await node2.start()
+        try:
+            assert node2.app.height == h1  # handshake replayed into the app
+            await _wait_height(node2, h1 + 2)
+        finally:
+            await node2.stop()
+        return node2
+
+    node2 = asyncio.run(phase2())
+    st2 = node2.state_store.load()
+    assert st2.last_block_height >= h1 + 2
+    # chain continuity: block h1+1 links back to the pre-restart chain
+    blk = node2.block_store.load_block(h1 + 1)
+    meta1 = node2.block_store.load_block_meta(h1)
+    assert blk.header.last_block_id.hash == meta1.block_id.hash
+    assert app_hash_1 == node2.block_store.load_block(h1 + 1).header.app_hash
