@@ -1,0 +1,4 @@
+from cometbft_tpu.blocksync.pool import BlockPool
+from cometbft_tpu.blocksync.reactor import BlocksyncReactor
+
+__all__ = ["BlockPool", "BlocksyncReactor"]

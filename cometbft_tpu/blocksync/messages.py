@@ -1,0 +1,117 @@
+"""Blocksync wire messages + codec.
+
+Reference: proto/tendermint/blocksync/types.proto and blocksync/msgs.go.
+One Message envelope, oneof by field number:
+
+  1 BlockRequest{1:height}
+  2 NoBlockResponse{1:height}
+  3 BlockResponse{1:block, 2:ext_commit}
+  4 StatusRequest{}
+  5 StatusResponse{1:height, 2:base}
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from cometbft_tpu.types.block import Block
+from cometbft_tpu.types.commit import ExtendedCommit
+from cometbft_tpu.utils.protobuf import Reader, Writer
+
+
+@dataclass
+class BlockRequest:
+    height: int
+
+
+@dataclass
+class NoBlockResponse:
+    height: int
+
+
+@dataclass
+class BlockResponse:
+    block: Block
+    ext_commit: ExtendedCommit | None = None
+
+
+@dataclass
+class StatusRequest:
+    pass
+
+
+@dataclass
+class StatusResponse:
+    height: int
+    base: int
+
+
+def encode(msg) -> bytes:
+    w = Writer()
+    if isinstance(msg, BlockRequest):
+        w.message(1, Writer().varint_i64(1, msg.height).output(), always=True)
+    elif isinstance(msg, NoBlockResponse):
+        w.message(2, Writer().varint_i64(1, msg.height).output(), always=True)
+    elif isinstance(msg, BlockResponse):
+        inner = Writer().message(1, msg.block.to_proto(), always=True)
+        if msg.ext_commit is not None:
+            from cometbft_tpu.store.blockstore import _extended_to_proto
+
+            inner.message(2, _extended_to_proto(msg.ext_commit))
+        w.message(3, inner.output(), always=True)
+    elif isinstance(msg, StatusRequest):
+        w.message(4, b"", always=True)
+    elif isinstance(msg, StatusResponse):
+        w.message(
+            5,
+            Writer().varint_i64(1, msg.height).varint_i64(2, msg.base).output(),
+            always=True,
+        )
+    else:
+        raise TypeError(f"cannot encode blocksync message {type(msg)}")
+    return w.output()
+
+
+def decode(data: bytes):
+    r = Reader(data)
+    f, _w = r.read_tag()
+    body = r.read_bytes()
+    br = Reader(body)
+    if f == 1 or f == 2:
+        height = 0
+        while not br.at_end():
+            g, w2 = br.read_tag()
+            if g == 1:
+                height = br.read_varint_i64()
+            else:
+                br.skip(w2)
+        return BlockRequest(height) if f == 1 else NoBlockResponse(height)
+    if f == 3:
+        block, ec = None, None
+        while not br.at_end():
+            g, w2 = br.read_tag()
+            if g == 1:
+                block = Block.from_proto(br.read_bytes())
+            elif g == 2:
+                from cometbft_tpu.store.blockstore import _extended_from_proto
+
+                ec = _extended_from_proto(br.read_bytes())
+            else:
+                br.skip(w2)
+        if block is None:
+            raise ValueError("BlockResponse without block")
+        return BlockResponse(block, ec)
+    if f == 4:
+        return StatusRequest()
+    if f == 5:
+        height, base = 0, 0
+        while not br.at_end():
+            g, w2 = br.read_tag()
+            if g == 1:
+                height = br.read_varint_i64()
+            elif g == 2:
+                base = br.read_varint_i64()
+            else:
+                br.skip(w2)
+        return StatusResponse(height, base)
+    raise ValueError(f"unknown blocksync message field {f}")

@@ -17,6 +17,7 @@ from __future__ import annotations
 import os
 
 from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.blocksync import BlocksyncReactor
 from cometbft_tpu.config import Config
 from cometbft_tpu.consensus import ConsensusState
 from cometbft_tpu.consensus.reactor import ConsensusReactor
@@ -155,9 +156,22 @@ class Node(BaseService):
             event_switch=self.event_switch,
             logger=self.logger.with_fields(module="consensus"),
         )
+        # blocksync runs when enabled and we are not the sole validator
+        # (node.go onlyValidatorIsUs — nothing to sync from ourselves)
+        self.blocksync_active = config.block_sync.enable and not _only_validator_is_us(
+            state, self.priv_validator.get_pub_key()
+        )
         self.consensus_reactor = ConsensusReactor(
             self.consensus_state,
+            wait_sync=self.blocksync_active,
             logger=self.logger.with_fields(module="cons-reactor"),
+        )
+        self.blocksync_reactor = BlocksyncReactor(
+            self.block_exec,
+            self.block_store,
+            active=self.blocksync_active,
+            consensus_reactor=self.consensus_reactor,
+            logger=self.logger.with_fields(module="blocksync"),
         )
         self.mempool_reactor = MempoolReactor(
             self.mempool, logger=self.logger.with_fields(module="mempool"))
@@ -187,6 +201,7 @@ class Node(BaseService):
             logger=self.logger.with_fields(module="p2p"),
         )
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
+        self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
         self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
         self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
 
@@ -210,6 +225,7 @@ class Node(BaseService):
         )
         state = await hs.handshake(self.proxy_app)
         self.consensus_state.sync_to_state(state)
+        self.blocksync_reactor.set_state(self.consensus_state.state)
 
         addr = await self.transport.listen(_strip_tcp(self.config.p2p.laddr))
         self.node_info.listen_addr = addr
@@ -234,3 +250,10 @@ class Node(BaseService):
                 db.close()
             except Exception:  # noqa: BLE001
                 pass
+
+
+def _only_validator_is_us(state, pub_key) -> bool:
+    """node.go onlyValidatorIsUs."""
+    if state.validators is None or len(state.validators) != 1:
+        return False
+    return state.validators.validators[0].address == pub_key.address()

@@ -184,9 +184,16 @@ class BlockExecutor:
 
     # ----------------------------------------------------------- validate
 
-    def validate_block(self, state: State, block: Block) -> None:
+    def validate_block(self, state: State, block: Block,
+                       last_commit_verified: bool = False) -> None:
         """state/validation.go:15-110 — structural + against-state checks,
-        LastCommit verification through the batch boundary."""
+        LastCommit verification through the batch boundary.
+
+        last_commit_verified=True skips the signature re-verification: the
+        streaming blocksync path has already full-verified this commit on
+        the device (types/validation.py stage_verify_commit) — one device
+        pass per commit instead of the reference's two
+        (blocksync/reactor.go:463 + state/validation.go:92)."""
         block.validate_basic()
         h = block.header
         if h.version.block != 11:
@@ -222,14 +229,15 @@ class BlockExecutor:
                     f"invalid block commit size: {len(block.last_commit.signatures)} vs "
                     f"{len(state.last_validators)} validators"
                 )
-            # THE hot call: batched signature verification (validation.go:92)
-            validation.verify_commit(
-                state.chain_id,
-                state.last_validators,
-                state.last_block_id,
-                h.height - 1,
-                block.last_commit,
-            )
+            if not last_commit_verified:
+                # THE hot call: batched signature verification (validation.go:92)
+                validation.verify_commit(
+                    state.chain_id,
+                    state.last_validators,
+                    state.last_block_id,
+                    h.height - 1,
+                    block.last_commit,
+                )
 
         # evidence in the proposed block must verify (validation.go:15 ->
         # evpool.CheckEvidence, state/validation.go end)
@@ -239,12 +247,16 @@ class BlockExecutor:
     # -------------------------------------------------------------- apply
 
     async def apply_block(
-        self, state: State, block_id: BlockID, block: Block
+        self, state: State, block_id: BlockID, block: Block,
+        last_commit_verified: bool = False, validated: bool = False,
     ) -> State:
         """execution.go:211-330 + Commit at 380-419. Returns the new state.
         The mempool is locked across FinalizeBlock->Commit->Update by the
-        caller's single-threaded consensus task (asyncio serialization)."""
-        self.validate_block(state, block)
+        caller's single-threaded consensus task (asyncio serialization).
+        validated=True skips validate_block entirely (the blocksync apply
+        loop runs it pre-pop so a bad block can still be redone)."""
+        if not validated:
+            self.validate_block(state, block, last_commit_verified=last_commit_verified)
         req = abci.RequestFinalizeBlock(
             txs=block.data.txs,
             decided_last_commit=_abci_commit_info(block, state.last_validators),

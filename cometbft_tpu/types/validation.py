@@ -71,7 +71,7 @@ def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
     )
 
 
-def _verify_commit_batch(
+def _commit_rows(
     chain_id: str,
     vals: ValidatorSet,
     commit: Commit,
@@ -80,11 +80,16 @@ def _verify_commit_batch(
     count_sig: Callable[[CommitSig], bool],
     count_all_signatures: bool,
     lookup_by_index: bool,
-) -> None:
-    """types/validation.go:153-257."""
-    bv = crypto_batch.create_batch_verifier(vals.get_proposer().pub_key)
+) -> tuple[list, list[bytes], list[bytes], list[int]]:
+    """The shared row-builder behind every batched commit verification
+    (types/validation.go:153-257 loop body): select signatures, tally power,
+    enforce the threshold. Returns (pubkeys, sign_bytes, sigs, commit_idxs);
+    raises ErrNotEnoughVotingPowerSigned below threshold."""
     seen_vals: dict[int, int] = {}
-    batch_sig_idxs: list[int] = []
+    pubs: list = []
+    msgs: list[bytes] = []
+    sigs: list[bytes] = []
+    idxs: list[int] = []
     tallied = 0
     for idx, cs in enumerate(commit.signatures):
         if ignore_sig(cs):
@@ -100,23 +105,50 @@ def _verify_commit_batch(
                     f"double vote from {val.address.hex()} ({seen_vals[val_idx]} and {idx})"
                 )
             seen_vals[val_idx] = idx
-        bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature)
-        batch_sig_idxs.append(idx)
+        pubs.append(val.pub_key)
+        msgs.append(commit.vote_sign_bytes(chain_id, idx))
+        sigs.append(cs.signature)
+        idxs.append(idx)
         if count_sig(cs):
             tallied += val.voting_power
         if not count_all_signatures and tallied > voting_power_needed:
             break
     if tallied <= voting_power_needed:
         raise ErrNotEnoughVotingPowerSigned(got=tallied, needed=voting_power_needed)
-    ok, valid_sigs = bv.verify()
-    if ok:
-        return
-    for i, sig_ok in enumerate(valid_sigs):
+    return pubs, msgs, sigs, idxs
+
+
+def _raise_first_bad(commit: Commit, idxs: list[int], mask) -> None:
+    for i, sig_ok in enumerate(mask):
         if not sig_ok:
-            idx = batch_sig_idxs[i]
+            idx = idxs[i]
             raise ErrInvalidCommitSignature(
                 f"wrong signature (#{idx}): {commit.signatures[idx].signature.hex()}"
             )
+
+
+def _verify_commit_batch(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+    lookup_by_index: bool,
+) -> None:
+    """types/validation.go:153-257."""
+    pubs, msgs, sigs, idxs = _commit_rows(
+        chain_id, vals, commit, voting_power_needed,
+        ignore_sig, count_sig, count_all_signatures, lookup_by_index,
+    )
+    bv = crypto_batch.create_batch_verifier(vals.get_proposer().pub_key)
+    for pub, msg, sig in zip(pubs, msgs, sigs):
+        bv.add(pub, msg, sig)
+    ok, valid_sigs = bv.verify()
+    if ok:
+        return
+    _raise_first_bad(commit, idxs, valid_sigs)
     raise RuntimeError("BUG: batch verification failed with no invalid signatures")
 
 
@@ -221,3 +253,93 @@ def verify_commit_light_trusting(
         _verify_commit_batch(chain_id, vals, commit, needed, ignore, count, False, False)
     else:
         _verify_commit_single(chain_id, vals, commit, needed, ignore, count, False, False)
+
+
+# ---------------------------------------------------------------------------
+# Streaming (async) commit verification — the blocksync/light-client seam.
+#
+# The reference verifies each commit synchronously, twice (VerifyCommitLight
+# in the blocksync reactor, then VerifyCommit again inside validateBlock,
+# blocksync/reactor.go:463 + state/validation.go:92). TPU-first redesign:
+# stage ONE full-semantics verification per commit on the device without
+# blocking (verify_batch_async), resolve a whole window of heights with a
+# single device fetch (resolve_batches), and let ApplyBlock skip the
+# redundant re-verification (last_commit_verified).
+# ---------------------------------------------------------------------------
+
+
+class StagedCommitVerification:
+    """A dispatched-but-unresolved verify_commit: finish() raises exactly
+    what the sync path would. device_thunk is set on the TPU backend so a
+    window of staged commits resolves with one device->host fetch."""
+
+    def __init__(self, commit: Commit, sig_idxs: list[int], device_thunk=None,
+                 cpu_rows=None):
+        self.commit = commit
+        self.sig_idxs = sig_idxs
+        self.device_thunk = device_thunk
+        self._cpu_rows = cpu_rows
+        self._mask = None
+
+    def finish(self, mask=None) -> None:
+        """Materialize the mask (or use the window-resolved one) and apply
+        the reference error semantics: first invalid signature raises."""
+        if mask is None:
+            mask = self._mask
+        if mask is None:
+            if self.device_thunk is not None:
+                mask = self.device_thunk()
+            else:
+                pubs, msgs, sigs = self._cpu_rows
+                mask = [p.verify_signature(m, s) for p, m, s in zip(pubs, msgs, sigs)]
+        _raise_first_bad(self.commit, self.sig_idxs, mask)
+
+
+def stage_verify_commit(
+    chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
+) -> StagedCommitVerification:
+    """verify_commit (full semantics: every non-absent signature checked,
+    COMMIT flags tallied, types/validation.go:26-57) staged asynchronously.
+    Structural checks + the voting-power threshold run here, synchronously;
+    signature validity is deferred to .finish()."""
+    _verify_basic(vals, commit, height, block_id)
+    needed = vals.total_voting_power() * 2 // 3
+    pubs, msgs, sigs, idxs = _commit_rows(
+        chain_id, vals, commit, needed,
+        ignore_sig=lambda c: c.block_id_flag == BlockIDFlag.ABSENT,
+        count_sig=lambda c: c.block_id_flag == BlockIDFlag.COMMIT,
+        count_all_signatures=True,
+        lookup_by_index=True,
+    )
+    if crypto_batch.resolve_backend() == "tpu" and all(
+        p.type_() == "ed25519" for p in pubs
+    ):
+        from cometbft_tpu.ops import ed25519_kernel
+
+        thunk = ed25519_kernel.verify_batch_async(
+            [p.bytes_() for p in pubs], msgs, sigs
+        )
+        return StagedCommitVerification(commit, idxs, device_thunk=thunk)
+    return StagedCommitVerification(commit, idxs, cpu_rows=(pubs, msgs, sigs))
+
+
+def prefetch_staged(staged: list[StagedCommitVerification]) -> None:
+    """Fetch every device mask in the window with ONE device->host transfer
+    and attach each to its staging record; subsequent finish() calls are
+    pure host work (per-commit error isolation stays with the caller)."""
+    device = [s for s in staged if s.device_thunk is not None and s._mask is None]
+    if not device:
+        return
+    from cometbft_tpu.ops import ed25519_kernel
+
+    resolved = ed25519_kernel.resolve_batches([s.device_thunk for s in device])
+    for s, m in zip(device, resolved):
+        s._mask = m
+
+
+def resolve_staged(staged: list[StagedCommitVerification]) -> None:
+    """Finish a window of staged verifications with one device fetch.
+    Raises on the first bad commit, in window order."""
+    prefetch_staged(staged)
+    for s in staged:
+        s.finish()
