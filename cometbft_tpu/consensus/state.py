@@ -763,6 +763,27 @@ class ConsensusState(BaseService):
         if vote.height != rs.height:
             return False
 
+        # Extension check on every peer precommit (state.go:2219-2240):
+        # the extension signature is verified FIRST so the app only ever
+        # sees authenticated payloads (ref vote.VerifyExtension before
+        # blockExec.VerifyVoteExtension — a forged vote must not buy an
+        # ABCI round-trip), then the app judges the payload. Skipped for
+        # our own votes — we produced the extension via ExtendVote.
+        if (
+            vote.type_ == SignedMsgType.PRECOMMIT
+            and not vote.block_id.is_nil()
+            and self.state.consensus_params.abci.vote_extensions_enabled(vote.height)
+            and vote.validator_address
+            != (self.priv_validator_pub_key.address() if self.priv_validator_pub_key else b"")
+        ):
+            _, val = rs.validators.get_by_index(vote.validator_index)
+            if val is None:
+                return False
+            if not vote.verify_extension(self.state.chain_id, val.pub_key):
+                self.logger.info("invalid vote extension signature", vote=str(vote))
+                return False
+            await self.block_exec.verify_vote_extension(vote)
+
         if self.config.batch_vote_verification and peer_id:
             return await self._add_vote_batched(vote, peer_id)
 

@@ -39,8 +39,15 @@ from cometbft_tpu.p2p.transport import Transport
 from cometbft_tpu.privval.file_pv import FilePV
 from cometbft_tpu.proxy import AppConns, local_client_creator, socket_client_creator
 from cometbft_tpu.state import BlockExecutor, State, StateStore
+from cometbft_tpu.state.txindex import (
+    BlockIndexer,
+    IndexerService,
+    NullTxIndexer,
+    TxIndexer,
+)
 from cometbft_tpu.store import BlockStore
 from cometbft_tpu.store.db import open_db
+from cometbft_tpu.types.event_bus import EventBus
 from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
 from cometbft_tpu.utils import cmttime
 from cometbft_tpu.version import CMTSemVer as VERSION
@@ -140,10 +147,26 @@ class Node(BaseService):
         self._evidence_db = open_db(backend, config.db_path("evidence"))
         self.evidence_pool = EvidencePool(self._evidence_db, self.state_store)
         self.event_switch = EventSwitch()
+        self.event_bus = EventBus()
+
+        # ---- indexers (node.go:311-320 createAndStartIndexerService)
+        if config.tx_index.indexer == "kv":
+            self._indexer_db = open_db(backend, config.db_path("tx_index"))
+            self.tx_indexer = TxIndexer(self._indexer_db)
+            self.block_indexer = BlockIndexer(self._indexer_db)
+        else:
+            self._indexer_db = None
+            self.tx_indexer = NullTxIndexer()
+            self.block_indexer = None
+        self.indexer_service = IndexerService(
+            self.tx_indexer, self.block_indexer, self.event_bus,
+            logger=self.logger.with_fields(module="txindex"),
+        ) if self._indexer_db is not None else None
 
         # ---- execution + consensus (node.go:391-425)
         self.block_exec = BlockExecutor(
-            self.state_store, None, self.mempool, evidence_pool=self.evidence_pool
+            self.state_store, None, self.mempool, evidence_pool=self.evidence_pool,
+            event_bus=self.event_bus,
         )
         wal = WAL(os.path.join(config.wal_path(), "wal"))
         self.consensus_state = ConsensusState(
@@ -211,6 +234,22 @@ class Node(BaseService):
 
     async def on_start(self) -> None:
         """node.go:527 OnStart."""
+        if self.indexer_service is not None:
+            await self.indexer_service.start()
+
+        # bridge the consensus fast-path EventSwitch into the async EventBus
+        # so RPC subscribers see round transitions (state.go:129-131 dual
+        # event plane)
+        from cometbft_tpu.types import event_bus as eb
+
+        def _rs_bridge(rs) -> None:
+            self.event_bus.server.publish(
+                eb.EventDataRoundState(rs.height, rs.round_, str(rs.step)),
+                {eb.EVENT_TYPE_KEY: [eb.EVENT_NEW_ROUND_STEP]},
+            )
+
+        self.event_switch.add_listener("node-bus", "NewRoundStep", _rs_bridge)
+
         await self.proxy_app.start()
         # wire the live app conns (created only at proxy start)
         self.mempool.app_conn = self.proxy_app.mempool
@@ -245,7 +284,10 @@ class Node(BaseService):
             await self.rpc_server.stop()
         await self.switch.stop()
         await self.proxy_app.stop()
-        for db in (self.block_store.db, self.state_store.db, self._evidence_db):
+        if self.indexer_service is not None and self.indexer_service.is_running:
+            await self.indexer_service.stop()
+        for db in (self.block_store.db, self.state_store.db, self._evidence_db,
+                   self._indexer_db):
             try:
                 db.close()
             except Exception:  # noqa: BLE001

@@ -28,6 +28,20 @@ class RPCError(Exception):
         self.code = code
 
 
+class QuotedStr(str):
+    """A URI arg that arrived as a '"quoted"' string literal — for []byte
+    params its UTF-8 bytes ARE the value (reference
+    rpc/jsonrpc/server/http_uri_handler.go: quoted args are string
+    literals, unquoted are hex/number)."""
+
+
+class UriStr(str):
+    """An unquoted URI arg — []byte params decode as hex (0x optional),
+    matching the reference URI handler; JSON-body params (plain str) stay
+    strictly base64 (proto3 JSON), so base64 payloads that merely look like
+    hex are never misdecoded."""
+
+
 class Environment:
     """rpc/core/env.go: the handlers' view of the node."""
 
@@ -274,10 +288,11 @@ class Environment:
         tx = params.get("tx")
         if tx is None:
             raise RPCError(-32602, "missing tx param")
-        try:
-            return base64.b64decode(tx, validate=True)
-        except Exception:  # noqa: BLE001 - maybe hex (curl convenience)
-            return bytes.fromhex(tx.removeprefix("0x"))
+        if isinstance(tx, QuotedStr):
+            return tx.encode()  # URI string literal: raw bytes
+        if isinstance(tx, UriStr):
+            return bytes.fromhex(tx[2:] if tx[:2] in ("0x", "0X") else tx)
+        return base64.b64decode(tx, validate=True)  # JSON body: proto3 base64
 
     async def broadcast_tx_async(self, params: dict) -> dict:
         """rpc/core/mempool.go:27: fire and forget."""
@@ -312,6 +327,115 @@ class Environment:
             raise RPCError(-32603, f"tx rejected: {e}") from e
         return {"code": res.code, "data": _b64(res.data), "log": res.log,
                 "hash": _hex(tx_hash(tx))}
+
+    async def broadcast_tx_commit(self, params: dict) -> dict:
+        """rpc/core/mempool.go:69 BroadcastTxCommit: subscribe to the tx's
+        inclusion event BEFORE CheckTx, then wait for DeliverTx (bounded by
+        timeout_broadcast_tx_commit)."""
+        import asyncio
+
+        from cometbft_tpu.abci import codec as abci_codec
+        from cometbft_tpu.mempool.mempool import ErrTxInCache, tx_hash
+        from cometbft_tpu.types import event_bus as eb
+
+        tx = self._tx_param(params)
+        h = tx_hash(tx)
+        bus = self.node.event_bus
+        client = f"btc-{h.hex()[:16]}-{id(params)}"
+        query = f"{eb.EVENT_TYPE_KEY} = '{eb.EVENT_TX}' AND {eb.TX_HASH_KEY} = '{h.hex().upper()}'"
+        sub = bus.subscribe(client, query, capacity=1)
+        try:
+            try:
+                check = await self.node.mempool.check_tx(tx)
+            except ErrTxInCache:
+                raise RPCError(-32603, "tx already exists in cache") from None
+            except Exception as e:  # noqa: BLE001
+                raise RPCError(-32603, f"error on broadcastTxCommit: {e}") from e
+            check_dict = {"code": check.code, "data": _b64(check.data),
+                          "log": check.log}
+            if check.code != 0:
+                return {"check_tx": check_dict, "tx_result": {},
+                        "hash": _hex(h), "height": "0"}
+            timeout = self.node.config.rpc.timeout_broadcast_tx_commit
+            try:
+                msg = await asyncio.wait_for(sub.out.get(), timeout)
+            except asyncio.TimeoutError:
+                raise RPCError(
+                    -32603, "timed out waiting for tx to be included in a block"
+                ) from None
+            if msg is None:
+                raise RPCError(-32603, f"subscription canceled: {sub.canceled}")
+            d = msg.data  # EventDataTx
+            return {
+                "check_tx": check_dict,
+                "tx_result": abci_codec._to_jsonable(d.result),
+                "hash": _hex(h),
+                "height": str(d.height),
+            }
+        finally:
+            try:
+                bus.unsubscribe_all(client)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ------------------------------------------------------------ tx query
+
+    async def tx(self, params: dict) -> dict:
+        """rpc/core/tx.go Tx: look up a committed tx by hash."""
+        from cometbft_tpu.abci import codec as abci_codec
+
+        h = params.get("hash", "")
+        raw = bytes.fromhex(h) if isinstance(h, str) else h
+        res = self.node.tx_indexer.get(raw)
+        if res is None:
+            raise RPCError(-32603, f"tx ({h}) not found")
+        return {
+            "hash": _hex(raw), "height": str(res.height), "index": res.index,
+            "tx_result": abci_codec._to_jsonable(res.result), "tx": _b64(res.tx),
+        }
+
+    async def tx_search(self, params: dict) -> dict:
+        """rpc/core/tx.go TxSearch over the KV indexer."""
+        from cometbft_tpu.abci import codec as abci_codec
+        from cometbft_tpu.types.block import tx_hash
+
+        query = params.get("query", "")
+        if not query:
+            raise RPCError(-32602, "missing query param")
+        limit = int(params.get("per_page") or 30)
+        try:
+            results = self.node.tx_indexer.search(query, limit=limit)
+        except Exception as e:  # noqa: BLE001
+            raise RPCError(-32602, f"bad query: {e}") from e
+        return {
+            "txs": [
+                {"hash": _hex(tx_hash(r.tx)), "height": str(r.height),
+                 "index": r.index, "tx_result": abci_codec._to_jsonable(r.result),
+                 "tx": _b64(r.tx)}
+                for r in results
+            ],
+            "total_count": str(len(results)),
+        }
+
+    async def block_search(self, params: dict) -> dict:
+        """rpc/core/blocks.go BlockSearch over the block indexer."""
+        query = params.get("query", "")
+        if not query:
+            raise RPCError(-32602, "missing query param")
+        if self.node.block_indexer is None:
+            raise RPCError(-32603, "block indexing disabled")
+        try:
+            heights = self.node.block_indexer.search(
+                query, limit=int(params.get("per_page") or 30))
+        except Exception as e:  # noqa: BLE001
+            raise RPCError(-32602, f"bad query: {e}") from e
+        blocks = []
+        for h in heights:
+            blk = self.node.block_store.load_block(h)
+            if blk is not None:
+                blocks.append({"block_id": {"hash": _hex(blk.hash())},
+                               "block": self._block_dict(blk)})
+        return {"blocks": blocks, "total_count": str(len(blocks))}
 
     async def unconfirmed_txs(self, params: dict) -> dict:
         limit = int(params.get("limit") or 30)
@@ -359,6 +483,10 @@ class Environment:
             "abci_query": self.abci_query,
             "broadcast_tx_async": self.broadcast_tx_async,
             "broadcast_tx_sync": self.broadcast_tx_sync,
+            "broadcast_tx_commit": self.broadcast_tx_commit,
+            "tx": self.tx,
+            "tx_search": self.tx_search,
+            "block_search": self.block_search,
             "unconfirmed_txs": self.unconfirmed_txs,
             "num_unconfirmed_txs": self.num_unconfirmed_txs,
             "broadcast_evidence": self.broadcast_evidence,

@@ -10,15 +10,20 @@ with the pubsub EventBus (rpc/core/events.go analog).
 from __future__ import annotations
 
 import asyncio
+import base64
+import hashlib
 import json
 import urllib.parse
 
 from cometbft_tpu.libs import log as cmtlog
-from cometbft_tpu.libs.service import BaseService
-from cometbft_tpu.rpc.core import Environment, RPCError
+from cometbft_tpu.libs.service import BaseService, TaskRunner
+from cometbft_tpu.rpc.core import Environment, QuotedStr, RPCError, UriStr
 
 MAX_BODY = 1_000_000
 MAX_HEADERS = 64
+WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"  # RFC 6455 §1.3
+WS_MAX_FRAME = 1 << 20
+WS_MAX_MESSAGE = 1 << 21  # aggregate cap across fragments (HTTP has MAX_BODY)
 
 
 class RPCServer(BaseService):
@@ -66,6 +71,9 @@ class RPCServer(BaseService):
                         break
                     k, _, v = line.decode("latin-1").partition(":")
                     headers[k.strip().lower()] = v.strip()
+                if headers.get("upgrade", "").lower() == "websocket":
+                    await self._handle_websocket(reader, writer, headers)
+                    return
                 body = b""
                 n = int(headers.get("content-length", 0) or 0)
                 if n > MAX_BODY:
@@ -104,8 +112,13 @@ class RPCServer(BaseService):
             if route == "":
                 return 200, {"routes": sorted(self.routes)}
             params = {k: v[0] for k, v in urllib.parse.parse_qs(query).items()}
-            # URI params arrive quoted (reference http_uri_handler.go)
-            params = {k: v.strip('"') for k, v in params.items()}
+            # quoted URI params are string literals, unquoted hex/number
+            # (http_uri_handler.go); keep which on the value so []byte args
+            # decode correctly — JSON-body params stay plain str (base64)
+            params = {
+                k: QuotedStr(v[1:-1]) if len(v) >= 2 and v[0] == v[-1] == '"' else UriStr(v)
+                for k, v in params.items()
+            }
             envelope = {"jsonrpc": "2.0", "id": -1, "method": route, "params": params}
             return 200, await self._call_one(envelope)
         return 405, {"error": "method not allowed"}
@@ -142,6 +155,203 @@ class RPCServer(BaseService):
         )
         writer.write(head.encode() + body)
         await writer.drain()
+
+
+    # ---------------------------------------------------------- websocket
+    # Reference: rpc/jsonrpc/server/ws_handler.go + rpc/core/events.go —
+    # JSON-RPC over an RFC 6455 socket, with subscribe/unsubscribe backed
+    # by the EventBus; matching events are pushed as they fire.
+
+    async def _handle_websocket(self, reader, writer, headers) -> None:
+        key = headers.get("sec-websocket-key", "")
+        if not key:
+            await self._respond(writer, 400, {"error": "missing Sec-WebSocket-Key"})
+            return
+        accept = base64.b64encode(
+            hashlib.sha1((key + WS_GUID).encode()).digest()).decode()
+        writer.write(
+            ("HTTP/1.1 101 Switching Protocols\r\nUpgrade: websocket\r\n"
+             f"Connection: Upgrade\r\nSec-WebSocket-Accept: {accept}\r\n\r\n").encode())
+        await writer.drain()
+
+        peer = writer.get_extra_info("peername")
+        client_id = f"ws-{peer[0]}:{peer[1]}" if peer else f"ws-{id(writer)}"
+        tasks = TaskRunner(client_id)
+        send_lock = asyncio.Lock()
+
+        async def send_json(payload: dict) -> None:
+            async with send_lock:
+                await _ws_send(writer, json.dumps(payload).encode())
+
+        try:
+            while True:
+                opcode, data, controls = await _ws_recv(reader)
+                for cop, cdata in controls + [(opcode, data)]:
+                    if cop == 0x9:  # ping -> pong
+                        async with send_lock:
+                            await _ws_send(writer, cdata, opcode=0xA)
+                if opcode == 0x8:  # close
+                    return
+                if opcode not in (0x1, 0x2):
+                    continue
+                try:
+                    req = json.loads(data)
+                except json.JSONDecodeError:
+                    await send_json(_err_envelope(None, -32700, "parse error"))
+                    continue
+                await self._ws_call(req, client_id, tasks, send_json)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            await tasks.cancel_all()
+            try:
+                self.node.event_bus.unsubscribe_all(client_id)
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _ws_call(self, req: dict, client_id: str, tasks: TaskRunner,
+                       send_json) -> None:
+        rid = req.get("id", -1)
+        method = req.get("method", "")
+        params = req.get("params") or {}
+        bus = self.node.event_bus
+        if method == "subscribe":
+            query = params.get("query", "")
+            if not query:
+                await send_json(_err_envelope(rid, -32602, "missing query"))
+                return
+            if (bus.server.num_client_subscriptions(client_id)
+                    >= self.config.max_subscriptions_per_client):
+                await send_json(_err_envelope(rid, -32603, "too many subscriptions"))
+                return
+            try:
+                sub = bus.subscribe(client_id, query)
+            except Exception as e:  # noqa: BLE001
+                await send_json(_err_envelope(rid, -32602, f"subscribe failed: {e}"))
+                return
+            tasks.spawn(self._pump_events(sub, query, rid, send_json),
+                        name=f"ws-sub-{len(query)}")
+            await send_json({"jsonrpc": "2.0", "id": rid, "result": {}})
+        elif method == "unsubscribe":
+            try:
+                bus.unsubscribe(client_id, params.get("query", ""))
+                await send_json({"jsonrpc": "2.0", "id": rid, "result": {}})
+            except Exception as e:  # noqa: BLE001
+                await send_json(_err_envelope(rid, -32603, str(e)))
+        elif method == "unsubscribe_all":
+            try:
+                bus.unsubscribe_all(client_id)
+            except Exception:  # noqa: BLE001
+                pass
+            await send_json({"jsonrpc": "2.0", "id": rid, "result": {}})
+        else:
+            await send_json(await self._call_one(req))
+
+    async def _pump_events(self, sub, query: str, rid, send_json) -> None:
+        """events.go:105: forward matching events until cancellation."""
+        while True:
+            msg = await sub.out.get()
+            if msg is None:  # canceled
+                # tell the client its subscription died (slow consumer /
+                # server shutdown) — a silent stop would leave it waiting
+                # forever on a healthy TCP conn (ref ws_handler.go sends
+                # the cancellation reason)
+                try:
+                    await send_json(_err_envelope(
+                        f"{rid}#event", -32000,
+                        f"subscription canceled: {sub.canceled or 'server closed it'} "
+                        f"(query: {query})"))
+                except (ConnectionError, asyncio.IncompleteReadError, OSError):
+                    pass
+                return
+            await send_json({
+                "jsonrpc": "2.0",
+                "id": f"{rid}#event",
+                "result": {
+                    "query": query,
+                    "data": _event_value(msg.data),
+                    "events": msg.events,
+                },
+            })
+
+
+def _event_value(data) -> dict:
+    """Serialize event payloads for RPC consumers (shape follows the
+    reference's result_event types loosely)."""
+    from cometbft_tpu.abci import codec as abci_codec
+    from cometbft_tpu.types import event_bus as eb
+
+    if isinstance(data, eb.EventDataTx):
+        return {"type": "tendermint/event/Tx", "value": {
+            "TxResult": {
+                "height": str(data.height), "index": data.index,
+                "tx": base64.b64encode(data.tx).decode(),
+                "result": abci_codec._to_jsonable(data.result),
+            }}}
+    if isinstance(data, eb.EventDataNewBlock):
+        blk = data.block
+        return {"type": "tendermint/event/NewBlock", "value": {
+            "block": {
+                "header": {"height": str(blk.header.height),
+                           "chain_id": blk.header.chain_id,
+                           "app_hash": blk.header.app_hash.hex().upper()},
+                "num_txs": str(len(blk.data.txs)),
+            }}}
+    if isinstance(data, eb.EventDataRoundState):
+        return {"type": "tendermint/event/RoundState", "value": {
+            "height": str(data.height), "round": data.round_, "step": data.step}}
+    return {"type": f"tendermint/event/{type(data).__name__}", "value": {}}
+
+
+async def _ws_recv(reader) -> tuple[int, bytes, list[tuple[int, bytes]]]:
+    """Read one (possibly fragmented) RFC 6455 message from a client.
+    Control frames may legally interleave with message fragments
+    (RFC 6455 §5.4); they are collected and returned alongside the data
+    message so no fragment state is lost. A close control short-circuits.
+    Returns (opcode, payload, controls-seen-before-completion)."""
+    opcode = None
+    buf = b""
+    controls: list[tuple[int, bytes]] = []
+    while True:
+        h = await reader.readexactly(2)
+        fin = h[0] & 0x80
+        op = h[0] & 0x0F
+        masked = h[1] & 0x80
+        ln = h[1] & 0x7F
+        if ln == 126:
+            ln = int.from_bytes(await reader.readexactly(2), "big")
+        elif ln == 127:
+            ln = int.from_bytes(await reader.readexactly(8), "big")
+        if ln > WS_MAX_FRAME or len(buf) + ln > WS_MAX_MESSAGE:
+            raise ConnectionError("ws frame/message too large")
+        mask = await reader.readexactly(4) if masked else b""
+        payload = await reader.readexactly(ln)
+        if masked:
+            payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        if op == 0x8:  # close ends everything, fragments moot
+            return op, payload, controls
+        if op in (0x9, 0xA):
+            if opcode is None and not buf:
+                return op, payload, controls  # no fragmentation in flight
+            controls.append((op, payload))
+            continue
+        opcode = opcode if op == 0 else op
+        buf += payload
+        if fin:
+            return opcode or 0x1, buf, controls
+
+
+async def _ws_send(writer, payload: bytes, opcode: int = 0x1) -> None:
+    ln = len(payload)
+    head = bytes([0x80 | opcode])
+    if ln < 126:
+        head += bytes([ln])
+    elif ln < (1 << 16):
+        head += bytes([126]) + ln.to_bytes(2, "big")
+    else:
+        head += bytes([127]) + ln.to_bytes(8, "big")
+    writer.write(head + payload)
+    await writer.drain()
 
 
 def _err_envelope(rid, code: int, message: str) -> dict:

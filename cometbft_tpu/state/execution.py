@@ -36,6 +36,10 @@ class ErrProposalRejected(Exception):
     pass
 
 
+class ErrVoteExtensionRejected(Exception):
+    pass
+
+
 def _abci_commit_info(block: Block, last_val_set: ValidatorSet | None) -> abci.CommitInfo:
     """Build CommitInfo from the block's LastCommit
     (state/execution.go buildLastCommitInfo)."""
@@ -181,6 +185,24 @@ class BlockExecutor:
         if resp.status == abci.ProposalStatus.UNKNOWN:
             raise ErrProposalRejected("ProcessProposal responded with status UNKNOWN")
         return resp.is_accepted()
+
+    async def verify_vote_extension(self, vote) -> None:
+        """execution.go:349-366 VerifyVoteExtension — consult the app on
+        every peer precommit extension. Raises ErrVoteExtensionRejected when
+        the app answers anything but ACCEPT (the reference panics on an
+        unknown status; a rejected extension just drops the vote)."""
+        req = abci.RequestVerifyVoteExtension(
+            hash=vote.block_id.hash,
+            validator_address=vote.validator_address,
+            height=vote.height,
+            vote_extension=vote.extension,
+        )
+        resp = await self.app_conn.verify_vote_extension(req)
+        if resp.status != abci.VerifyStatus.ACCEPT:
+            raise ErrVoteExtensionRejected(
+                f"app rejected vote extension (status={resp.status}) from "
+                f"{vote.validator_address.hex()[:12]} at height {vote.height}"
+            )
 
     # ----------------------------------------------------------- validate
 

@@ -52,6 +52,18 @@ class Vote:
             return False
         return pub_key.verify_signature(self.sign_bytes(chain_id), self.signature)
 
+    def verify_extension(self, chain_id: str, pub_key: crypto.PubKey) -> bool:
+        """Only the extension signature (types/vote.go:247 VerifyExtension)
+        — the gate before the app sees the payload; the vote's own
+        signature verifies separately (serial add or device-batch flush)."""
+        if self.type_ != SignedMsgType.PRECOMMIT or self.block_id.is_nil():
+            return True
+        if not self.extension_signature:
+            return False
+        return pub_key.verify_signature(
+            self.extension_sign_bytes(chain_id), self.extension_signature
+        )
+
     def verify_vote_and_extension(self, chain_id: str, pub_key: crypto.PubKey) -> bool:
         if not self.verify(chain_id, pub_key):
             return False

@@ -96,6 +96,8 @@ async def make_net(
     n_vals: int = 4,
     config: ConsensusConfig | None = None,
     chain_id: str = "net-test-chain",
+    app_factory=None,
+    ext_enable_height: int = 0,
 ) -> InProcNet:
     privs = [ed25519.gen_priv_key() for _ in range(n_vals)]
     gdoc = GenesisDoc(
@@ -106,12 +108,13 @@ async def make_net(
             for p in privs
         ],
     )
+    gdoc.consensus_params.abci.vote_extensions_enable_height = ext_enable_height
     gdoc.validate_and_complete()
 
     net = InProcNet(privs=privs)
     for i in range(n_vals):
         state = State.from_genesis(gdoc)
-        app = KVStoreApplication()
+        app = (app_factory or KVStoreApplication)()
         conns = AppConns(local_client_creator(app))
         await conns.start()
         state_store = StateStore(MemDB())

@@ -1,0 +1,238 @@
+"""Pubsub query language, EventBus, indexers, and the client-visible tx
+lifecycle (broadcast_tx_commit + websocket subscriptions) against a live
+node.
+
+Reference test analog: libs/pubsub/pubsub_test.go + query tests,
+state/txindex/kv/kv_test.go, rpc/core tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import json
+import os
+import secrets
+
+import pytest
+
+from cometbft_tpu.abci.types import Event, EventAttribute, ExecTxResult
+from cometbft_tpu.libs import pubsub
+from cometbft_tpu.node import Node, init_files
+from cometbft_tpu.state.txindex import BlockIndexer, TxIndexer, TxResult
+from cometbft_tpu.store import MemDB
+from cometbft_tpu.types import event_bus as eb
+from cometbft_tpu.types.block import tx_hash
+
+from tests.test_node import _node_config, _rpc_call
+
+
+# ------------------------------------------------------------------ query
+
+
+def test_query_parse_and_match():
+    q = pubsub.Query("tm.event = 'Tx' AND tx.height > 5 AND acc.name CONTAINS 'fre'")
+    assert q.matches({"tm.event": ["Tx"], "tx.height": ["6"], "acc.name": ["alfred"]})
+    assert not q.matches({"tm.event": ["Tx"], "tx.height": ["5"], "acc.name": ["alfred"]})
+    assert not q.matches({"tm.event": ["Tx"], "tx.height": ["9"], "acc.name": ["bob"]})
+    assert not q.matches({"tm.event": ["NewBlock"], "tx.height": ["9"], "acc.name": ["fred"]})
+    # any-value semantics: one matching value among many is enough
+    assert q.matches({"tm.event": ["Tx"], "tx.height": ["7"], "acc.name": ["bob", "fred"]})
+
+
+def test_query_operators():
+    assert pubsub.Query("k EXISTS").matches({"k": ["x"]})
+    assert not pubsub.Query("k EXISTS").matches({"o": ["x"]})
+    assert pubsub.Query("k != 'a'").matches({"k": ["b"]})
+    assert pubsub.Query("k <= 3").matches({"k": ["3"]})
+    assert not pubsub.Query("k < 3").matches({"k": ["3"]})
+    assert pubsub.Query("k = 'it''s'".replace("''", "\\'")).matches({"k": ["it's"]})
+
+
+def test_query_rejects_garbage():
+    for bad in ("", "AND", "k =", "= 'x'", "k & 'x'", "k = 'x' OR j = 'y'"):
+        with pytest.raises(pubsub.QueryError):
+            pubsub.Query(bad)
+
+
+def test_pubsub_fanout_and_capacity():
+    async def main():
+        srv = pubsub.Server(capacity_per_subscription=2)
+        s1 = srv.subscribe("c1", "tm.event = 'Tx'")
+        s2 = srv.subscribe("c2", "tm.event = 'NewBlock'")
+        with pytest.raises(pubsub.ErrAlreadySubscribed):
+            srv.subscribe("c1", "tm.event = 'Tx'")
+        srv.publish("t1", {"tm.event": ["Tx"]})
+        srv.publish("b1", {"tm.event": ["NewBlock"]})
+        assert (await s1.out.get()).data == "t1"
+        assert (await s2.out.get()).data == "b1"
+        # overflow cancels the subscription rather than blocking consensus
+        for i in range(4):
+            srv.publish(f"t{i}", {"tm.event": ["Tx"]})
+        assert s1.canceled == "out of capacity"
+        with pytest.raises(pubsub.ErrSubscriptionNotFound):
+            srv.unsubscribe("c1", "tm.event = 'Tx'")
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------- indexer
+
+
+def _tx_result(height, index, tx, sender="alice"):
+    return TxResult(height, index, tx, ExecTxResult(
+        code=0,
+        events=[Event(type_="transfer", attributes=[
+            EventAttribute(key="sender", value=sender),
+            EventAttribute(key="amount", value=str(100 * height)),
+        ])],
+    ))
+
+
+def test_tx_indexer_roundtrip_and_search():
+    ix = TxIndexer(MemDB())
+    txs = [f"tx-{i}".encode() for i in range(6)]
+    for i, tx in enumerate(txs):
+        ix.index(_tx_result(height=i + 1, index=0, tx=tx,
+                            sender="alice" if i % 2 == 0 else "bob"))
+
+    got = ix.get(tx_hash(txs[2]))
+    assert got is not None and got.height == 3 and got.tx == txs[2]
+    assert ix.get(b"\x00" * 32) is None
+
+    by_hash = ix.search(f"tx.hash = '{tx_hash(txs[4]).hex()}'")
+    assert [r.height for r in by_hash] == [5]
+    by_sender = ix.search("transfer.sender = 'bob'")
+    assert [r.height for r in by_sender] == [2, 4, 6]
+    ranged = ix.search("tx.height >= 3 AND tx.height < 6")
+    assert [r.height for r in ranged] == [3, 4, 5]
+    both = ix.search("transfer.sender = 'alice' AND tx.height > 1")
+    assert [r.height for r in both] == [3, 5]
+    contains = ix.search("transfer.sender CONTAINS 'li'")
+    assert [r.height for r in contains] == [1, 3, 5]
+    # ranged condition over a non-reserved key: post-filtered
+    amt = ix.search("transfer.amount > 350")
+    assert [r.height for r in amt] == [4, 5, 6]
+
+
+def test_block_indexer_search():
+    bx = BlockIndexer(MemDB())
+    for h in range(1, 5):
+        bx.index(h, [Event(type_="rewards", attributes=[
+            EventAttribute(key="epoch", value=str(h // 2))])])
+    assert bx.has(3) and not bx.has(9)
+    assert bx.search("rewards.epoch = '1'") == [2, 3]
+    assert bx.search("block.height > 2") == [3, 4]
+
+
+def test_event_bus_tx_flow():
+    async def main():
+        bus = eb.EventBus()
+        sub = bus.subscribe("me", "tm.event = 'Tx' AND transfer.sender = 'carol'")
+        res = ExecTxResult(events=[Event(type_="transfer", attributes=[
+            EventAttribute(key="sender", value="carol")])])
+        await bus.publish_event_tx(7, b"mytx", 0, res)
+        await bus.publish_event_tx(8, b"other", 0, ExecTxResult())
+        msg = await asyncio.wait_for(sub.out.get(), 2)
+        assert msg.data.height == 7
+        assert msg.events[eb.TX_HASH_KEY] == [tx_hash(b"mytx").hex().upper()]
+        assert sub.out.empty()  # the non-matching tx was filtered
+
+    asyncio.run(main())
+
+
+# ------------------------------------------- live node: tx lifecycle + ws
+
+
+async def _ws_client_connect(addr: str):
+    host, port = addr.rsplit(":", 1)
+    reader, writer = await asyncio.open_connection(host, int(port))
+    key = base64.b64encode(secrets.token_bytes(16)).decode()
+    writer.write((
+        f"GET /websocket HTTP/1.1\r\nHost: {addr}\r\nUpgrade: websocket\r\n"
+        f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+        "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+    await writer.drain()
+    status = await reader.readline()
+    assert b"101" in status
+    while (await reader.readline()) not in (b"\r\n", b""):
+        pass
+    return reader, writer
+
+
+async def _ws_send_text(writer, text: str) -> None:
+    payload = text.encode()
+    mask = secrets.token_bytes(4)
+    masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+    ln = len(payload)
+    if ln < 126:
+        head = bytes([0x81, 0x80 | ln])
+    else:
+        head = bytes([0x81, 0x80 | 126]) + ln.to_bytes(2, "big")
+    writer.write(head + mask + masked)
+    await writer.drain()
+
+
+async def _ws_recv_json(reader) -> dict:
+    h = await reader.readexactly(2)
+    ln = h[1] & 0x7F
+    if ln == 126:
+        ln = int.from_bytes(await reader.readexactly(2), "big")
+    elif ln == 127:
+        ln = int.from_bytes(await reader.readexactly(8), "big")
+    payload = await reader.readexactly(ln)
+    return json.loads(payload)
+
+
+def test_node_tx_lifecycle_and_ws_subscription(tmp_path):
+    """broadcast_tx_commit round-trips against a running node; a websocket
+    subscriber sees the NewBlock events; tx + tx_search find the committed
+    tx (VERDICT item 9 'Done' criterion)."""
+    home = str(tmp_path / "home")
+    init_files(home, chain_id="ev-chain", moniker="ev0")
+
+    async def main():
+        node = Node(_node_config(home))
+        await node.start()
+        try:
+            addr = node.rpc_server.bound_addr
+            # ws subscribe to NewBlock before sending the tx
+            reader, writer = await _ws_client_connect(addr)
+            await _ws_send_text(writer, json.dumps({
+                "jsonrpc": "2.0", "id": 5, "method": "subscribe",
+                "params": {"query": "tm.event = 'NewBlock'"}}))
+            ack = await asyncio.wait_for(_ws_recv_json(reader), 5)
+            assert ack["id"] == 5 and "error" not in ack
+
+            tx = f"evkey=evval-{os.getpid()}".encode()
+            resp = await asyncio.wait_for(_rpc_call(
+                addr, "broadcast_tx_commit",
+                {"tx": base64.b64encode(tx).decode()}), 15)
+            result = resp["result"]
+            assert result["check_tx"]["code"] == 0
+            assert result["tx_result"]["code"] == 0
+            committed_at = int(result["height"])
+            assert committed_at >= 1
+
+            # the websocket got NewBlock events, eventually incl. our height
+            seen = set()
+            while committed_at not in seen:
+                ev = await asyncio.wait_for(_ws_recv_json(reader), 10)
+                assert ev["result"]["query"] == "tm.event = 'NewBlock'"
+                seen.add(int(ev["result"]["data"]["value"]["block"]["header"]["height"]))
+            writer.close()
+
+            # indexer surfaces: tx by hash + tx_search by height
+            h = result["hash"]
+            got = await _rpc_call(addr, "tx", {"hash": h})
+            assert got["result"]["height"] == str(committed_at)
+            assert base64.b64decode(got["result"]["tx"]) == tx
+            search = await _rpc_call(
+                addr, "tx_search", {"query": f"tx.height = {committed_at}"})
+            assert search["result"]["total_count"] == "1"
+            assert search["result"]["txs"][0]["hash"] == h
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
