@@ -76,9 +76,11 @@ class ConsensusState(BaseService):
         priv_validator: PrivValidator | None = None,
         event_switch=None,
         logger: cmtlog.Logger | None = None,
+        metrics=None,
     ):
         super().__init__("ConsensusState", logger)
         self.config = config
+        self.metrics = metrics  # libs.metrics.ConsensusMetrics | None
         self.block_exec = block_exec
         self.block_store = block_store
         self.wal = wal
@@ -676,6 +678,22 @@ class ConsensusState(BaseService):
             "finalized block", height=height, hash=block.hash().hex()[:12],
             txs=len(block.data.txs), app_hash=new_state.app_hash.hex()[:12],
         )
+        if self.metrics is not None:
+            m = self.metrics
+            m.height.set(height)
+            m.rounds.set(rs.commit_round)
+            m.num_txs.set(len(block.data.txs))
+            m.total_txs.inc(len(block.data.txs))
+            if block_parts is not None:
+                m.block_size.set(sum(
+                    len(p.bytes_) for p in block_parts.parts if p is not None))
+            if self.state is not None and not self.state.last_block_time.is_zero():
+                m.block_interval.observe(
+                    (block.header.time.unix_ns() - self.state.last_block_time.unix_ns())
+                    / 1e9)
+            if rs.validators is not None:
+                m.validators.set(len(rs.validators))
+                m.validators_power.set(rs.validators.total_voting_power())
         self.update_to_state(new_state)
         self._schedule_round_0(self.rs)
 
@@ -733,17 +751,16 @@ class ConsensusState(BaseService):
             return False
 
     def _conflicts_to_evidence(self, conflicts) -> None:
-        """Equivocations -> DuplicateVoteEvidence into the pool
-        (state.go:2117-2146). Takes a list so one batched flush can report
-        every conflicting pair it found."""
+        """Equivocations -> the pool's consensus buffer (state.go:2117-2146
+        ReportConflictingVotes). The pool materializes DuplicateVoteEvidence
+        once the header at the vote height commits, stamping the BLOCK time
+        — the timestamp other pools cross-check against. Takes a list so one
+        batched flush can report every conflicting pair it found."""
         for e in conflicts:
             if self.block_exec.evidence_pool is not None:
-                from cometbft_tpu.types.evidence import DuplicateVoteEvidence
-
-                ev = DuplicateVoteEvidence.new(
-                    e.vote_a, e.vote_b, self.state.last_block_time, self.rs.validators
+                self.block_exec.evidence_pool.report_conflicting_votes(
+                    e.vote_a, e.vote_b
                 )
-                self.block_exec.evidence_pool.add_evidence(ev)
             self.logger.info(
                 "found and sent conflicting vote to evidence pool",
                 vote=str(e.vote_b),
@@ -782,7 +799,14 @@ class ConsensusState(BaseService):
             if not vote.verify_extension(self.state.chain_id, val.pub_key):
                 self.logger.info("invalid vote extension signature", vote=str(vote))
                 return False
-            await self.block_exec.verify_vote_extension(vote)
+            try:
+                await self.block_exec.verify_vote_extension(vote)
+            except Exception:
+                if self.metrics is not None:
+                    self.metrics.vote_extension_received.labels("rejected").inc()
+                raise
+            if self.metrics is not None:
+                self.metrics.vote_extension_received.labels("accepted").inc()
 
         if self.config.batch_vote_verification and peer_id:
             return await self._add_vote_batched(vote, peer_id)
@@ -835,6 +859,10 @@ class ConsensusState(BaseService):
     async def _flush_vote_set(self, vs: VoteSet) -> None:
         """One device batch for a VoteSet's staged votes; then events +
         threshold hooks for what got added, evidence for equivocations."""
+        n_pending = len(vs._pending)
+        if self.metrics is not None and n_pending > 0:
+            self.metrics.batch_flushes.inc()
+            self.metrics.batch_lanes.inc(n_pending)
         try:
             results = vs.flush_pending()
         except ErrVoteConflictingVotes as e:

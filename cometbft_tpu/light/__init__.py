@@ -1,0 +1,44 @@
+"""Light client (reference: light/).
+
+verifier — stateless VerifyAdjacent / VerifyNonAdjacent / Verify / backwards
+client   — trusted store + bisection + fork detection + attack evidence
+provider — light-block sources (in-memory; node-backed lives with statesync)
+store    — persisted trusted light blocks
+"""
+
+from cometbft_tpu.light import errors, verifier
+from cometbft_tpu.light.client import (
+    SEQUENTIAL,
+    SKIPPING,
+    Client,
+    TrustOptions,
+    make_attack_evidence,
+)
+from cometbft_tpu.light.errors import (
+    ErrInvalidHeader,
+    ErrLightClientAttack,
+    ErrNewValSetCantBeTrusted,
+    ErrOldHeaderExpired,
+    ErrVerificationFailed,
+    LightClientError,
+)
+from cometbft_tpu.light.provider import MemProvider, Provider
+from cometbft_tpu.light.store import LightStore
+from cometbft_tpu.light.verifier import (
+    DEFAULT_TRUST_LEVEL,
+    header_expired,
+    validate_trust_level,
+    verify,
+    verify_adjacent,
+    verify_backwards,
+    verify_non_adjacent,
+)
+
+__all__ = [
+    "errors", "verifier", "Client", "TrustOptions", "SEQUENTIAL", "SKIPPING",
+    "make_attack_evidence", "MemProvider", "Provider", "LightStore",
+    "DEFAULT_TRUST_LEVEL", "header_expired", "validate_trust_level",
+    "verify", "verify_adjacent", "verify_backwards", "verify_non_adjacent",
+    "ErrInvalidHeader", "ErrLightClientAttack", "ErrNewValSetCantBeTrusted",
+    "ErrOldHeaderExpired", "ErrVerificationFailed", "LightClientError",
+]

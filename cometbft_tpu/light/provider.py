@@ -1,0 +1,65 @@
+"""Light-block providers.
+
+Reference: light/provider/provider.go (interface), light/provider/mock
+(test double), light/provider/http (RPC-backed). The RPC-backed provider
+lives in light/rpc_provider.py next to the JSON-RPC client; here are the
+interface and the deterministic in-memory provider used by tests and the
+bench harness.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Optional
+
+from cometbft_tpu.types.light import LightBlock
+
+from cometbft_tpu.light.errors import ErrHeightTooHigh, ErrLightBlockNotFound
+
+
+class Provider(ABC):
+    """light/provider/provider.go:10-36."""
+
+    @abstractmethod
+    async def light_block(self, height: int) -> LightBlock:
+        """Return the LightBlock at height (0 = latest). Raises
+        ErrLightBlockNotFound / ErrHeightTooHigh / ErrBadLightBlock."""
+
+    @abstractmethod
+    async def report_evidence(self, ev) -> None:
+        """Hand misbehavior proof to the provider's node."""
+
+    def id_(self) -> str:
+        return repr(self)
+
+
+class MemProvider(Provider):
+    """light/provider/mock/mock.go: a provider over an in-memory chain map.
+    Mutable so tests can fork it (serve conflicting headers past a height)."""
+
+    def __init__(self, chain_id: str, blocks: dict[int, LightBlock], name: str = "mem"):
+        self.chain_id = chain_id
+        self.blocks = dict(blocks)
+        self.name = name
+        self.evidence: list = []
+        self.fail_after: Optional[int] = None  # simulate a stalled provider
+
+    async def light_block(self, height: int) -> LightBlock:
+        if self.fail_after is not None and height > self.fail_after:
+            raise ErrLightBlockNotFound(f"{self.name}: no block at {height}")
+        if height == 0:
+            if not self.blocks:
+                raise ErrLightBlockNotFound(f"{self.name}: empty chain")
+            return self.blocks[max(self.blocks)]
+        lb = self.blocks.get(height)
+        if lb is None:
+            if self.blocks and height > max(self.blocks):
+                raise ErrHeightTooHigh(f"{self.name}: head is {max(self.blocks)}")
+            raise ErrLightBlockNotFound(f"{self.name}: no block at {height}")
+        return lb
+
+    async def report_evidence(self, ev) -> None:
+        self.evidence.append(ev)
+
+    def id_(self) -> str:
+        return self.name

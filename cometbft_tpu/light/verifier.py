@@ -1,0 +1,211 @@
+"""Stateless light-client header verification.
+
+Reference: light/verifier.go:32-245. Three entry points:
+
+  verify_adjacent      — height X → X+1: the new valset hash must equal the
+                         trusted header's next_validators_hash, then +2/3 of
+                         the new set must have signed.
+  verify_non_adjacent  — height X → Y > X+1: trust-level (default 1/3) of the
+                         TRUSTED valset must appear in the new commit, then
+                         +2/3 of the new set must have signed.
+  verify               — dispatch on adjacency.
+
+Both commit checks ride the batch-first crypto boundary
+(types/validation.py): on the TPU backend every signature row of a commit is
+one device batch — for the 500-validator BASELINE config-4 chains that is
+the whole workload, so bisection hops verify at device batch throughput
+rather than per-signature host speed.
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu.types.light import LightBlock, SignedHeader
+from cometbft_tpu.types.validation import (
+    ErrNotEnoughVotingPowerSigned,
+    Fraction,
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
+from cometbft_tpu.types.validator import ValidatorSet
+from cometbft_tpu.utils import cmttime
+
+from cometbft_tpu.light.errors import (
+    ErrInvalidHeader,
+    ErrNewValSetCantBeTrusted,
+    ErrOldHeaderExpired,
+)
+
+# light/verifier.go:16 — one correct validator is enough to trust a new header
+DEFAULT_TRUST_LEVEL = Fraction(1, 3)
+
+
+def validate_trust_level(lvl: Fraction) -> None:
+    """light/verifier.go:197-205: trust level must be in [1/3, 1]."""
+    if (
+        lvl.numerator * 3 < lvl.denominator
+        or lvl.numerator > lvl.denominator
+        or lvl.denominator == 0
+    ):
+        raise ValueError(f"trustLevel must be within [1/3, 1], given {lvl}")
+
+
+def header_expired(h: SignedHeader, trusting_period_ns: int, now: cmttime.Timestamp) -> bool:
+    """light/verifier.go:208-211."""
+    expiration_ns = h.time.unix_ns() + trusting_period_ns
+    return expiration_ns <= now.unix_ns()
+
+
+def _verify_new_header_and_vals(
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusted_header: SignedHeader,
+    now: cmttime.Timestamp,
+    max_clock_drift_ns: int,
+) -> None:
+    """light/verifier.go:153-193."""
+    try:
+        untrusted_header.validate_basic(trusted_header.chain_id)
+    except ValueError as e:
+        raise ErrInvalidHeader(f"untrusted header invalid: {e}") from e
+    if untrusted_header.height <= trusted_header.height:
+        raise ErrInvalidHeader(
+            f"expected new header height {untrusted_header.height} to be greater "
+            f"than trusted header height {trusted_header.height}"
+        )
+    if untrusted_header.time.unix_ns() <= trusted_header.time.unix_ns():
+        raise ErrInvalidHeader(
+            f"expected new header time {untrusted_header.time} to be after "
+            f"old header time {trusted_header.time}"
+        )
+    if untrusted_header.time.unix_ns() >= now.unix_ns() + max_clock_drift_ns:
+        raise ErrInvalidHeader(
+            f"new header has a time from the future {untrusted_header.time} "
+            f"(now: {now}; max clock drift: {max_clock_drift_ns}ns)"
+        )
+    if untrusted_header.header.validators_hash != untrusted_vals.hash():
+        raise ErrInvalidHeader(
+            f"expected new header validators ({untrusted_header.header.validators_hash.hex()}) "
+            f"to match those supplied ({untrusted_vals.hash().hex()}) "
+            f"at height {untrusted_header.height}"
+        )
+
+
+def verify_adjacent(
+    trusted_header: SignedHeader,
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period_ns: int,
+    now: cmttime.Timestamp,
+    max_clock_drift_ns: int,
+) -> None:
+    """light/verifier.go:93-135."""
+    if untrusted_header.height != trusted_header.height + 1:
+        raise ValueError("headers must be adjacent in height")
+    if header_expired(trusted_header, trusting_period_ns, now):
+        raise ErrOldHeaderExpired(
+            trusted_header.time.add_ns(trusting_period_ns), now
+        )
+    _verify_new_header_and_vals(
+        untrusted_header, untrusted_vals, trusted_header, now, max_clock_drift_ns
+    )
+    if untrusted_header.header.validators_hash != trusted_header.header.next_validators_hash:
+        raise ErrInvalidHeader(
+            f"expected old header next validators "
+            f"({trusted_header.header.next_validators_hash.hex()}) to match "
+            f"those from new header ({untrusted_header.header.validators_hash.hex()})"
+        )
+    try:
+        verify_commit_light(
+            trusted_header.chain_id,
+            untrusted_vals,
+            untrusted_header.commit.block_id,
+            untrusted_header.height,
+            untrusted_header.commit,
+        )
+    except Exception as e:  # noqa: BLE001 — uniform ErrInvalidHeader wrapping
+        raise ErrInvalidHeader(e) from e
+
+
+def verify_non_adjacent(
+    trusted_header: SignedHeader,
+    trusted_vals: ValidatorSet,
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period_ns: int,
+    now: cmttime.Timestamp,
+    max_clock_drift_ns: int,
+    trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+) -> None:
+    """light/verifier.go:32-90."""
+    if untrusted_header.height == trusted_header.height + 1:
+        raise ValueError("headers must be non adjacent in height")
+    if header_expired(trusted_header, trusting_period_ns, now):
+        raise ErrOldHeaderExpired(
+            trusted_header.time.add_ns(trusting_period_ns), now
+        )
+    _verify_new_header_and_vals(
+        untrusted_header, untrusted_vals, trusted_header, now, max_clock_drift_ns
+    )
+    # trust-level of the last trusted validators signed the new commit
+    try:
+        verify_commit_light_trusting(
+            trusted_header.chain_id, trusted_vals, untrusted_header.commit, trust_level
+        )
+    except ErrNotEnoughVotingPowerSigned as e:
+        raise ErrNewValSetCantBeTrusted(e) from e
+    # +2/3 of the new validators signed (last: untrusted_vals can be made
+    # large to DoS; verifier.go:69-72)
+    try:
+        verify_commit_light(
+            trusted_header.chain_id,
+            untrusted_vals,
+            untrusted_header.commit.block_id,
+            untrusted_header.height,
+            untrusted_header.commit,
+        )
+    except Exception as e:  # noqa: BLE001
+        raise ErrInvalidHeader(e) from e
+
+
+def verify(
+    trusted_header: SignedHeader,
+    trusted_vals: ValidatorSet,
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period_ns: int,
+    now: cmttime.Timestamp,
+    max_clock_drift_ns: int,
+    trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+) -> None:
+    """light/verifier.go:138-151."""
+    if untrusted_header.height != trusted_header.height + 1:
+        verify_non_adjacent(
+            trusted_header, trusted_vals, untrusted_header, untrusted_vals,
+            trusting_period_ns, now, max_clock_drift_ns, trust_level,
+        )
+    else:
+        verify_adjacent(
+            trusted_header, untrusted_header, untrusted_vals,
+            trusting_period_ns, now, max_clock_drift_ns,
+        )
+
+
+def verify_backwards(untrusted_header, trusted_header) -> None:
+    """light/verifier.go:214-245 — headers, not signed headers: walk the
+    LastBlockID hash chain one step down."""
+    try:
+        untrusted_header.validate_basic()
+    except ValueError as e:
+        raise ErrInvalidHeader(e) from e
+    if untrusted_header.chain_id != trusted_header.chain_id:
+        raise ErrInvalidHeader("header belongs to another chain")
+    if untrusted_header.time.unix_ns() >= trusted_header.time.unix_ns():
+        raise ErrInvalidHeader(
+            f"expected older header time {untrusted_header.time} to be before "
+            f"new header time {trusted_header.time}"
+        )
+    if untrusted_header.hash() != trusted_header.last_block_id.hash:
+        raise ErrInvalidHeader(
+            f"older header hash {untrusted_header.hash().hex()} does not match "
+            f"trusted header's last block {trusted_header.last_block_id.hash.hex()}"
+        )

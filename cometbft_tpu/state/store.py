@@ -72,6 +72,11 @@ class StateStore:
         raw = self.db.get(_hkey(b"V:", height))
         return _valset_from_bytes(raw) if raw is not None else None
 
+    def save_validators(self, height: int, vals: ValidatorSet) -> None:
+        """Historical valset row (state/store.go saveValidatorsInfo) —
+        blocksync/statesync backfill and test fixtures."""
+        self.db.set(_hkey(b"V:", height), _valset_bytes(vals))
+
     # ------------------------------------------------------------- prune
 
     def prune_states(self, retain_height: int) -> int:

@@ -170,16 +170,98 @@ class LightClientAttackEvidence(Evidence):
         ]
 
     def bytes_(self) -> bytes:
+        return self.to_proto()
+
+    def to_proto(self) -> bytes:
+        """tendermint.types.LightClientAttackEvidence: conflicting_block=1,
+        common_height=2, byzantine_validators=3, total_voting_power=4,
+        timestamp=5."""
         w = pb.Writer()
-        # structural encoding: conflicting block header hash + common height
-        sh = self.conflicting_block.signed_header if self.conflicting_block else None
-        w.bytes(1, sh.header.hash() if sh else b"")
+        w.message(1, self.conflicting_block.to_proto(), always=True)
         w.varint_i64(2, self.common_height)
-        w.varint_i64(3, self.total_voting_power)
+        for v in self.byzantine_validators:
+            w.message(3, v.to_proto(), always=True)
+        w.varint_i64(4, self.total_voting_power)
         w.message(
-            4, pb.timestamp_bytes(self.timestamp.seconds, self.timestamp.nanos), always=True
+            5, pb.timestamp_bytes(self.timestamp.seconds, self.timestamp.nanos), always=True
         )
         return w.output()
+
+    @classmethod
+    def from_proto(cls, data: bytes) -> "LightClientAttackEvidence":
+        from cometbft_tpu.types.light import LightBlock
+
+        r = pb.Reader(data)
+        ev = cls(conflicting_block=None, common_height=0)
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1:
+                ev.conflicting_block = LightBlock.from_proto(r.read_bytes())
+            elif f == 2:
+                ev.common_height = r.read_varint_i64()
+            elif f == 3:
+                ev.byzantine_validators.append(Validator.from_proto(r.read_bytes()))
+            elif f == 4:
+                ev.total_voting_power = r.read_varint_i64()
+            elif f == 5:
+                secs, nanos = r.read_timestamp()
+                ev.timestamp = cmttime.Timestamp(secs, nanos)
+            else:
+                r.skip(w)
+        return ev
+
+    def hash(self) -> bytes:
+        """types/evidence.go:322-329: header hash + common height — stable
+        across byzantine-validator permutations (dedup key)."""
+        w = pb.Writer()
+        w.bytes(1, self.conflicting_block.hash() or b"")
+        w.varint_i64(2, self.common_height)
+        return tmhash.sum_(w.output())
+
+    def conflicting_header_is_invalid(self, trusted_header) -> bool:
+        """types/evidence.go:303-312: lunatic iff any state-derived header
+        field differs from the trusted header at the same height."""
+        ch = self.conflicting_block.header
+        return (
+            trusted_header.validators_hash != ch.validators_hash
+            or trusted_header.next_validators_hash != ch.next_validators_hash
+            or trusted_header.consensus_hash != ch.consensus_hash
+            or trusted_header.app_hash != ch.app_hash
+            or trusted_header.last_results_hash != ch.last_results_hash
+        )
+
+    def get_byzantine_validators(self, common_vals: ValidatorSet,
+                                 trusted) -> list[Validator]:
+        """types/evidence.go:250-300: classify the attack and extract the
+        culprits. Lunatic -> signers of the conflicting commit who are in
+        the common valset; equivocation (same round) -> validators who
+        signed both commits; amnesia (different rounds) -> unknown."""
+        from cometbft_tpu.types.basic import BlockIDFlag
+
+        out: list[Validator] = []
+        conflicting = self.conflicting_block
+        if self.conflicting_header_is_invalid(trusted.header):
+            for cs in conflicting.commit.signatures:
+                if cs.block_id_flag != BlockIDFlag.COMMIT:
+                    continue
+                _, val = common_vals.get_by_address(cs.validator_address)
+                if val is None:
+                    continue
+                out.append(val)
+        elif trusted.commit.round_ == conflicting.commit.round_:
+            for i, sig_a in enumerate(conflicting.commit.signatures):
+                if sig_a.block_id_flag != BlockIDFlag.COMMIT:
+                    continue
+                if i >= len(trusted.commit.signatures):
+                    continue
+                sig_b = trusted.commit.signatures[i]
+                if sig_b.block_id_flag != BlockIDFlag.COMMIT:
+                    continue
+                _, val = conflicting.validator_set.get_by_address(sig_a.validator_address)
+                if val is not None:
+                    out.append(val)
+        out.sort(key=lambda v: (-v.voting_power, v.address))
+        return out
 
     def height(self) -> int:
         return self.common_height
@@ -204,6 +286,8 @@ def evidence_list_to_proto(evs: list[Evidence]) -> bytes:
         inner = pb.Writer()
         if isinstance(ev, DuplicateVoteEvidence):
             inner.message(1, ev.to_proto(), always=True)
+        elif isinstance(ev, LightClientAttackEvidence):
+            inner.message(2, ev.to_proto(), always=True)
         else:
             raise ValueError(f"unsupported evidence type for wire: {type(ev)}")
         w.message(1, inner.output(), always=True)
@@ -221,6 +305,8 @@ def evidence_list_from_proto(data: bytes) -> list[Evidence]:
                 ef, ew = er.read_tag()
                 if ef == 1:
                     out.append(DuplicateVoteEvidence.from_proto(er.read_bytes()))
+                elif ef == 2:
+                    out.append(LightClientAttackEvidence.from_proto(er.read_bytes()))
                 else:
                     er.skip(ew)
         else:

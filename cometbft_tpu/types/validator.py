@@ -80,6 +80,44 @@ class Validator:
         w.varint_i64(2, self.voting_power)
         return w.output()
 
+    def to_proto(self) -> bytes:
+        """tendermint.types.Validator: address=1, pub_key=2, voting_power=3,
+        proposer_priority=4 (types/validator.go ToProto)."""
+        w = pb.Writer()
+        w.bytes(1, self.address)
+        w.message(2, pub_key_to_proto(self.pub_key), always=True)
+        w.varint_i64(3, self.voting_power)
+        w.varint_i64(4, self.proposer_priority)
+        return w.output()
+
+    @classmethod
+    def from_proto(cls, data: bytes) -> "Validator":
+        r = pb.Reader(data)
+        address = b""
+        pub_key = None
+        power = 0
+        priority = 0
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1:
+                address = r.read_bytes()
+            elif f == 2:
+                pub_key = pub_key_from_proto(r.read_bytes())
+            elif f == 3:
+                power = r.read_varint_i64()
+            elif f == 4:
+                priority = r.read_varint_i64()
+            else:
+                r.skip(w)
+        if pub_key is None:
+            raise ValueError("Validator proto missing pub_key")
+        return cls(
+            address=address or pub_key.address(),
+            pub_key=pub_key,
+            voting_power=power,
+            proposer_priority=priority,
+        )
+
 
 def pub_key_to_proto(pub_key: crypto.PubKey) -> bytes:
     """crypto.PublicKey oneof: ed25519=1 bytes, secp256k1=2 bytes
@@ -98,6 +136,10 @@ def pub_key_from_proto(data: bytes) -> crypto.PubKey:
         f, w = r.read_tag()
         if f == 1:
             return ed25519.PubKey(r.read_bytes())
+        if f == 2:
+            from cometbft_tpu.crypto import secp256k1
+
+            return secp256k1.PubKey(r.read_bytes())
         if f == 3:
             from cometbft_tpu.crypto import sr25519
 
@@ -307,3 +349,37 @@ class ValidatorSet:
 
     def __iter__(self):
         return iter(self.validators)
+
+    # ---------------------------------------------------------------- wire
+
+    def to_proto(self) -> bytes:
+        """tendermint.types.ValidatorSet: validators=1, proposer=2,
+        total_voting_power=3 (types/validator_set.go ToProto)."""
+        w = pb.Writer()
+        for v in self.validators:
+            w.message(1, v.to_proto(), always=True)
+        if self.proposer is not None:
+            w.message(2, self.proposer.to_proto(), always=True)
+        w.varint_i64(3, self.total_voting_power())
+        return w.output()
+
+    @classmethod
+    def from_proto(cls, data: bytes) -> "ValidatorSet":
+        r = pb.Reader(data)
+        vals: list[Validator] = []
+        proposer: Validator | None = None
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1:
+                vals.append(Validator.from_proto(r.read_bytes()))
+            elif f == 2:
+                proposer = Validator.from_proto(r.read_bytes())
+            else:
+                r.skip(w)
+        vs = cls.__new__(cls)
+        vs.validators = sorted(vals, key=lambda v: v.address)
+        vs.proposer = proposer
+        vs._total_voting_power = None
+        if vs.validators:
+            vs._update_total_voting_power()
+        return vs

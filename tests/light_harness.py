@@ -1,0 +1,158 @@
+"""Simulated chains of LightBlocks with real Ed25519 commits — the fixture
+substrate for light-client tests (the spirit of light/helpers_test.go
+genLightBlocksWithKeys)."""
+
+from __future__ import annotations
+
+import secrets
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+from cometbft_tpu.types.block import Header
+from cometbft_tpu.types.light import LightBlock, SignedHeader
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.types.vote_set import VoteSet
+from cometbft_tpu.utils import cmttime
+
+
+def make_valset(n, power=10):
+    privs = [ed25519.gen_priv_key() for _ in range(n)]
+    vals = [Validator.new(p.pub_key(), power) for p in privs]
+    vs = ValidatorSet(vals)
+    by_addr = {p.pub_key().address(): p for p in privs}
+    privs_sorted = [by_addr[v.address] for v in vs.validators]
+    return vs, privs_sorted
+
+
+class LightChain:
+    """A height-indexed chain of LightBlocks with optional validator churn.
+
+    blocks[h] is fully linked: header h carries validators_hash of valset h,
+    next_validators_hash of valset h+1, last_block_id of block h-1; the
+    commit in block h is signed by valset h over header h's real hash."""
+
+    def __init__(self, chain_id: str, num_heights: int, n_vals: int = 4,
+                 churn_every: int = 0, base_time_s: int | None = None):
+        self.chain_id = chain_id
+        self.valsets: dict[int, ValidatorSet] = {}
+        self.privs: dict[int, list] = {}
+        self.blocks: dict[int, LightBlock] = {}
+        base = base_time_s if base_time_s is not None else cmttime.now().seconds - num_heights - 100
+
+        vs, privs = make_valset(n_vals)
+        for h in range(1, num_heights + 2):
+            self.valsets[h] = vs
+            self.privs[h] = privs
+            if churn_every and h % churn_every == 0:
+                # replace one validator: remove lowest-address, add a fresh key
+                new_priv = ed25519.gen_priv_key()
+                gone = vs.validators[0]
+                vs2 = vs.copy()
+                vs2.update_with_change_set([
+                    Validator(address=gone.address, pub_key=gone.pub_key, voting_power=0),
+                    Validator.new(new_priv.pub_key(), gone.voting_power),
+                ])
+                all_privs = [p for p in privs if p.pub_key().address() != gone.address]
+                all_privs.append(new_priv)
+                by_addr = {p.pub_key().address(): p for p in all_privs}
+                privs = [by_addr[v.address] for v in vs2.validators]
+                vs = vs2
+            else:
+                vs, privs = vs.copy(), list(privs)
+
+        last_block_id = BlockID()
+        for h in range(1, num_heights + 1):
+            header = Header(
+                chain_id=chain_id,
+                height=h,
+                time=cmttime.Timestamp(base + h, 0),
+                last_block_id=last_block_id,
+                validators_hash=self.valsets[h].hash(),
+                next_validators_hash=self.valsets[h + 1].hash(),
+                consensus_hash=b"\x01" * 32,
+                app_hash=h.to_bytes(8, "big").rjust(32, b"\x00"),
+                last_results_hash=b"\x02" * 32,
+                data_hash=b"\x03" * 32,
+                last_commit_hash=b"\x04" * 32,
+                evidence_hash=b"\x05" * 32,
+                proposer_address=self.valsets[h].validators[0].address,
+            )
+            bid = BlockID(
+                hash=header.hash(),
+                part_set_header=PartSetHeader(total=1, hash=secrets.token_bytes(32)),
+            )
+            commit = self._make_commit(h, bid)
+            self.blocks[h] = LightBlock(
+                signed_header=SignedHeader(header=header, commit=commit),
+                validator_set=self.valsets[h],
+            )
+            last_block_id = bid
+
+    def _make_commit(self, height: int, block_id: BlockID, round_: int = 1):
+        vs = self.valsets[height]
+        vote_set = VoteSet(self.chain_id, height, round_, SignedMsgType.PRECOMMIT, vs)
+        for i, p in enumerate(self.privs[height]):
+            v = Vote(
+                type_=SignedMsgType.PRECOMMIT,
+                height=height,
+                round_=round_,
+                block_id=block_id,
+                timestamp=cmttime.canonical_now_ms(),
+                validator_address=p.pub_key().address(),
+                validator_index=i,
+            )
+            v.signature = p.sign(v.sign_bytes(self.chain_id))
+            vote_set.add_vote(v)
+        return vote_set.make_commit()
+
+    def forked_from(self, fork_height: int, suffix_heights: int) -> "LightChain":
+        """A lying chain: identical up to fork_height-1, then headers with a
+        corrupted app hash (lunatic-style divergence) signed by the SAME
+        validator keys — the realistic >1/3-byzantine attack."""
+        import copy
+
+        other = copy.copy(self)
+        other.blocks = dict(self.blocks)
+        other.valsets = dict(self.valsets)
+        other.privs = dict(self.privs)
+        last_block_id = (
+            self.blocks[fork_height - 1].commit.block_id
+            if fork_height > 1 else BlockID()
+        )
+        for h in range(fork_height, fork_height + suffix_heights):
+            honest = self.blocks.get(h)
+            base_time = (
+                honest.header.time if honest is not None
+                else cmttime.Timestamp(self.blocks[max(self.blocks)].header.time.seconds + 1, 0)
+            )
+            header = Header(
+                chain_id=self.chain_id,
+                height=h,
+                time=base_time,
+                last_block_id=last_block_id,
+                validators_hash=self.valsets[h].hash(),
+                next_validators_hash=self.valsets[h + 1].hash()
+                if h + 1 in self.valsets else self.valsets[h].hash(),
+                consensus_hash=b"\x01" * 32,
+                app_hash=b"\xEE" * 32,  # the lie
+                last_results_hash=b"\x02" * 32,
+                data_hash=b"\x03" * 32,
+                last_commit_hash=b"\x04" * 32,
+                evidence_hash=b"\x05" * 32,
+                proposer_address=self.valsets[h].validators[0].address,
+            )
+            bid = BlockID(
+                hash=header.hash(),
+                part_set_header=PartSetHeader(total=1, hash=secrets.token_bytes(32)),
+            )
+            commit = other._make_commit_for(h, bid)
+            other.blocks[h] = LightBlock(
+                signed_header=SignedHeader(header=header, commit=commit),
+                validator_set=self.valsets[h],
+            )
+            last_block_id = bid
+        return other
+
+    def _make_commit_for(self, height: int, block_id: BlockID):
+        return self._make_commit(height, block_id)

@@ -106,7 +106,7 @@ async def make_tcp_node(
     state_store.bootstrap(state)
     block_store = BlockStore(MemDB())
     mempool = CListMempool(MempoolConfig(), conns.mempool)
-    ev_pool = EvidencePool(MemDB(), state_store)
+    ev_pool = EvidencePool(MemDB(), state_store, block_store=block_store)
     block_exec = BlockExecutor(state_store, conns.consensus, mempool, evidence_pool=ev_pool)
     es = EventSwitch()
     cs = ConsensusState(
