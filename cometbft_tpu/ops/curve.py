@@ -68,7 +68,7 @@ def add(p: Point, q: Point) -> Point:
 
 
 def double(p: Point) -> Point:
-    """dbl-2008-hwcd. 4 squarings + 4 muls."""
+    """dbl-2008-hwcd. 4 squarings + 4 muls. Never reads p.t."""
     a = F.sq(p.x)
     b = F.sq(p.y)
     zz = F.sq(p.z)
@@ -80,8 +80,62 @@ def double(p: Point) -> Point:
     return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
 
 
+def double_no_t(p: Point) -> Point:
+    """double without materializing T (4 sq + 3 muls): doubling never reads
+    its input's T, so runs of doublings only need T on the last one — 3 of
+    every 5 ladder muls saved. The returned T is zeros and MUST NOT feed an
+    add."""
+    a = F.sq(p.x)
+    b = F.sq(p.y)
+    zz = F.sq(p.z)
+    c = F.add(zz, zz)
+    h = F.add(a, b)
+    e = F.sub(h, F.sq(F.add(p.x, p.y)))
+    g = F.sub(a, b)
+    f = F.add(c, g)
+    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), jnp.zeros_like(p.x))
+
+
 def neg(p: Point) -> Point:
     return Point(F.neg(p.x), p.y, p.z, F.neg(p.t))
+
+
+# --------------------------------------------------------------------------
+# Premultiplied-T adds: table entries store t' = D2*t, turning the addition
+# formula's c = (t1*D2)*t2 two-mul chain into one mul. Build tables with
+# true T (chained construction needs it), premultiply once at the end.
+# --------------------------------------------------------------------------
+
+
+def add_pre(p: Point, q_pre: Point, out_t: bool = True) -> Point:
+    """add-2008-hwcd-3 where q.t is premultiplied by D2: 8 muls, 7 without
+    the output T. p.t is the TRUE extended coordinate."""
+    a = F.mul(F.sub(p.y, p.x), F.sub(q_pre.y, q_pre.x))
+    b = F.mul(F.add(p.y, p.x), F.add(q_pre.y, q_pre.x))
+    c = F.mul(p.t, q_pre.t)
+    zz = F.mul(p.z, q_pre.z)
+    d = F.add(zz, zz)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add(d, c)
+    h = F.add(b, a)
+    t = F.mul(e, h) if out_t else jnp.zeros_like(p.x)
+    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), t)
+
+
+def madd_pre(p: Point, q_pre: Point, out_t: bool = True) -> Point:
+    """Mixed add: q is affine (Z=1) with premultiplied T — 7 muls, 6
+    without the output T."""
+    a = F.mul(F.sub(p.y, p.x), F.sub(q_pre.y, q_pre.x))
+    b = F.mul(F.add(p.y, p.x), F.add(q_pre.y, q_pre.x))
+    c = F.mul(p.t, q_pre.t)
+    d = F.add(p.z, p.z)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add(d, c)
+    h = F.add(b, a)
+    t = F.mul(e, h) if out_t else jnp.zeros_like(p.x)
+    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), t)
 
 
 def mul_by_cofactor(p: Point) -> Point:
@@ -120,128 +174,100 @@ def decompress_zip215(y_limbs: jnp.ndarray, sign: jnp.ndarray) -> tuple[jnp.ndar
     return ok, Point(x, y, jnp.broadcast_to(F.ONE, y.shape).astype(jnp.int32), F.mul(x, y))
 
 
-def straus_base_and_point(
-    s_bits: jnp.ndarray, k_bits: jnp.ndarray, a: Point
-) -> Point:
-    """[s]B + [k]A by interleaved (Straus) double-scalar multiplication with
-    the shared 4-entry table {O, B, A, B+A} — the same shape as the oracle's
-    double_scalar_mult, vectorized: every lane runs the same 253 iterations
-    (scalars < 2^253: s < L enforced host-side, k = H mod L), selecting its
-    table entry branch-free per bit pair.
-
-    s_bits/k_bits: (253, B) int32 in {0,1}, little-endian bit order along
-    axis 0 (bit axis leading, batch on lanes like everything else).
-    """
-    batch_shape = s_bits.shape[1:]
-    nbits = s_bits.shape[0]
-    t0 = identity(batch_shape)
-    t1 = base_point(batch_shape)
-    t2 = a
-    t3 = add(t1, a)
-
-    def select(b_s: jnp.ndarray, b_k: jnp.ndarray) -> Point:
-        bs = b_s[None]
-        bk = b_k[None]
-        coords = []
-        for c0, c1, c2, c3 in zip(t0, t1, t2, t3):
-            lo = jnp.where(bs == 1, c1, c0)
-            hi = jnp.where(bs == 1, c3, c2)
-            coords.append(jnp.where(bk == 1, hi, lo))
-        return Point(*coords)
-
-    def body(it: jnp.ndarray, acc: Point) -> Point:
-        i = nbits - 1 - it
-        acc = double(acc)
-        b_s = jax.lax.dynamic_index_in_dim(s_bits, i, axis=0, keepdims=False)
-        b_k = jax.lax.dynamic_index_in_dim(k_bits, i, axis=0, keepdims=False)
-        return add(acc, select(b_s, b_k))
-
-    # Derive the identity init from an input so its sharding "varying-ness"
-    # matches the loop body under shard_map (a replicated-constant carry
-    # would trip the manual-axes vma check).
-    zero = jnp.zeros_like(a.x)
-    one = zero + F.ONE
-    init = Point(zero, one, one, zero)
-    return jax.lax.fori_loop(0, nbits, body, init)
-
-
 # ---------------------------------------------------------------------------
-# 4-bit windowed double-scalar multiplication: 64 iterations of 4 doublings
-# + 2 table adds, vs the bitwise ladder's 253 x (double + add). The [d]B
-# table is a compile-time constant (B is fixed); the [d]A table is built
-# per batch (7 doubles + 7 adds). ~23% fewer field muls and a 4x shorter
-# loop than straus_base_and_point — shorter dependent chains compile to
-# much better TPU code than the 253-iteration dynamic-index loop.
+# Signed 5-bit ladder: 52 windows x (5 doublings + 2 adds) with digits in
+# [-16, 15] (ops.unpack.words_to_digits5_signed). vs the 4-bit ladder's
+# 64 x (4 dbl + 2 add):
+#   - 260 doublings -> 260, but 4 of every 5 skip the T mul (double_no_t)
+#   - 128 adds -> 104, the base half mixed (madd: Z=1) and all adds one
+#     mul cheaper via premultiplied table T (add_pre/madd_pre)
+#   - per-signature field muls: ~3226 -> ~2606 (-19%)
+# Negative digits select the negated entry lane-locally (x, t sign flip) —
+# table stays 17 entries, so VMEM footprint is ~equal to the 16-entry
+# unsigned table.
 # ---------------------------------------------------------------------------
 
-def _base_table_consts() -> tuple[jnp.ndarray, ...]:
-    """[d]B for d in 0..15 as canonical affine-extended limb constants,
-    each coord (16, 20, 1) for broadcast over the lane axis."""
+TABLE17 = 17  # entries 0..16
+
+
+def _base_table17_consts() -> tuple[jnp.ndarray, ...]:
+    """[d]B for d in 0..16, affine with premultiplied T: coords (17, 20, 1)
+    (x, y, z=1, t*2d)."""
     import numpy as np
 
     from cometbft_tpu.ops import limbs as L
 
-    coords = np.zeros((4, 16, L.NLIMBS), dtype=np.int32)
+    coords = np.zeros((4, TABLE17, L.NLIMBS), dtype=np.int32)
     pt = oracle.B_POINT
     acc = (0, 1, 1, 0)
-    for d in range(16):
+    d2 = F._D_INT * 2 % oracle.P
+    for d in range(TABLE17):
         if d:
             acc = oracle.point_add(acc, pt)
         zinv = pow(acc[2], oracle.P - 2, oracle.P)
         x = acc[0] * zinv % oracle.P
         y = acc[1] * zinv % oracle.P
-        for ci, v in enumerate((x, y, 1, x * y % oracle.P)):
+        for ci, v in enumerate((x, y, 1, x * y % oracle.P * d2 % oracle.P)):
             coords[ci, d] = L.int_to_limbs(v)
     return tuple(jnp.asarray(coords[ci])[:, :, None] for ci in range(4))
 
 
-_BASE_TABLE = _base_table_consts()
+_BASE_TABLE17 = _base_table17_consts()
 
 
-def build_point_table(a: Point) -> tuple[jnp.ndarray, ...]:
-    """{[0]A..[15]A} per lane: each coord stacked (16, 20, B). 7 doubles +
-    7 adds, shared across the whole 64-iteration window loop."""
+def build_point_table17(a: Point) -> tuple[jnp.ndarray, ...]:
+    """{[0]A..[16]A} per lane with premultiplied T: coords (17, 20, B).
+    15 point ops + one T-premul pass."""
     zero = jnp.zeros_like(a.x)
     one = zero + F.ONE
     t = [Point(zero, one, one, zero), a]
-    for d in range(2, 16):
+    for d in range(2, TABLE17):
         t.append(double(t[d // 2]) if d % 2 == 0 else add(t[d - 1], a))
+    d2 = jnp.broadcast_to(F.D2, a.x.shape).astype(jnp.int32)
+    t = [Point(p.x, p.y, p.z, F.mul(p.t, d2)) for p in t]
     return tuple(jnp.stack([p[ci] for p in t], axis=0) for ci in range(4))
 
 
-def _select(table: tuple[jnp.ndarray, ...], digit: jnp.ndarray) -> Point:
-    """Branch-free table lookup: 4-level binary where-tree over the 16
-    entries. table coords (16, 20, B|1), digit (B,) in 0..15 -> Point of
-    (20, B). A where-tree beats a gather on TPU: no dynamic indexing, pure
-    vector selects."""
-    coords = list(table)
+def _select17_signed(table: tuple[jnp.ndarray, ...], digit: jnp.ndarray) -> Point:
+    """Branch-free signed lookup: |d| via 4-level where-tree over entries
+    0..15 plus one fixup where for entry 16, then lane-local negation (x, t
+    sign flip — valid for premultiplied t too) where d < 0."""
+    neg_mask = (digit < 0)[None, :]
+    mag = jnp.abs(digit)
+    coords = [c[:16] for c in table]
     for level in (3, 2, 1, 0):
-        bit = ((digit >> level) & 1)[None, None, :] == 1
+        bit = ((mag >> level) & 1)[None, None, :] == 1
         half = coords[0].shape[0] // 2
         coords = [jnp.where(bit, c[half:], c[:half]) for c in coords]
-    return Point(*(c[0] for c in coords))
+    is16 = (mag == 16)[None, :]
+    x, y, z, t = (jnp.where(is16, table[ci][16], coords[ci][0]) for ci in range(4))
+    x = jnp.where(neg_mask, F.neg(x), x)
+    t = jnp.where(neg_mask, F.neg(t), t)
+    return Point(x, y, z, t)
 
 
-def windowed_double_scalar(
+def windowed_double_scalar_signed(
     s_digits: jnp.ndarray, k_digits: jnp.ndarray, a: Point
 ) -> Point:
-    """[s]B + [k]A with 4-bit windows. s_digits/k_digits: (64, B) int32
-    little-endian window digits (ops.unpack.words_to_digits4). Scalars are
-    < 2^253 < 16^64. Complete addition formulas make zero digits (identity
-    entries) branch-free no-ops."""
-    table_a = build_point_table(a)
+    """[s]B + [k]A, signed 5-bit windows. s_digits/k_digits: (52, B) int32
+    in [-16, 15], little-endian (ops.unpack.words_to_digits5_signed)."""
+    table_a = build_point_table17(a)
     bx = jnp.zeros_like(a.x)
-    table_b = tuple(c + bx[None] for c in _BASE_TABLE)  # broadcast to lanes
+    table_b = tuple(c + bx[None] for c in _BASE_TABLE17)
 
-    # most-significant digit first
     sd = s_digits[::-1]
     kd = k_digits[::-1]
 
     def body(acc: Point, digs):
         ds, dk = digs
-        acc = double(double(double(double(acc))))
-        acc = add(acc, _select(table_a, dk))
-        acc = add(acc, _select(table_b, ds))
+        for _ in range(4):
+            acc = double_no_t(acc)
+        acc = double(acc)
+        # base add first (mixed, produces T for the A add); the A add keeps
+        # T so the loop body is uniform (one traced window — the caller's
+        # final add(-R) reads it)
+        acc = madd_pre(acc, _select17_signed(table_b, ds), out_t=True)
+        acc = add_pre(acc, _select17_signed(table_a, dk), out_t=True)
         return acc, None
 
     zero = jnp.zeros_like(a.x)

@@ -80,8 +80,8 @@ def verify_math(ax, ay, az, at, r_words, s_words, k_words) -> jnp.ndarray:
     sign_r = U.words_sign(r_words)
     ok_r, r = curve.decompress_zip215(y_r, sign_r)
     neg_a = curve.neg(curve.Point(ax, ay, az, at))
-    sb_ka = curve.windowed_double_scalar(
-        U.words_to_digits4(s_words), U.words_to_digits4(k_words), neg_a
+    sb_ka = curve.windowed_double_scalar_signed(
+        U.words_to_digits5_signed(s_words), U.words_to_digits5_signed(k_words), neg_a
     )
     diff = curve.add(sb_ka, curve.neg(r))
     valid = curve.is_identity(curve.mul_by_cofactor(diff))

@@ -3,18 +3,27 @@
 Same math as ed25519_kernel.verify_math, but executed as ONE fused device
 program per 128-lane block with every intermediate held in VMEM. The
 XLA-compiled ladder materializes each field-op result to HBM (a (20, B)
-int32 array per op, ~3.6k field muls per verify), which makes the kernel
+int32 array per op, ~2.6k field muls per verify), which makes the kernel
 HBM-bound ~20x off the VPU roofline; the Pallas version streams each block
-of signatures through VMEM once: reads 4x(20,128) A-coords + 3x(8,128)
-packed words, writes a (1,128) mask, and does the entire windowed
-double-scalar ladder + R decompression in on-chip memory.
+of signatures through VMEM once: reads 4x(20,128) A-coords, one (8,128)
+packed R block and 2x(52,128) signed window digits, writes a (1,128) mask,
+and does the entire signed-window double-scalar ladder + R decompression
+in on-chip memory.
+
+Ladder: 52 windows of signed 5-bit digits — 5 doublings (4 of them
+skipping the unused T output) + a mixed premultiplied-T base add + a
+premultiplied-T point add per window (curve.windowed_double_scalar_signed
+is the shape-polymorphic source of truth; the kernel body inlines its loop
+so Mosaic sees a flat fori_loop).
 
 The kernel body reuses the shape-polymorphic field/curve jnp code
 (field.py, curve.py) — Pallas traces it onto Mosaic. Pallas forbids
 closing over device constants, so the field constants (M_SUB, D2, the
-[d]B window table, ...) enter as broadcast kernel inputs and are swapped
-into the field/curve modules for the duration of the (single-threaded)
-kernel trace.
+17-entry [d]B window table, ...) enter as broadcast kernel inputs and are
+swapped into the field/curve modules for the duration of the
+(single-threaded) kernel trace. Signed digit recoding runs as a tiny XLA
+prelude (unpack.words_to_digits5_signed) — its 52-step carry scan is
+hostile to the fused kernel but trivial for XLA.
 
 Reference seam: crypto/ed25519/ed25519.go:208-241 (curve25519-voi batch
 verifier) — this is its device replacement.
@@ -35,6 +44,7 @@ from cometbft_tpu.ops import field as F
 from cometbft_tpu.ops import unpack as U
 
 LANES = 128  # one VPU lane row per block; VMEM use ~3 MB/block
+NDIG = U.NDIGITS5
 
 # Constants the traced field/curve code needs, pre-broadcast to the lane
 # width so they're ordinary VMEM blocks (index_map pins them to block 0).
@@ -48,9 +58,11 @@ def _const_args() -> tuple[np.ndarray, ...]:
         )
         for n in _FIELD_CONST_NAMES
     ]
-    for t in curve._BASE_TABLE:
+    for t in curve._BASE_TABLE17:
         out.append(
-            np.ascontiguousarray(np.broadcast_to(np.asarray(t), (16, F.NLIMBS, LANES)))
+            np.ascontiguousarray(
+                np.broadcast_to(np.asarray(t), (curve.TABLE17, F.NLIMBS, LANES))
+            )
         )
     return tuple(out)
 
@@ -59,17 +71,20 @@ _N_CONSTS = len(_FIELD_CONST_NAMES) + 4
 
 
 def _verify_block_kernel(*refs):
-    """consts..., A-coords (20, L) int32, packed words (8, L) uint32,
-    out (1, L) int32 mask, scratch: 2x (64, L) digit buffers."""
+    """consts..., A-coords (20, L) int32, packed R words (8, L) uint32,
+    signed digits s/k (52, L) int32, out (1, L) int32 mask."""
     consts = refs[:_N_CONSTS]
-    ax, ay, az, at, rw, sw, kw, out, sdig_ref, kdig_ref = refs[_N_CONSTS:]
+    ax, ay, az, at, rw, sdig_ref, kdig_ref, out = refs[_N_CONSTS:]
 
     saved_f = {n: getattr(F, n) for n in _FIELD_CONST_NAMES}
-    saved_table = curve._BASE_TABLE
+    saved_table = curve._BASE_TABLE17
     try:
         for n, ref in zip(_FIELD_CONST_NAMES, consts):
             setattr(F, n, ref[:])
-        curve._BASE_TABLE = tuple(r[:] for r in consts[len(_FIELD_CONST_NAMES):])
+        curve._BASE_TABLE17 = tuple(
+            r[:] for r in consts[len(_FIELD_CONST_NAMES):]
+        )
+        table_b = curve._BASE_TABLE17
 
         r_words = rw[:]
         y_r = U.words_to_y_limbs(r_words)
@@ -78,36 +93,32 @@ def _verify_block_kernel(*refs):
 
         a = curve.Point(ax[:], ay[:], az[:], at[:])
         neg_a = curve.neg(a)
-        # digits land in VMEM scratch so the ladder loop can dynamic-index
-        # them (Mosaic supports pl.ds on refs, not on values)
-        sdig_ref[:] = U.words_to_digits4(sw[:])  # (64, L)
-        kdig_ref[:] = U.words_to_digits4(kw[:])
-
-        table_a = curve.build_point_table(neg_a)
-        table_b = curve._BASE_TABLE
+        table_a = curve.build_point_table17(neg_a)
 
         zero = jnp.zeros_like(neg_a.x)
         one = zero + F.ONE
         init = curve.Point(zero, one, one, zero)
 
         def body(j, acc):
-            # most-significant digit first: index 63 - j
-            i = 63 - j
+            # most-significant digit first: index NDIG-1-j
+            i = NDIG - 1 - j
             ds = sdig_ref[pl.ds(i, 1), :][0]
             dk = kdig_ref[pl.ds(i, 1), :][0]
-            acc = curve.double(curve.double(curve.double(curve.double(acc))))
-            acc = curve.add(acc, curve._select(table_a, dk))
-            acc = curve.add(acc, curve._select(table_b, ds))
+            for _ in range(4):
+                acc = curve.double_no_t(acc)
+            acc = curve.double(acc)
+            acc = curve.madd_pre(acc, curve._select17_signed(table_b, ds), out_t=True)
+            acc = curve.add_pre(acc, curve._select17_signed(table_a, dk), out_t=True)
             return acc
 
-        sb_ka = jax.lax.fori_loop(0, 64, body, init)
+        sb_ka = jax.lax.fori_loop(0, NDIG, body, init)
         diff = curve.add(sb_ka, curve.neg(r))
         valid = curve.is_identity(curve.mul_by_cofactor(diff))
         out[0, :] = (valid & ok_r).astype(jnp.int32)
     finally:
         for n, v in saved_f.items():
             setattr(F, n, v)
-        curve._BASE_TABLE = saved_table
+        curve._BASE_TABLE17 = saved_table
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -117,25 +128,27 @@ def verify_pallas(ax, ay, az, at, r_words, s_words, k_words, interpret=False):
     the XLA path for smaller buckets)."""
     b = ax.shape[1]
     assert b % LANES == 0, f"batch {b} not a multiple of {LANES}"
+    s_dig = U.words_to_digits5_signed(s_words)
+    k_dig = U.words_to_digits5_signed(k_words)
     grid = (b // LANES,)
     const_specs = [
         pl.BlockSpec((F.NLIMBS, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM)
     ] * len(_FIELD_CONST_NAMES) + [
-        pl.BlockSpec((16, F.NLIMBS, LANES), lambda i: (0, 0, 0), memory_space=pltpu.VMEM)
+        pl.BlockSpec(
+            (curve.TABLE17, F.NLIMBS, LANES), lambda i: (0, 0, 0),
+            memory_space=pltpu.VMEM,
+        )
     ] * 4
     limb_spec = pl.BlockSpec((F.NLIMBS, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
     word_spec = pl.BlockSpec((U.WORDS, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
+    dig_spec = pl.BlockSpec((NDIG, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
     out_spec = pl.BlockSpec((1, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
     mask = pl.pallas_call(
         _verify_block_kernel,
         grid=grid,
-        in_specs=const_specs + [limb_spec] * 4 + [word_spec] * 3,
+        in_specs=const_specs + [limb_spec] * 4 + [word_spec] + [dig_spec] * 2,
         out_specs=out_spec,
         out_shape=jax.ShapeDtypeStruct((1, b), jnp.int32),
-        scratch_shapes=[
-            pltpu.VMEM((64, LANES), jnp.int32),
-            pltpu.VMEM((64, LANES), jnp.int32),
-        ],
         interpret=interpret,
-    )(*_const_args(), ax, ay, az, at, r_words, s_words, k_words)
+    )(*_const_args(), ax, ay, az, at, r_words, s_dig, k_dig)
     return mask[0] != 0

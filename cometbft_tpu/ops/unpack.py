@@ -42,13 +42,42 @@ def words_sign(w: jnp.ndarray) -> jnp.ndarray:
     return (w[WORDS - 1] >> 31).astype(jnp.int32)
 
 
-def words_to_digits4(w: jnp.ndarray) -> jnp.ndarray:
-    """(8, B) uint32 scalar words -> (64, B) int32 4-bit window digits,
-    little-endian digit order (digit j = bits [4j, 4j+4)). Digits never
-    straddle words (32 % 4 == 0)."""
-    out = []
-    for j in range(64):
-        wi, sh = j // 8, 4 * (j % 8)
-        v = w[wi] >> sh if sh else w[wi]
-        out.append((v & 15).astype(jnp.int32))
-    return jnp.stack(out, axis=0)
+NDIGITS5 = 52  # ceil(256/5) windows + headroom for the signed carry
+
+
+def words_to_digits5_signed(w: jnp.ndarray) -> jnp.ndarray:
+    """(8, B) uint32 scalar words -> (52, B) int32 SIGNED 5-bit window
+    digits in [-16, 15], little-endian: scalar = sum d_j * 32^j. Standard
+    signed recoding (d >= 16 -> d - 32, carry 1 up) shortens the ladder to
+    52 windows of 5 doublings and, because -d selects as a lane-local
+    negation, keeps the table at 17 entries. The carry ripple is a 52-step
+    scan over (B,) rows — noise next to one field mul.
+
+    Scalars are < L < 2^253, so window 51 absorbs the final carry without
+    overflow (bits 255.. are zero)."""
+    raw = []
+    for j in range(NDIGITS5):
+        bit = 5 * j
+        wi, off = bit // 32, bit % 32
+        if wi >= WORDS:
+            v = jnp.zeros_like(w[0])
+        else:
+            v = w[wi] >> off if off else w[wi]
+            if off > 27 and wi + 1 < WORDS:
+                v = v | (w[wi + 1] << (32 - off))
+        raw.append((v & 31).astype(jnp.int32))
+    digits = jnp.stack(raw, axis=0)  # (52, B) in [0, 31]
+
+    import jax
+
+    def body(carry, d):
+        d = d + carry
+        hi = (d >= 16).astype(jnp.int32)
+        return hi, d - 32 * hi
+
+    carry_out, signed = jax.lax.scan(
+        body, jnp.zeros_like(digits[0]), digits
+    )
+    # carry out of the top window is impossible for scalars < 2^253
+    # (windows 51 covers bits 255..259 = zero), asserted by construction
+    return signed
