@@ -1,0 +1,130 @@
+"""secp256k1 keys (reference: crypto/secp256k1/secp256k1.go).
+
+ECDSA over secp256k1, OpenSSL-backed (`cryptography`). Wire formats mirror
+the reference: 33-byte compressed pubkeys, 64-byte R||S signatures with S
+canonicalized to the lower half-order (secp256k1.go:180-190 — malleability
+guard), and Bitcoin-style addresses RIPEMD160(SHA256(pubkey))
+(secp256k1.go:23-41).
+
+No batch path: secp256k1 has no safe batch verification (crypto/batch
+excludes it, batch.go:26-32), so commits containing secp256k1 validators
+fall back to per-signature verification — same behavior as the reference.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets as _secrets
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+from cryptography.hazmat.primitives import hashes
+
+from cometbft_tpu import crypto
+
+KEY_TYPE = "secp256k1"
+PUB_KEY_SIZE = 33
+PRIV_KEY_SIZE = 32
+SIGNATURE_SIZE = 64
+
+# curve order (SEC2): canonical signatures use s <= N/2
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_HALF_N = N // 2
+
+
+class PubKey(crypto.PubKey):
+    __slots__ = ("_bytes", "_openssl")
+
+    def __init__(self, data: bytes):
+        if len(data) != PUB_KEY_SIZE:
+            raise crypto.ErrInvalidKey(f"secp256k1 pubkey must be {PUB_KEY_SIZE} bytes")
+        self._bytes = bytes(data)
+        self._openssl: ec.EllipticCurvePublicKey | None = None
+
+    def address(self) -> bytes:
+        """secp256k1.go:23-41: RIPEMD160(SHA256(compressed pubkey))."""
+        sha = hashlib.sha256(self._bytes).digest()
+        return hashlib.new("ripemd160", sha).digest()
+
+    def bytes_(self) -> bytes:
+        return self._bytes
+
+    def type_(self) -> str:
+        return KEY_TYPE
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        """64-byte R||S; rejects non-canonical S (upper half-order),
+        matching secp256k1.go:192-210 VerifyBytes."""
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if not (0 < r < N and 0 < s <= _HALF_N):
+            return False
+        try:
+            if self._openssl is None:
+                self._openssl = ec.EllipticCurvePublicKey.from_encoded_point(
+                    ec.SECP256K1(), self._bytes)
+            self._openssl.verify(
+                encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256()))
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+    def __repr__(self) -> str:
+        return f"PubKeySecp256k1{{{self._bytes.hex().upper()}}}"
+
+
+class PrivKey(crypto.PrivKey):
+    __slots__ = ("_bytes", "_openssl", "_pub")
+
+    def __init__(self, data: bytes):
+        if len(data) != PRIV_KEY_SIZE:
+            raise crypto.ErrInvalidKey("secp256k1 privkey must be 32 bytes")
+        self._bytes = bytes(data)
+        d = int.from_bytes(data, "big")
+        if not 0 < d < N:
+            raise crypto.ErrInvalidKey("secp256k1 privkey out of range")
+        self._openssl = ec.derive_private_key(d, ec.SECP256K1())
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            PublicFormat,
+        )
+
+        pub = self._openssl.public_key().public_bytes(
+            Encoding.X962, PublicFormat.CompressedPoint)
+        self._pub = PubKey(pub)
+
+    def bytes_(self) -> bytes:
+        return self._bytes
+
+    def sign(self, msg: bytes) -> bytes:
+        """64-byte R||S with low-S canonicalization (secp256k1.go:160-178)."""
+        der = self._openssl.sign(msg, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > _HALF_N:
+            s = N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> PubKey:
+        return self._pub
+
+    def type_(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key() -> PrivKey:
+    while True:
+        d = _secrets.token_bytes(PRIV_KEY_SIZE)
+        if 0 < int.from_bytes(d, "big") < N:
+            return PrivKey(d)
+
+
+def gen_priv_key_from_secret(secret: bytes) -> PrivKey:
+    """Deterministic key: SHA256(secret) clamped into range (testing only)."""
+    d = int.from_bytes(hashlib.sha256(secret).digest(), "big") % (N - 1) + 1
+    return PrivKey(d.to_bytes(32, "big"))

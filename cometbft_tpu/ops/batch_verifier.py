@@ -38,3 +38,31 @@ class TPUBatchVerifier(crypto.BatchVerifier):
 
     def count(self) -> int:
         return len(self._sigs)
+
+
+class SrTPUBatchVerifier(crypto.BatchVerifier):
+    """sr25519 on the device: same ladder kernel family, ristretto decode +
+    cofactor-4 coset equality (ops/sr25519_kernel.py; reference seam
+    crypto/sr25519/batch.go:45-78)."""
+
+    def __init__(self):
+        self._pubs: list[bytes] = []
+        self._msgs: list[bytes] = []
+        self._sigs: list[bytes] = []
+
+    def add(self, pub_key: crypto.PubKey, msg: bytes, sig: bytes) -> None:
+        if pub_key.type_() != "sr25519":
+            raise crypto.ErrInvalidKey("sr25519 tpu batch verifier requires sr25519 keys")
+        if len(sig) != SIGNATURE_SIZE:
+            raise crypto.ErrInvalidSignature("bad signature length")
+        self._pubs.append(pub_key.bytes_())
+        self._msgs.append(bytes(msg))
+        self._sigs.append(bytes(sig))
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        from cometbft_tpu.ops import sr25519_kernel
+
+        return sr25519_kernel.verify_batch(self._pubs, self._msgs, self._sigs)
+
+    def count(self) -> int:
+        return len(self._sigs)

@@ -1,0 +1,229 @@
+"""Batched sr25519 (schnorrkel) verification on TPU lanes.
+
+Reference seam: crypto/sr25519/batch.go:45-78 (curve25519-voi's
+sr25519.BatchVerifier). Device design: the schnorrkel verification equation
+over ristretto255 reduces to edwards25519 arithmetic —
+
+    accept  iff  [4]( [s]B - [k]A - R ) == O
+
+— because two edwards points map to the same ristretto255 element exactly
+when they differ by a 4-torsion point, so the cofactor-4 coset check IS
+ristretto equality. That makes the heavy path identical to the ed25519
+kernel: the same signed 5-bit double-scalar ladder (curve.py), the same
+limb layout and packed wire format; only the point DECODING differs
+(ristretto255 decode instead of ZIP-215 decompression) and the final
+cofactor is 4 instead of 8.
+
+Host side stays host-shaped: Merlin transcript challenges (STROBE/Keccak,
+64-bit word arithmetic — hostile to the VPU) come from
+crypto/sr25519_math, and the schnorrkel marker bit / s < L checks never
+reach the device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cometbft_tpu.crypto import sr25519_math as srm
+from cometbft_tpu.ops import curve
+from cometbft_tpu.ops import field as F
+from cometbft_tpu.ops import limbs as L
+from cometbft_tpu.ops import unpack as U
+from cometbft_tpu.ops.ed25519_kernel import bucket_size
+
+SQRT_M1_LIMBS = F.SQRT_M1
+
+# the 32-byte encoding of the ristretto identity (all zeros) — padding lanes
+_ID_ENC32 = bytes(32)
+
+
+def _words_to_full_limbs(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(8, B) uint32 -> ((20, B) int32 limbs of the low 255 bits, (B,) bit
+    255). Ristretto encodings must have bit 255 clear; the caller folds the
+    flag into validity."""
+    return U.words_to_y_limbs(w), U.words_sign(w)
+
+
+def _is_canonical_even(limbs: jnp.ndarray, hi_bit: jnp.ndarray) -> jnp.ndarray:
+    """ristretto255 DECODE preconditions: s < p, s nonnegative (even),
+    bit 255 clear."""
+    canon = F.canonicalize(limbs)
+    is_canon = jnp.all(canon == limbs, axis=0)
+    even = (limbs[0] & 1) == 0
+    return is_canon & even & (hi_bit == 0)
+
+
+def sqrt_ratio_m1(u: jnp.ndarray, v: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Vectorized SQRT_RATIO_M1: (was_square (B,), nonnegative root (20, B))."""
+    v3 = F.mul(F.sq(v), v)
+    v7 = F.mul(F.sq(v3), v)
+    r = F.mul(F.mul(u, v3), F.pow22523(F.mul(u, v7)))
+    check = F.mul(v, F.sq(r))
+    correct = F.is_zero(F.sub(check, u))
+    flipped = F.is_zero(F.add(check, u))
+    flipped_i = F.is_zero(F.add(check, F.mul(u, SQRT_M1_LIMBS)))
+    r = jnp.where((flipped | flipped_i)[None], F.mul(r, SQRT_M1_LIMBS), r)
+    was_square = correct | flipped
+    # CT_ABS: take the even root
+    odd = F.parity(r) == 1
+    r = jnp.where(odd[None], F.neg(r), r)
+    return was_square, r
+
+
+def ristretto_decode_device(w: jnp.ndarray) -> tuple[jnp.ndarray, curve.Point]:
+    """(8, B) packed encodings -> (ok (B,), extended Point (20, B) coords).
+    Mirrors sr25519_math.ristretto_decode lane-parallel."""
+    s, hi = _words_to_full_limbs(w)
+    pre_ok = _is_canonical_even(s, hi)
+    one = jnp.broadcast_to(F.ONE, s.shape).astype(jnp.int32)
+    ss = F.sq(s)
+    u1 = F.sub(one, ss)
+    u2 = F.add(one, ss)
+    u2_sqr = F.sq(u2)
+    v = F.sub(F.neg(F.mul(F.mul(F.D, u1), u1)), u2_sqr)
+    was_square, invsqrt = sqrt_ratio_m1(one, F.mul(v, u2_sqr))
+    den_x = F.mul(invsqrt, u2)
+    den_y = F.mul(F.mul(invsqrt, den_x), v)
+    x = F.mul(F.add(s, s), den_x)
+    x = jnp.where((F.parity(x) == 1)[None], F.neg(x), x)
+    y = F.mul(u1, den_y)
+    t = F.mul(x, y)
+    ok = pre_ok & was_square & (F.parity(t) == 0) & ~F.is_zero(y)
+    z = jnp.broadcast_to(F.ONE, s.shape).astype(jnp.int32)
+    return ok, curve.Point(x, y, z, t)
+
+
+@jax.jit
+def _decompress_kernel(words: jnp.ndarray):
+    ok, p = ristretto_decode_device(words)
+    return ok, p.x, p.y, p.z, p.t
+
+
+def verify_math_sr(ax, ay, az, at, r_words, s_words, k_words) -> jnp.ndarray:
+    """Per-chip sr25519 verify program: A coords (20, B) (ristretto-decoded,
+    cached), packed R encodings + s/k scalars (8, B). Lanes with undecodable
+    R reject; undecodable A is masked host-side by the cache."""
+    ok_r, r = ristretto_decode_device(r_words)
+    neg_a = curve.neg(curve.Point(ax, ay, az, at))
+    sb_ka = curve.windowed_double_scalar_signed(
+        U.words_to_digits5_signed(s_words), U.words_to_digits5_signed(k_words), neg_a
+    )
+    diff = curve.add(sb_ka, curve.neg(r))
+    quad = curve.double(curve.double(diff))  # cofactor 4: ristretto equality
+    valid = curve.is_identity(quad)
+    return valid & ok_r
+
+
+_verify_kernel = jax.jit(verify_math_sr)
+
+
+def decompress_points(enc: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(N, 32) ristretto encodings -> (ok (N,), coords (N, 4, 20))."""
+    n = enc.shape[0]
+    b = bucket_size(n)
+    words = L.bytes_to_words(enc)
+    if b > n:
+        words = np.concatenate([words, np.zeros((b - n, 8), dtype=np.uint32)])
+    ok, x, y, z, t = _decompress_kernel(jnp.asarray(words.T))
+    coords = np.stack(
+        [np.asarray(x).T, np.asarray(y).T, np.asarray(z).T, np.asarray(t).T], axis=1
+    )
+    return np.asarray(ok)[:n], coords[:n]
+
+
+class SrPubKeyCache:
+    """Ristretto-decoded pubkey cache (host level only; the device-level
+    digest cache from ed25519 applies once sr25519 valsets stabilize —
+    reuse the same class with this module's decompressor)."""
+
+    def __init__(self, capacity: int = 65536):
+        self.capacity = capacity
+        self._map: dict[bytes, tuple[bool, np.ndarray]] = {}
+
+    def lookup_or_decompress(self, pubs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+        missing = [p for p in dict.fromkeys(pubs) if p not in self._map]
+        if missing:
+            enc = np.frombuffer(b"".join(missing), dtype=np.uint8).reshape(-1, 32)
+            ok, coords = decompress_points(enc)
+            evict = len(self._map) + len(missing) - self.capacity
+            for _ in range(max(0, evict)):
+                self._map.pop(next(iter(self._map)))
+            for i, p in enumerate(missing):
+                self._map[p] = (bool(ok[i]), coords[i])
+        oks = np.empty(len(pubs), dtype=bool)
+        coords = np.empty((len(pubs), 4, L.NLIMBS), dtype=np.int32)
+        for i, p in enumerate(pubs):
+            o, c = self._map[p]
+            oks[i] = o
+            coords[i] = c
+        return oks, coords
+
+
+_default_cache = SrPubKeyCache()
+
+
+def verify_batch(
+    pubs: list[bytes],
+    msgs: list[bytes],
+    sigs: list[bytes],
+    cache: SrPubKeyCache | None = None,
+) -> tuple[bool, list[bool]]:
+    """Schnorrkel batch verification with a per-signature mask."""
+    n = len(sigs)
+    assert len(pubs) == n and len(msgs) == n
+    if n == 0:
+        return True, []
+    cache = cache or _default_cache
+
+    # host: marker/canonicity checks + Merlin challenges
+    pre_ok = np.ones(n, dtype=bool)
+    s_vals = [0] * n
+    r_encs: list[bytes] = [b""] * n
+    for i, (pub, sig) in enumerate(zip(pubs, sigs)):
+        if len(pub) != 32:
+            pre_ok[i] = False
+            continue
+        parsed = srm.parse_signature(sig)
+        if parsed is None:
+            pre_ok[i] = False
+            continue
+        r_encs[i], s_vals[i] = parsed
+    safe_pubs = [p if pre_ok[i] else _ID_ENC32 for i, p in enumerate(pubs)]
+    safe_rs = [r if pre_ok[i] else _ID_ENC32 for i, r in enumerate(r_encs)]
+    ks = [
+        srm.compute_challenge(safe_pubs[i], safe_rs[i], msgs[i]) if pre_ok[i] else 0
+        for i in range(n)
+    ]
+    s_safe = [s if pre_ok[i] else 0 for i, s in enumerate(s_vals)]
+
+    ok_a, coords = cache.lookup_or_decompress(safe_pubs)
+
+    b = bucket_size(n)
+    pad = b - n
+    r_enc_arr = np.frombuffer(b"".join(safe_rs), dtype=np.uint8).reshape(n, 32)
+    r_words = L.bytes_to_words(r_enc_arr)
+    s_words = L.scalars_to_words(s_safe)
+    k_words = L.scalars_to_words(ks)
+    if pad:
+        zw = np.zeros((pad, 8), dtype=np.uint32)
+        r_words = np.concatenate([r_words, zw])
+        s_words = np.concatenate([s_words, zw])
+        k_words = np.concatenate([k_words, zw])
+        id_coords = np.zeros((pad, 4, L.NLIMBS), dtype=np.int32)
+        id_coords[:, 1, 0] = 1
+        id_coords[:, 2, 0] = 1
+        coords = np.concatenate([coords, id_coords])
+
+    a_dev = tuple(
+        jnp.asarray(np.ascontiguousarray(coords[:, i].T)) for i in range(4)
+    )
+    mask_dev = _verify_kernel(
+        *a_dev,
+        jnp.asarray(np.ascontiguousarray(r_words.T)),
+        jnp.asarray(np.ascontiguousarray(s_words.T)),
+        jnp.asarray(np.ascontiguousarray(k_words.T)),
+    )
+    mask = np.asarray(mask_dev)[:n] & pre_ok & ok_a
+    return bool(mask.all()), mask.tolist()

@@ -142,9 +142,21 @@ def _verify_commit_batch(
         chain_id, vals, commit, voting_power_needed,
         ignore_sig, count_sig, count_all_signatures, lookup_by_index,
     )
-    bv = crypto_batch.create_batch_verifier(vals.get_proposer().pub_key)
-    for pub, msg, sig in zip(pubs, msgs, sigs):
-        bv.add(pub, msg, sig)
+    # mixed-scheme coalescing: each key type becomes one device sub-batch
+    # (BASELINE config 5 mega-commits mix ed25519 + sr25519 validators)
+    bv = crypto_batch.create_mixed_batch_verifier()
+    try:
+        for pub, msg, sig in zip(pubs, msgs, sigs):
+            bv.add(pub, msg, sig)
+    except Exception as e:  # noqa: BLE001 - unbatchable key type in the set
+        from cometbft_tpu.libs import log as _log
+
+        _log.default().info(
+            "commit verification falling back to serial", reason=str(e))
+        return _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed,
+            ignore_sig, count_sig, count_all_signatures, lookup_by_index,
+        )
     ok, valid_sigs = bv.verify()
     if ok:
         return

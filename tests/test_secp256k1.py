@@ -1,0 +1,78 @@
+"""secp256k1 ECDSA keys (reference: crypto/secp256k1/secp256k1_test.go):
+roundtrip, low-S canonicalization, Bitcoin-style addresses, and the
+serial-fallback path for commits containing secp256k1 validators."""
+
+import secrets
+
+from cometbft_tpu.crypto import ed25519, secp256k1
+from cometbft_tpu.crypto.secp256k1 import _HALF_N
+
+
+class TestSecp256k1:
+    def test_sign_verify_roundtrip(self):
+        priv = secp256k1.gen_priv_key()
+        msg = b"ecdsa message"
+        sig = priv.sign(msg)
+        assert len(sig) == 64
+        assert priv.pub_key().verify_signature(msg, sig)
+        assert not priv.pub_key().verify_signature(msg + b"x", sig)
+        assert not secp256k1.gen_priv_key().pub_key().verify_signature(msg, sig)
+
+    def test_low_s_enforced(self):
+        priv = secp256k1.gen_priv_key()
+        sig = priv.sign(b"m")
+        s = int.from_bytes(sig[32:], "big")
+        assert s <= _HALF_N
+        # the malleable twin (N - s) must be rejected
+        high_s = secp256k1.N - s
+        mall = sig[:32] + high_s.to_bytes(32, "big")
+        assert not priv.pub_key().verify_signature(b"m", mall)
+
+    def test_address_is_ripemd_sha(self):
+        import hashlib
+
+        priv = secp256k1.gen_priv_key()
+        pub = priv.pub_key()
+        want = hashlib.new("ripemd160", hashlib.sha256(pub.bytes_()).digest()).digest()
+        assert pub.address() == want and len(want) == 20
+
+    def test_pubkey_proto_roundtrip(self):
+        from cometbft_tpu.types.validator import pub_key_from_proto, pub_key_to_proto
+
+        pub = secp256k1.gen_priv_key().pub_key()
+        pub2 = pub_key_from_proto(pub_key_to_proto(pub))
+        assert pub2.type_() == "secp256k1" and pub2.bytes_() == pub.bytes_()
+
+    def test_commit_with_secp_falls_back_to_serial(self):
+        """A valset containing a secp256k1 validator has no batch path
+        (crypto/batch excludes it): commit verification falls back to the
+        serial loop and still succeeds."""
+        from cometbft_tpu.types import validation as tv
+        from cometbft_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+        from cometbft_tpu.types.validator import Validator, ValidatorSet
+        from cometbft_tpu.types.vote import Vote
+        from cometbft_tpu.types.vote_set import VoteSet
+        from cometbft_tpu.utils import cmttime
+
+        privs = [
+            secp256k1.gen_priv_key() if i == 0 else ed25519.gen_priv_key()
+            for i in range(4)
+        ]
+        vs = ValidatorSet([Validator.new(p.pub_key(), 10) for p in privs])
+        by_addr = {p.pub_key().address(): p for p in privs}
+        privs = [by_addr[v.address] for v in vs.validators]
+        bid = BlockID(
+            hash=secrets.token_bytes(32),
+            part_set_header=PartSetHeader(total=1, hash=secrets.token_bytes(32)),
+        )
+        vote_set = VoteSet("secp-chain", 2, 0, SignedMsgType.PRECOMMIT, vs)
+        for i, p in enumerate(privs):
+            v = Vote(
+                type_=SignedMsgType.PRECOMMIT, height=2, round_=0, block_id=bid,
+                timestamp=cmttime.canonical_now_ms(),
+                validator_address=p.pub_key().address(), validator_index=i,
+            )
+            v.signature = p.sign(v.sign_bytes("secp-chain"))
+            vote_set.add_vote(v)
+        commit = vote_set.make_commit()
+        tv.verify_commit("secp-chain", vs, bid, 2, commit)

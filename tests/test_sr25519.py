@@ -1,0 +1,176 @@
+"""sr25519 (schnorrkel): ristretto255 group vectors, Merlin/STROBE
+transcript behavior, sign/verify, the device batch kernel vs the oracle,
+and mixed ed25519+sr25519 commit verification through coalesced batches
+(reference: crypto/sr25519/*, BASELINE config 5)."""
+
+import secrets
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import ed25519, ed25519_math as ed, sr25519
+from cometbft_tpu.crypto import sr25519_math as srm
+from cometbft_tpu.ops import sr25519_kernel as SK
+
+# draft-irtf-cfrg-ristretto255-decaf448 §A.1 small multiples of the generator
+RISTRETTO_VECTORS = [
+    "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+    "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+    "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259",
+    "da80862773358b466ffadfe0b3293ab3d9fd53c5ea6c955358f568322daf6a57",
+]
+
+
+class TestRistretto:
+    def test_generator_multiples_match_spec(self):
+        for i, want in enumerate(RISTRETTO_VECTORS, start=1):
+            pt = ed.scalar_mult(i, ed.B_POINT)
+            assert srm.ristretto_encode(pt).hex() == want
+
+    def test_roundtrip_and_torsion_quotient(self):
+        for _ in range(10):
+            k = secrets.randbelow(srm.L)
+            pt = ed.scalar_mult(k, ed.B_POINT)
+            enc = srm.ristretto_encode(pt)
+            dec = srm.ristretto_decode(enc)
+            assert dec is not None
+            assert srm.ristretto_encode(dec) == enc
+            diff = ed.point_add(pt, ed.point_neg(dec))
+            assert ed.is_identity(ed.point_double(ed.point_double(diff)))
+
+    def test_decode_rejects_noncanonical(self):
+        assert srm.ristretto_decode(b"\xff" * 32) is None  # >= p
+        assert srm.ristretto_decode((1).to_bytes(32, "little")) is None  # odd
+        # bit 255 set
+        bad = bytearray(srm.ristretto_encode(ed.B_POINT))
+        bad[31] |= 0x80
+        assert srm.ristretto_decode(bytes(bad)) is None
+
+    def test_device_decode_matches_oracle(self):
+        encs, expect_ok = [], []
+        for i in range(64):
+            if i % 7 == 0:
+                encs.append(secrets.token_bytes(32))  # mostly invalid
+            else:
+                k = secrets.randbelow(srm.L)
+                encs.append(srm.ristretto_encode(ed.scalar_mult(k, ed.B_POINT)))
+            expect_ok.append(srm.ristretto_decode(encs[-1]) is not None)
+        enc_arr = np.frombuffer(b"".join(encs), dtype=np.uint8).reshape(-1, 32)
+        ok, coords = SK.decompress_points(enc_arr)
+        assert ok.tolist() == expect_ok
+
+
+class TestSchnorrkel:
+    def test_sign_verify_roundtrip(self):
+        priv = sr25519.gen_priv_key()
+        msg = b"the quick brown fox"
+        sig = priv.sign(msg)
+        assert len(sig) == 64 and sig[63] & 128
+        assert priv.pub_key().verify_signature(msg, sig)
+        assert not priv.pub_key().verify_signature(msg + b"!", sig)
+        other = sr25519.gen_priv_key()
+        assert not other.pub_key().verify_signature(msg, sig)
+
+    def test_marker_bit_required(self):
+        priv = sr25519.gen_priv_key()
+        sig = bytearray(priv.sign(b"m"))
+        sig[63] &= 127
+        assert not priv.pub_key().verify_signature(b"m", bytes(sig))
+
+    def test_key_type_and_address(self):
+        priv = sr25519.gen_priv_key()
+        pub = priv.pub_key()
+        assert pub.type_() == "sr25519"
+        assert len(pub.address()) == 20
+
+    def test_transcript_determinism(self):
+        t1 = srm.make_signing_transcript(b"msg")
+        t2 = srm.make_signing_transcript(b"msg")
+        assert t1.challenge_bytes(b"c", 32) == t2.challenge_bytes(b"c", 32)
+        t3 = srm.make_signing_transcript(b"other")
+        assert t1.clone().challenge_bytes(b"c", 32) != t3.challenge_bytes(b"c", 32)
+
+
+class TestBatchKernel:
+    def test_device_batch_matches_oracle(self):
+        privs = [sr25519.gen_priv_key() for _ in range(6)]
+        pubs, msgs, sigs, expect = [], [], [], []
+        for i in range(48):
+            p = privs[i % 6]
+            m = secrets.token_bytes(40)
+            s = p.sign(m)
+            bad = i % 9 == 0
+            if bad:
+                s = s[:7] + bytes([s[7] ^ 1]) + s[8:]
+            pubs.append(p.pub_key().bytes_())
+            msgs.append(m)
+            sigs.append(s)
+            expect.append(not bad)
+        ok, mask = SK.verify_batch(pubs, msgs, sigs)
+        assert mask == expect
+        assert ok == all(expect)
+
+    def test_batch_dispatch_by_key_type(self):
+        from cometbft_tpu.crypto import batch as crypto_batch
+
+        bv = crypto_batch.create_batch_verifier(sr25519.gen_priv_key().pub_key())
+        priv = sr25519.gen_priv_key()
+        bv.add(priv.pub_key(), b"m1", priv.sign(b"m1"))
+        bv.add(priv.pub_key(), b"m2", priv.sign(b"m2"))
+        ok, mask = bv.verify()
+        assert ok and mask == [True, True]
+
+
+class TestMixedCommit:
+    def test_mixed_scheme_commit_verifies(self):
+        """BASELINE config 5 in miniature: a valset mixing ed25519 and
+        sr25519 validators; the commit flows through coalesced per-scheme
+        batches with per-lane masks."""
+        from cometbft_tpu.types import validation as tv
+        from cometbft_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+        from cometbft_tpu.types.validator import Validator, ValidatorSet
+        from cometbft_tpu.types.vote import Vote
+        from cometbft_tpu.types.vote_set import VoteSet
+        from cometbft_tpu.utils import cmttime
+
+        privs = [
+            (ed25519.gen_priv_key() if i % 2 == 0 else sr25519.gen_priv_key())
+            for i in range(8)
+        ]
+        vs = ValidatorSet([Validator.new(p.pub_key(), 10) for p in privs])
+        by_addr = {p.pub_key().address(): p for p in privs}
+        privs = [by_addr[v.address] for v in vs.validators]
+        bid = BlockID(
+            hash=secrets.token_bytes(32),
+            part_set_header=PartSetHeader(total=1, hash=secrets.token_bytes(32)),
+        )
+        vote_set = VoteSet("mixed-chain", 3, 0, SignedMsgType.PRECOMMIT, vs)
+        for i, p in enumerate(privs):
+            v = Vote(
+                type_=SignedMsgType.PRECOMMIT, height=3, round_=0, block_id=bid,
+                timestamp=cmttime.canonical_now_ms(),
+                validator_address=p.pub_key().address(), validator_index=i,
+            )
+            v.signature = p.sign(v.sign_bytes("mixed-chain"))
+            vote_set.add_vote(v)
+        commit = vote_set.make_commit()
+        tv.verify_commit("mixed-chain", vs, bid, 3, commit)
+        tv.verify_commit_light("mixed-chain", vs, bid, 3, commit)
+        tv.verify_commit_light_trusting("mixed-chain", vs, commit, tv.Fraction(1, 3))
+
+        # a corrupted sr25519 signature is pinpointed by index
+        sr_idx = next(
+            i for i, p in enumerate(privs) if p.pub_key().type_() == "sr25519"
+        )
+        from cometbft_tpu.types.commit import CommitSig
+        from cometbft_tpu.types.basic import BlockIDFlag
+
+        cs = commit.signatures[sr_idx]
+        commit.signatures[sr_idx] = CommitSig(
+            block_id_flag=BlockIDFlag.COMMIT,
+            validator_address=cs.validator_address,
+            timestamp=cs.timestamp,
+            signature=cs.signature[:3] + bytes([cs.signature[3] ^ 1]) + cs.signature[4:],
+        )
+        with pytest.raises(tv.ErrInvalidCommitSignature, match=rf"#{sr_idx}"):
+            tv.verify_commit("mixed-chain", vs, bid, 3, commit)
