@@ -28,6 +28,9 @@ class KVStoreApplication(abci.BaseApplication):
         self.staged: dict[str, str] | None = None
         self.staged_hash = b""
         self.tx_count = 0
+        self.snapshot_interval = 0  # 0 = snapshots off
+        self.snapshots: list[tuple[abci.Snapshot, list[bytes]]] = []
+        self._restoring: tuple[abci.Snapshot, list[bytes]] | None = None
 
     # ------------------------------------------------------------ helpers
 
@@ -144,7 +147,82 @@ class KVStoreApplication(abci.BaseApplication):
             self.app_hash = self.staged_hash
             self.staged = None
             self.height += 1
+        if self.snapshot_interval and self.height % self.snapshot_interval == 0:
+            self._take_snapshot()
         return abci.ResponseCommit(retain_height=0)
+
+    # ------------------------------------------------------- state sync
+    # (reference shape: abci/example/kvstore has no snapshots; the e2e app
+    # does — test/e2e/app/snapshots.go. Same JSON-chunks design here.)
+
+    SNAPSHOT_FORMAT = 1
+    SNAPSHOT_CHUNK_SIZE = 1 << 16
+
+    def _take_snapshot(self) -> None:
+        import hashlib
+
+        payload = json.dumps(
+            {"height": self.height, "app_hash": self.app_hash.hex(),
+             "state": self.state, "tx_count": self.tx_count},
+            sort_keys=True,
+        ).encode()
+        chunks = [
+            payload[i:i + self.SNAPSHOT_CHUNK_SIZE]
+            for i in range(0, max(len(payload), 1), self.SNAPSHOT_CHUNK_SIZE)
+        ]
+        snap = abci.Snapshot(
+            height=self.height, format_=self.SNAPSHOT_FORMAT,
+            chunks=len(chunks), hash=hashlib.sha256(payload).digest(),
+        )
+        self.snapshots.append((snap, chunks))
+        del self.snapshots[:-5]  # keep the 5 newest
+
+    def list_snapshots(self, req: abci.RequestListSnapshots) -> abci.ResponseListSnapshots:
+        return abci.ResponseListSnapshots(snapshots=[s for s, _ in self.snapshots])
+
+    def load_snapshot_chunk(
+        self, req: abci.RequestLoadSnapshotChunk
+    ) -> abci.ResponseLoadSnapshotChunk:
+        for snap, chunks in self.snapshots:
+            if (snap.height == req.height and snap.format_ == req.format_
+                    and 0 <= req.chunk < len(chunks)):
+                return abci.ResponseLoadSnapshotChunk(chunk=chunks[req.chunk])
+        return abci.ResponseLoadSnapshotChunk()
+
+    def offer_snapshot(self, req: abci.RequestOfferSnapshot) -> abci.ResponseOfferSnapshot:
+        s = req.snapshot
+        if s is None or s.format_ != self.SNAPSHOT_FORMAT:
+            return abci.ResponseOfferSnapshot(
+                result=abci.OfferSnapshotResult.REJECT_FORMAT)
+        self._restoring = (s, [])
+        return abci.ResponseOfferSnapshot(result=abci.OfferSnapshotResult.ACCEPT)
+
+    def apply_snapshot_chunk(
+        self, req: abci.RequestApplySnapshotChunk
+    ) -> abci.ResponseApplySnapshotChunk:
+        import hashlib
+
+        if self._restoring is None:
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.ApplySnapshotChunkResult.ABORT)
+        snap, got = self._restoring
+        got.append(req.chunk)
+        if len(got) < snap.chunks:
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.ApplySnapshotChunkResult.ACCEPT)
+        payload = b"".join(got)
+        if hashlib.sha256(payload).digest() != snap.hash:
+            self._restoring = None
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.ApplySnapshotChunkResult.REJECT_SNAPSHOT)
+        doc = json.loads(payload)
+        self.state = doc["state"]
+        self.height = doc["height"]
+        self.app_hash = bytes.fromhex(doc["app_hash"])
+        self.tx_count = doc.get("tx_count", 0)
+        self._restoring = None
+        return abci.ResponseApplySnapshotChunk(
+            result=abci.ApplySnapshotChunkResult.ACCEPT)
 
     def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
         key = req.data.decode()

@@ -1,0 +1,27 @@
+"""State sync (reference: statesync/).
+
+snapshots — peer-offered snapshot pool with ranking + rejection memory
+chunks    — ordered chunk queue with retry/refetch semantics
+syncer    — the offer/fetch/apply loop against the ABCI app, anchored to
+            light-client-verified state
+provider  — StateProvider: trusted AppHash/Commit/State via the light client
+reactor   — p2p plumbing: snapshot/chunk channels, serving + requesting
+"""
+
+from cometbft_tpu.statesync.chunks import ChunkQueue
+from cometbft_tpu.statesync.provider import LightClientStateProvider, StateProvider
+from cometbft_tpu.statesync.reactor import StatesyncReactor
+from cometbft_tpu.statesync.snapshots import Snapshot, SnapshotPool
+from cometbft_tpu.statesync.syncer import (
+    ErrAbort,
+    ErrNoSnapshots,
+    ErrRejectSnapshot,
+    ErrRetrySnapshot,
+    Syncer,
+)
+
+__all__ = [
+    "ChunkQueue", "LightClientStateProvider", "StateProvider",
+    "StatesyncReactor", "Snapshot", "SnapshotPool", "Syncer",
+    "ErrAbort", "ErrNoSnapshots", "ErrRejectSnapshot", "ErrRetrySnapshot",
+]

@@ -1,0 +1,234 @@
+"""State sync: snapshot pool ranking/rejection, chunk queue ordering +
+retry, the full syncer loop against a snapshot-serving kvstore app, and the
+light-client state provider (reference: statesync/*_test.go shapes)."""
+
+import asyncio
+import hashlib
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.proxy import AppConns, local_client_creator
+from cometbft_tpu.statesync import (
+    ChunkQueue,
+    ErrNoSnapshots,
+    LightClientStateProvider,
+    Snapshot,
+    SnapshotPool,
+    Syncer,
+)
+
+from light_harness import LightChain
+
+
+class TestSnapshotPool:
+    def test_ranking_best_first(self):
+        pool = SnapshotPool()
+        s1 = Snapshot(height=10, format=1, chunks=2, hash_=b"a" * 32)
+        s2 = Snapshot(height=20, format=1, chunks=2, hash_=b"b" * 32)
+        s3 = Snapshot(height=20, format=2, chunks=2, hash_=b"c" * 32)
+        for s in (s1, s2, s3):
+            assert pool.add("p1", s)
+        assert not pool.add("p1", s1)  # dupe
+        assert pool.add("p2", s1)      # new peer for same snapshot
+        assert pool.best() == s3       # height desc, then format desc
+
+    def test_rejections_stick(self):
+        pool = SnapshotPool()
+        s = Snapshot(height=5, format=1, chunks=1, hash_=b"x" * 32)
+        pool.add("p1", s)
+        pool.reject(s)
+        assert pool.best() is None
+        assert not pool.add("p2", s)  # rejected snapshots never come back
+        s2 = Snapshot(height=6, format=7, chunks=1, hash_=b"y" * 32)
+        pool.reject_format(7)
+        assert not pool.add("p1", s2)
+        pool.reject_peer("evil")
+        assert not pool.add("evil", Snapshot(height=9, format=1, chunks=1, hash_=b"z" * 32))
+
+
+class TestChunkQueue:
+    def test_out_of_order_arrival_ordered_delivery(self):
+        async def main():
+            q = ChunkQueue(3)
+            assert await q.allocate() == 0
+            assert await q.allocate() == 1
+            assert await q.allocate() == 2
+            assert await q.allocate() is None
+            await q.add(2, b"c", "p")
+            await q.add(0, b"a", "p")
+            await q.add(1, b"b", "p")
+            out = [await q.next_chunk(1) for _ in range(3)]
+            assert out == [(0, b"a"), (1, b"b"), (2, b"c")]
+            assert q.done()
+
+        asyncio.run(main())
+
+    def test_retry_rewinds(self):
+        async def main():
+            q = ChunkQueue(2)
+            await q.add(0, b"a", "p")
+            await q.add(1, b"b", "p")
+            assert (await q.next_chunk(1))[0] == 0
+            await q.retry(0)
+            assert await q.allocate() == 0
+            await q.add(0, b"a2", "p")
+            assert await q.next_chunk(1) == (0, b"a2")
+            assert await q.next_chunk(1) == (1, b"b")
+
+        asyncio.run(main())
+
+
+class _DirectProvider:
+    """StateProvider stub pinning known-good trusted data."""
+
+    def __init__(self, app_hash, state, commit):
+        self._app_hash, self._state, self._commit = app_hash, state, commit
+
+    async def app_hash(self, height):
+        return self._app_hash
+
+    async def commit(self, height):
+        return self._commit
+
+    async def state(self, height):
+        return self._state
+
+
+def _serving_app(n_keys=50, interval=4, heights=8):
+    """A kvstore that committed `heights` blocks with snapshots every
+    `interval`."""
+    app = KVStoreApplication()
+    app.snapshot_interval = interval
+    for h in range(1, heights + 1):
+        txs = [f"k{h}-{i}=v{i}".encode() for i in range(n_keys // heights)]
+        app.finalize_block(abci.RequestFinalizeBlock(txs=txs, height=h))
+        app.commit(abci.RequestCommit())
+    return app
+
+
+class TestSyncer:
+    def test_full_restore_roundtrip(self):
+        """A fresh app restores a served snapshot chunk-by-chunk and ends
+        bit-identical (height, app hash, state)."""
+
+        async def main():
+            server = _serving_app()
+            snap_meta, _ = server.snapshots[-1]
+            client = KVStoreApplication()
+            conns = AppConns(local_client_creator(client))
+            await conns.start()
+            try:
+                def request_chunk(peer_id, snapshot, index):
+                    # serve synchronously from the server app
+                    resp = server.load_snapshot_chunk(abci.RequestLoadSnapshotChunk(
+                        height=snapshot.height, format_=snapshot.format,
+                        chunk=index))
+                    asyncio.get_running_loop().create_task(
+                        syncer.add_chunk(index, resp.chunk, peer_id))
+
+                from cometbft_tpu.state.state import State
+                trusted_state = State(chain_id="ss-chain", initial_height=1,
+                                      last_block_height=snap_meta.height,
+                                      app_hash=server.app_hash)
+                syncer = Syncer(
+                    _DirectProvider(server.app_hash, trusted_state, object()),
+                    conns.snapshot, request_chunk, chunk_timeout=5.0,
+                )
+                assert syncer.add_snapshot("peer1", Snapshot(
+                    height=snap_meta.height, format=snap_meta.format_,
+                    chunks=snap_meta.chunks, hash_=snap_meta.hash))
+                state, _commit = await syncer.sync_any()
+                assert state.last_block_height == snap_meta.height
+                assert client.height == server.height == snap_meta.height
+                assert client.app_hash == server.app_hash
+                assert client.state == server.state
+            finally:
+                await conns.stop()
+
+        asyncio.run(main())
+
+    def test_wrong_app_hash_rejects_snapshot(self):
+        """A snapshot whose restored app hash mismatches the light-client
+        anchored hash is rejected (the wire is never trusted)."""
+
+        async def main():
+            server = _serving_app()
+            snap_meta, _ = server.snapshots[-1]
+            client = KVStoreApplication()
+            conns = AppConns(local_client_creator(client))
+            await conns.start()
+            try:
+                def request_chunk(peer_id, snapshot, index):
+                    resp = server.load_snapshot_chunk(abci.RequestLoadSnapshotChunk(
+                        height=snapshot.height, format_=snapshot.format,
+                        chunk=index))
+                    asyncio.get_running_loop().create_task(
+                        syncer.add_chunk(index, resp.chunk, peer_id))
+
+                from cometbft_tpu.state.state import State
+                lying_hash = hashlib.sha256(b"lies").digest()
+                syncer = Syncer(
+                    _DirectProvider(lying_hash,
+                                    State(chain_id="x", initial_height=1), object()),
+                    conns.snapshot, request_chunk, chunk_timeout=5.0,
+                )
+                syncer.add_snapshot("peer1", Snapshot(
+                    height=snap_meta.height, format=snap_meta.format_,
+                    chunks=snap_meta.chunks, hash_=snap_meta.hash))
+                with pytest.raises(ErrNoSnapshots):
+                    await syncer.sync_any()
+            finally:
+                await conns.stop()
+
+        asyncio.run(main())
+
+    def test_no_snapshots(self):
+        async def main():
+            conns = AppConns(local_client_creator(KVStoreApplication()))
+            await conns.start()
+            try:
+                syncer = Syncer(
+                    _DirectProvider(b"", None, None), conns.snapshot,
+                    lambda *a: None)
+                with pytest.raises(ErrNoSnapshots):
+                    await syncer.sync_any()
+            finally:
+                await conns.stop()
+
+        asyncio.run(main())
+
+
+class TestLightClientStateProvider:
+    def test_state_assembly_from_light_blocks(self):
+        async def main():
+            from cometbft_tpu import light
+            from cometbft_tpu.light.provider import MemProvider
+            from cometbft_tpu.light.store import LightStore
+            from cometbft_tpu.store import MemDB
+
+            chain = LightChain("ss-lc", 12, n_vals=4)
+            lc = light.Client(
+                "ss-lc",
+                light.TrustOptions(period_ns=10**18, height=1,
+                                   hash_=chain.blocks[1].hash()),
+                MemProvider("ss-lc", chain.blocks, name="p"),
+                [MemProvider("ss-lc", chain.blocks, name="w")],
+                LightStore(MemDB()),
+            )
+            await lc.initialize()
+            provider = LightClientStateProvider(lc)
+            h = 8
+            app_hash = await provider.app_hash(h)
+            assert app_hash == chain.blocks[h + 1].header.app_hash
+            commit = await provider.commit(h)
+            assert commit.height == h
+            state = await provider.state(h)
+            assert state.last_block_height == h
+            assert state.validators.hash() == chain.valsets[h + 1].hash()
+            assert state.next_validators.hash() == chain.valsets[h + 2].hash()
+            assert state.last_validators.hash() == chain.valsets[h].hash()
+            assert state.app_hash == chain.blocks[h + 1].header.app_hash
+
+        asyncio.run(main())
