@@ -1,0 +1,87 @@
+"""Remote-signer privval: socket protocol round-trip, double-sign guard
+enforced at the signer, reconnection-free request pipelining (reference:
+privval/signer_client_test.go shapes)."""
+
+import secrets
+import threading
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.privval.file_pv import ErrDoubleSign, FilePV
+from cometbft_tpu.privval.remote import SignerClient, SignerServer
+from cometbft_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.utils import cmttime
+
+
+def _block_id():
+    return BlockID(
+        hash=secrets.token_bytes(32),
+        part_set_header=PartSetHeader(total=1, hash=secrets.token_bytes(32)),
+    )
+
+
+def _vote(height, round_, bid, addr, type_=SignedMsgType.PRECOMMIT):
+    return Vote(
+        type_=type_, height=height, round_=round_, block_id=bid,
+        timestamp=cmttime.canonical_now_ms(), validator_address=addr,
+        validator_index=0,
+    )
+
+
+@pytest.fixture()
+def remote_pair():
+    priv = ed25519.gen_priv_key()
+    pv = FilePV(priv)
+    client = SignerClient(("127.0.0.1", 0), timeout=5.0, accept_timeout=5.0)
+    server = SignerServer(pv, client.laddr)
+    server.start()
+    t = threading.Thread(target=client.accept)
+    t.start()
+    t.join(timeout=5.0)
+    assert client._conn is not None, "signer never dialed in"
+    yield priv, pv, client, server
+    server.stop()
+    client.close()
+
+
+class TestRemoteSigner:
+    def test_pubkey_and_ping(self, remote_pair):
+        priv, _, client, _ = remote_pair
+        client.ping()
+        pub = client.get_pub_key()
+        assert pub.bytes_() == priv.pub_key().bytes_()
+
+    def test_sign_vote_roundtrip(self, remote_pair):
+        priv, _, client, _ = remote_pair
+        addr = priv.pub_key().address()
+        v = _vote(5, 0, _block_id(), addr)
+        client.sign_vote("remote-chain", v)
+        assert v.verify("remote-chain", priv.pub_key())
+
+    def test_sign_vote_with_extension(self, remote_pair):
+        priv, _, client, _ = remote_pair
+        addr = priv.pub_key().address()
+        v = _vote(6, 0, _block_id(), addr)
+        v.extension = b"ext-payload"
+        client.sign_vote("remote-chain", v, sign_extension=True)
+        assert v.verify_vote_and_extension("remote-chain", priv.pub_key())
+
+    def test_double_sign_refused_at_signer(self, remote_pair):
+        priv, _, client, _ = remote_pair
+        addr = priv.pub_key().address()
+        v1 = _vote(7, 0, _block_id(), addr)
+        client.sign_vote("remote-chain", v1)
+        v2 = _vote(7, 0, _block_id(), addr)  # same HRS, different block
+        with pytest.raises(ErrDoubleSign):
+            client.sign_vote("remote-chain", v2)
+
+    def test_sign_proposal(self, remote_pair):
+        priv, _, client, _ = remote_pair
+        p = Proposal(height=9, round_=0, pol_round=-1, block_id=_block_id(),
+                     timestamp=cmttime.canonical_now_ms())
+        client.sign_proposal("remote-chain", p)
+        assert priv.pub_key().verify_signature(
+            p.sign_bytes("remote-chain"), p.signature)
