@@ -156,6 +156,39 @@ def cmd_show_validator(args) -> int:
     return 0
 
 
+def cmd_rollback(args) -> int:
+    """cmd/cometbft/commands/rollback.go: revert state (and optionally the
+    block) by one height so the app can re-run the last block."""
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.state.rollback import rollback
+    from cometbft_tpu.state.store import StateStore
+    from cometbft_tpu.store import BlockStore
+    from cometbft_tpu.store.db import open_db
+
+    cfg = Config.load(_home(args))
+    block_store = BlockStore(open_db(cfg.base.db_backend, cfg.db_path("blockstore")))
+    state_store = StateStore(open_db(cfg.base.db_backend, cfg.db_path("state")))
+    height, app_hash = rollback(block_store, state_store,
+                                remove_block=args.hard)
+    print(f"Rolled back state to height {height} and hash {app_hash.hex().upper()}")
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    """inspect/inspect.go: serve the data-backed subset of the RPC (status,
+    block, blockchain, validators, tx lookups) over a STOPPED node's stores
+    — consensus and p2p never start, so a crashed node can be examined
+    without running it."""
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.node.inspect import run_inspect
+
+    cfg = Config.load(_home(args))
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    asyncio.run(run_inspect(cfg))
+    return 0
+
+
 def cmd_version(_args) -> int:
     print(VERSION)
     return 0
@@ -188,6 +221,15 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("--chain-id", default="")
     sp.add_argument("--starting-port", type=int, default=26656)
     sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("rollback", help="revert state by one height")
+    sp.add_argument("--hard", action="store_true",
+                    help="also remove the block at the rolled-back height")
+    sp.set_defaults(fn=cmd_rollback)
+
+    sp = sub.add_parser("inspect", help="serve read-only RPC over a stopped node's data")
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
+    sp.set_defaults(fn=cmd_inspect)
 
     sp = sub.add_parser("show-node-id")
     sp.set_defaults(fn=cmd_show_node_id)

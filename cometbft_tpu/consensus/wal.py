@@ -40,10 +40,16 @@ class EventDataRoundState:
 
 
 class WAL:
-    def __init__(self, path: str):
+    def __init__(self, path: str, chunk_size: int | None = None,
+                 total_size: int | None = None):
+        from cometbft_tpu.libs import autofile
+
         self.path = path
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._f = open(path, "ab")
+        self.group = autofile.Group(
+            path,
+            chunk_size=chunk_size or autofile.DEFAULT_CHUNK_SIZE,
+            total_size=total_size or autofile.DEFAULT_TOTAL_SIZE,
+        )
 
     # ------------------------------------------------------------- write
 
@@ -52,48 +58,55 @@ class WAL:
 
     def write_sync(self, msg) -> None:
         self._write_record(_encode_msg(msg))
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        self.group.fsync()
 
     def _write_record(self, body: bytes) -> None:
         crc = zlib.crc32(body) & 0xFFFFFFFF
-        self._f.write(struct.pack(">II", crc, len(body)) + body)
+        self.group.write(struct.pack(">II", crc, len(body)) + body)
+        self.group.maybe_rotate()  # record boundary: safe rotation point
 
     def flush(self) -> None:
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        self.group.fsync()
 
     def close(self) -> None:
-        try:
-            self.flush()
-        except (OSError, ValueError):
-            pass
-        self._f.close()
+        self.group.close()
 
     # -------------------------------------------------------------- read
 
     def iter_records(self) -> Iterator[object]:
-        """Yield decoded messages; stops (and truncates) at a corrupted
-        tail."""
-        good_end = 0
-        with open(self.path, "rb") as f:
-            while True:
-                hdr = f.read(8)
-                if len(hdr) < 8:
-                    break
-                crc, n = struct.unpack(">II", hdr)
-                if n > MAX_RECORD_SIZE:
-                    break
-                body = f.read(n)
-                if len(body) < n or (zlib.crc32(body) & 0xFFFFFFFF) != crc:
-                    break
-                good_end = f.tell()
-                yield _decode_msg(body)
-        size = os.path.getsize(self.path)
-        if good_end < size:
-            # torn tail: repair by truncation (reference auto-repair)
-            with open(self.path, "r+b") as f:
-                f.truncate(good_end)
+        """Yield decoded messages across every chunk in stream order;
+        stops at a corrupted record. Torn tails are repaired by truncation
+        only in the FINAL file (a mid-group corruption means real damage,
+        not a crash artifact — reference wal.go repair semantics)."""
+        paths = [p for p in self.group.chunk_paths() if os.path.exists(p)]
+        for pi, path in enumerate(paths):
+            good_end = 0
+            corrupted = False
+            with open(path, "rb") as f:
+                while True:
+                    hdr = f.read(8)
+                    if len(hdr) < 8:
+                        break
+                    crc, n = struct.unpack(">II", hdr)
+                    if n > MAX_RECORD_SIZE:
+                        corrupted = True
+                        break
+                    body = f.read(n)
+                    if len(body) < n or (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+                        corrupted = True
+                        break
+                    good_end = f.tell()
+                    yield _decode_msg(body)
+            size = os.path.getsize(path)
+            if good_end < size:
+                if pi == len(paths) - 1:
+                    # torn tail: repair by truncation (reference auto-repair)
+                    with open(path, "r+b") as f:
+                        f.truncate(good_end)
+                else:
+                    raise OSError(f"corrupted WAL chunk {path} (not the tail)")
+            if corrupted:
+                return
 
     def search_for_end_height(self, height: int) -> bool:
         """True if EndHeightMessage(height) exists (wal.go:64)."""

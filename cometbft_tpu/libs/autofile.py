@@ -1,0 +1,99 @@
+"""Rotating file groups (reference: libs/autofile/group.go).
+
+A Group is a head file plus numbered chunks (`path`, `path.000`,
+`path.001`, ...): writers append to the head; when the head passes
+chunk_size (checked at record boundaries so records never split), it
+rotates to the next numbered chunk and a fresh head opens. Total size is
+bounded by pruning the oldest chunks (group.go:36 headSizeLimit /
+totalSizeLimit). Readers see one logical stream across chunks in order.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Iterator
+
+DEFAULT_CHUNK_SIZE = 10 * 1024 * 1024   # group.go:41 defaultHeadSizeLimit
+DEFAULT_TOTAL_SIZE = 1024 * 1024 * 1024  # group.go:42 defaultTotalSizeLimit
+
+
+class Group:
+    def __init__(self, head_path: str,
+                 chunk_size: int = DEFAULT_CHUNK_SIZE,
+                 total_size: int = DEFAULT_TOTAL_SIZE):
+        self.head_path = head_path
+        self.chunk_size = chunk_size
+        self.total_size = total_size
+        os.makedirs(os.path.dirname(head_path) or ".", exist_ok=True)
+        self._head = open(head_path, "ab")
+
+    # ------------------------------------------------------------- write
+
+    def write(self, data: bytes) -> None:
+        self._head.write(data)
+
+    def flush(self) -> None:
+        self._head.flush()
+
+    def fsync(self) -> None:
+        self._head.flush()
+        os.fsync(self._head.fileno())
+
+    def maybe_rotate(self) -> bool:
+        """Call at a record boundary; rotates the head into a numbered
+        chunk when it exceeds chunk_size (group.go:190 checkHeadSizeLimit).
+        Returns True if a rotation happened."""
+        if self._head.tell() < self.chunk_size:
+            return False
+        self.fsync()
+        self._head.close()
+        idx = self._chunk_indexes()
+        nxt = (idx[-1] + 1) if idx else 0
+        os.replace(self.head_path, f"{self.head_path}.{nxt:03d}")
+        self._head = open(self.head_path, "ab")
+        self._prune()
+        return True
+
+    def _prune(self) -> None:
+        """Drop oldest chunks while total size exceeds the limit
+        (group.go:216 checkTotalSizeLimit)."""
+        while True:
+            paths = self.chunk_paths()
+            total = sum(os.path.getsize(p) for p in paths if os.path.exists(p))
+            idx = self._chunk_indexes()
+            if total <= self.total_size or not idx:
+                return
+            os.remove(f"{self.head_path}.{idx[0]:03d}")
+
+    def close(self) -> None:
+        try:
+            self.fsync()
+        except (OSError, ValueError):
+            pass
+        self._head.close()
+
+    # -------------------------------------------------------------- read
+
+    def _chunk_indexes(self) -> list[int]:
+        d = os.path.dirname(self.head_path) or "."
+        base = os.path.basename(self.head_path)
+        pat = re.compile(re.escape(base) + r"\.(\d{3,})$")
+        out = []
+        for name in os.listdir(d):
+            m = pat.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def chunk_paths(self) -> list[str]:
+        """Oldest chunk first, the head last — the logical stream order."""
+        paths = [f"{self.head_path}.{i:03d}" for i in self._chunk_indexes()]
+        paths.append(self.head_path)
+        return paths
+
+    def iter_bytes(self) -> Iterator[tuple[str, bytes]]:
+        for p in self.chunk_paths():
+            if os.path.exists(p):
+                with open(p, "rb") as f:
+                    yield p, f.read()

@@ -1,0 +1,259 @@
+"""Prometheus-style metrics, dependency-free.
+
+Reference: each subsystem's metrics.go (consensus/metrics.go:20-133,
+mempool/metrics.go, p2p/metrics.go, state/metrics.go) built on go-kit +
+prometheus. Same shape here: typed per-subsystem structs over Counter /
+Gauge / Histogram primitives, one process-wide Registry rendering the
+Prometheus text exposition format, served by the RPC server's /metrics
+route (config.instrumentation.prometheus).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, labels: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(labels)
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *label_values: str) -> "_Bound":
+        if len(label_values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: want {len(self.label_names)} labels, got {len(label_values)}")
+        return _Bound(self, tuple(str(v) for v in label_values))
+
+    def _set(self, key: tuple, v: float) -> None:
+        with self._lock:
+            self._values[key] = v
+
+    def _add(self, key: tuple, v: float) -> None:
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + v
+
+    def _fmt_key(self, key: tuple) -> str:
+        if not key:
+            return self.name
+        inner = ",".join(
+            f'{n}="{v}"' for n, v in zip(self.label_names, key))
+        return f"{self.name}{{{inner}}}"
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.TYPE}"]
+        with self._lock:
+            vals = dict(self._values) or ({(): 0.0} if not self.label_names else {})
+        for key, v in sorted(vals.items()):
+            out.append(f"{self._fmt_key(key)} {v:g}")
+        return out
+
+
+class _Bound:
+    def __init__(self, metric: "_Metric", key: tuple):
+        self._m = metric
+        self._key = key
+
+    def set(self, v: float) -> None:
+        self._m._set(self._key, v)
+
+    def inc(self, v: float = 1.0) -> None:
+        self._m._add(self._key, v)
+
+    def observe(self, v: float) -> None:
+        self._m.observe_key(self._key, v)  # type: ignore[attr-defined]
+
+
+class Counter(_Metric):
+    TYPE = "counter"
+
+    def inc(self, v: float = 1.0) -> None:
+        self._add((), v)
+
+
+class Gauge(_Metric):
+    TYPE = "gauge"
+
+    def set(self, v: float) -> None:
+        self._set((), v)
+
+    def inc(self, v: float = 1.0) -> None:
+        self._add((), v)
+
+    def dec(self, v: float = 1.0) -> None:
+        self._add((), -v)
+
+
+class Histogram(_Metric):
+    """Prometheus histogram with fixed buckets."""
+
+    TYPE = "histogram"
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                       2.5, 5.0, 10.0)
+
+    def __init__(self, name: str, help_: str, labels: Sequence[str] = (),
+                 buckets: Sequence[float] | None = None):
+        super().__init__(name, help_, labels)
+        self.buckets = tuple(buckets if buckets is not None else self.DEFAULT_BUCKETS)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, v: float) -> None:
+        self.observe_key((), v)
+
+    def observe_key(self, key: tuple, v: float) -> None:
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + v
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            keys = list(self._totals) or ([()] if not self.label_names else [])
+            for key in sorted(keys):
+                counts = self._counts.get(key, [0] * len(self.buckets))
+                cum = 0
+                for b, c in zip(self.buckets, counts):
+                    cum = c  # counts are already cumulative per-bucket
+                    labels = dict(zip(self.label_names, key))
+                    labels["le"] = f"{b:g}"
+                    inner = ",".join(f'{n}="{v}"' for n, v in labels.items())
+                    out.append(f"{self.name}_bucket{{{inner}}} {cum}")
+                labels = dict(zip(self.label_names, key))
+                labels["le"] = "+Inf"
+                inner = ",".join(f'{n}="{v}"' for n, v in labels.items())
+                out.append(f"{self.name}_bucket{{{inner}}} {self._totals.get(key, 0)}")
+                base = self._fmt_key(key)
+                out.append(f"{base}_sum {self._sums.get(key, 0.0):g}")
+                out.append(f"{base}_count {self._totals.get(key, 0)}")
+        return out
+
+
+class Registry:
+    def __init__(self, namespace: str = "cometbft"):
+        self.namespace = namespace
+        self._metrics: list[_Metric] = []
+        self._lock = threading.Lock()
+
+    def counter(self, subsystem: str, name: str, help_: str,
+                labels: Sequence[str] = ()) -> Counter:
+        return self._register(Counter(self._nm(subsystem, name), help_, labels))
+
+    def gauge(self, subsystem: str, name: str, help_: str,
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge(self._nm(subsystem, name), help_, labels))
+
+    def histogram(self, subsystem: str, name: str, help_: str,
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] | None = None) -> Histogram:
+        return self._register(
+            Histogram(self._nm(subsystem, name), help_, labels, buckets))
+
+    def _nm(self, subsystem: str, name: str) -> str:
+        return f"{self.namespace}_{subsystem}_{name}"
+
+    def _register(self, m):
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def render(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------- per-subsystem structs
+
+
+class ConsensusMetrics:
+    """consensus/metrics.go:20-133."""
+
+    def __init__(self, reg: Registry):
+        self.height = reg.gauge("consensus", "height", "Height of the chain")
+        self.rounds = reg.gauge("consensus", "rounds", "Round of the current height")
+        self.round_duration = reg.histogram(
+            "consensus", "round_duration_seconds", "Time per consensus round")
+        self.validators = reg.gauge("consensus", "validators", "Number of validators")
+        self.validators_power = reg.gauge(
+            "consensus", "validators_power", "Total voting power")
+        self.missing_validators = reg.gauge(
+            "consensus", "missing_validators", "Validators missing from the last commit")
+        self.byzantine_validators = reg.gauge(
+            "consensus", "byzantine_validators", "Validators with evidence against them")
+        self.block_interval = reg.histogram(
+            "consensus", "block_interval_seconds", "Time between blocks",
+            buckets=(0.1, 0.25, 0.5, 1, 2, 5, 10, 30))
+        self.num_txs = reg.gauge("consensus", "num_txs", "Txs in the latest block")
+        self.block_size = reg.gauge("consensus", "block_size_bytes", "Latest block size")
+        self.total_txs = reg.counter("consensus", "total_txs", "Total committed txs")
+        self.vote_extension_received = reg.counter(
+            "consensus", "vote_extensions_received", "Peer vote extensions seen",
+            labels=("status",))
+        self.batch_flushes = reg.counter(
+            "consensus", "vote_batch_flushes", "Device vote-batch flushes")
+        self.batch_lanes = reg.counter(
+            "consensus", "vote_batch_lanes", "Signatures through batched flushes")
+
+
+class MempoolMetrics:
+    """mempool/metrics.go."""
+
+    def __init__(self, reg: Registry):
+        self.size = reg.gauge("mempool", "size", "Number of uncommitted txs")
+        self.size_bytes = reg.gauge("mempool", "size_bytes", "Mempool byte size")
+        self.failed_txs = reg.counter("mempool", "failed_txs", "CheckTx rejections")
+        self.recheck_times = reg.counter("mempool", "recheck_times", "Recheck passes")
+
+
+class P2PMetrics:
+    """p2p/metrics.go."""
+
+    def __init__(self, reg: Registry):
+        self.peers = reg.gauge("p2p", "peers", "Connected peers")
+        self.message_send_bytes = reg.counter(
+            "p2p", "message_send_bytes_total", "Bytes sent", labels=("chID",))
+        self.message_receive_bytes = reg.counter(
+            "p2p", "message_receive_bytes_total", "Bytes received", labels=("chID",))
+
+
+class StateMetrics:
+    """state/metrics.go."""
+
+    def __init__(self, reg: Registry):
+        self.block_processing_time = reg.histogram(
+            "state", "block_processing_time", "ApplyBlock seconds",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5))
+
+
+class CryptoMetrics:
+    """TPU dimension (no reference analog): device batch activity."""
+
+    def __init__(self, reg: Registry):
+        self.device_batches = reg.counter(
+            "crypto", "device_batches", "Kernel dispatches", labels=("kind",))
+        self.device_lanes = reg.counter(
+            "crypto", "device_lanes", "Signature lanes dispatched", labels=("kind",))
+        self.device_seconds = reg.counter(
+            "crypto", "device_seconds", "Estimated device-busy seconds")
+
+
+_global: Optional[Registry] = None
+
+
+def global_registry() -> Registry:
+    global _global
+    if _global is None:
+        _global = Registry()
+    return _global

@@ -99,6 +99,12 @@ class CListMempool:
         self._lock = asyncio.Lock()
         self._tx_available = asyncio.Event()
         self.notify_available = True
+        self.metrics = None  # libs.metrics.MempoolMetrics | None (node wires it)
+
+    def _update_metrics(self) -> None:
+        if self.metrics is not None:
+            self.metrics.size.set(self.size())
+            self.metrics.size_bytes.set(self.size_bytes())
 
     # ------------------------------------------------------------- sizes
 
@@ -209,9 +215,12 @@ class CListMempool:
             if mtx is not None:
                 self._txs_bytes -= len(mtx.tx)
         if self.config.recheck and self._txs:
+            if self.metrics is not None:
+                self.metrics.recheck_times.inc()
             await self._recheck_txs()
         if not self._txs:
             self._tx_available.clear()
+        self._update_metrics()
 
     async def _recheck_txs(self) -> None:
         """Re-validate remaining txs against post-block state

@@ -145,7 +145,8 @@ class Node(BaseService):
         # ---- mempool + evidence (node.go:369-388)
         self.mempool = CListMempool(config.mempool, None)  # app conn wired on start
         self._evidence_db = open_db(backend, config.db_path("evidence"))
-        self.evidence_pool = EvidencePool(self._evidence_db, self.state_store)
+        self.evidence_pool = EvidencePool(self._evidence_db, self.state_store,
+                                          block_store=self.block_store)
         self.event_switch = EventSwitch()
         self.event_bus = EventBus()
 
@@ -164,6 +165,16 @@ class Node(BaseService):
         ) if self._indexer_db is not None else None
 
         # ---- execution + consensus (node.go:391-425)
+        # ---- metrics (node.go:300 DefaultMetricsProvider; per-node registry
+        # so in-process multi-node tests don't cross-count)
+        from cometbft_tpu.libs import metrics as cmtmetrics
+
+        self.metrics_registry = cmtmetrics.Registry()
+        self.consensus_metrics = cmtmetrics.ConsensusMetrics(self.metrics_registry)
+        self.mempool_metrics = cmtmetrics.MempoolMetrics(self.metrics_registry)
+        self.p2p_metrics = cmtmetrics.P2PMetrics(self.metrics_registry)
+        self.mempool.metrics = self.mempool_metrics
+
         self.block_exec = BlockExecutor(
             self.state_store, None, self.mempool, evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
@@ -178,6 +189,7 @@ class Node(BaseService):
             priv_validator=self.priv_validator,
             event_switch=self.event_switch,
             logger=self.logger.with_fields(module="consensus"),
+            metrics=self.consensus_metrics,
         )
         # blocksync runs when enabled and we are not the sole validator
         # (node.go onlyValidatorIsUs — nothing to sync from ourselves)
@@ -223,6 +235,7 @@ class Node(BaseService):
             ),
             logger=self.logger.with_fields(module="p2p"),
         )
+        self.switch.metrics = self.p2p_metrics
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
         self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
         self.switch.add_reactor("MEMPOOL", self.mempool_reactor)

@@ -43,6 +43,7 @@ class Switch(BaseService):
         self._chan_to_reactor: dict[int, Reactor] = {}
         self._channels: list[ChannelDescriptor] = []
         self.peers: dict[str, Peer] = {}
+        self.metrics = None  # libs.metrics.P2PMetrics | None (node wires it)
         self.persistent_addrs: dict[str, str] = {}  # node_id -> addr
         self._reconnecting: set[str] = set()
         self._tasks = TaskRunner("switch")
@@ -160,6 +161,8 @@ class Switch(BaseService):
             reactor.init_peer(peer)
         await peer.start()
         self.peers[node_id] = peer
+        if self.metrics is not None:
+            self.metrics.peers.set(len(self.peers))
         for reactor in self.reactors.values():
             await reactor.add_peer(peer)
         self.logger.info("added peer", peer=node_id[:10],
@@ -205,6 +208,8 @@ class Switch(BaseService):
     async def _stop_peer(self, peer: Peer, reason: object) -> None:
         if self.peers.get(peer.id) is peer:
             self.peers.pop(peer.id, None)
+            if self.metrics is not None:
+                self.metrics.peers.set(len(self.peers))
         try:
             await peer.stop()
         except Exception:  # noqa: BLE001

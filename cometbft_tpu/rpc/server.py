@@ -19,6 +19,13 @@ from cometbft_tpu.libs import log as cmtlog
 from cometbft_tpu.libs.service import BaseService, TaskRunner
 from cometbft_tpu.rpc.core import Environment, QuotedStr, RPCError, UriStr
 
+
+class _RawText:
+    """Marker for non-JSON HTTP responses (the /metrics exposition)."""
+
+    def __init__(self, text: str):
+        self.text = text
+
 MAX_BODY = 1_000_000
 MAX_HEADERS = 64
 WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"  # RFC 6455 §1.3
@@ -111,6 +118,14 @@ class RPCServer(BaseService):
             route = path.strip("/")
             if route == "":
                 return 200, {"routes": sorted(self.routes)}
+            if route == "metrics":
+                # Prometheus text exposition (config.instrumentation;
+                # reference serves this on prometheus_laddr — one process
+                # port here, same scrape contract)
+                reg = getattr(self.node, "metrics_registry", None)
+                if reg is None:
+                    return 404, {"error": "metrics disabled"}
+                return 200, _RawText(reg.render())
             params = {k: v[0] for k, v in urllib.parse.parse_qs(query).items()}
             # quoted URI params are string literals, unquoted hex/number
             # (http_uri_handler.go); keep which on the value so []byte args
@@ -143,13 +158,19 @@ class RPCServer(BaseService):
 
     async def _respond(self, writer: asyncio.StreamWriter, status: int,
                        payload, keep_alive: bool = False) -> None:
-        body = json.dumps(payload).encode()
-        reason = {200: "OK", 400: "Bad Request", 405: "Method Not Allowed",
+        if isinstance(payload, _RawText):
+            body = payload.text.encode()
+            ctype = "text/plain; version=0.0.4"
+        else:
+            body = json.dumps(payload).encode()
+            ctype = "application/json"
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  405: "Method Not Allowed",
                   413: "Payload Too Large"}.get(status, "Error")
         conn = "keep-alive" if keep_alive else "close"
         head = (
             f"HTTP/1.1 {status} {reason}\r\n"
-            f"Content-Type: application/json\r\n"
+            f"Content-Type: {ctype}\r\n"
             f"Content-Length: {len(body)}\r\n"
             f"Connection: {conn}\r\n\r\n"
         )

@@ -182,6 +182,30 @@ class BlockStore:
 
     # ------------------------------------------------------------- prune
 
+    def delete_latest_block(self) -> None:
+        """store/store.go DeleteLatestBlock — the rollback tool's hook."""
+        with self._lock:
+            height = self._height
+            if height == 0:
+                raise ValueError("block store is empty")
+            meta = self.load_block_meta(height)
+            pairs: list[tuple[bytes, bytes | None]] = [
+                (_hkey(b"H:", height), None),
+                (_hkey(b"SC:", height), None),
+                (_hkey(b"EC:", height), None),
+                (_hkey(b"C:", height - 1), None),
+            ]
+            if meta is not None:
+                pairs.append((b"BH:" + meta.block_id.hash, None))
+                for i in range(10_000):
+                    k = _hkey(b"P:", height) + i.to_bytes(4, "big")
+                    if self.db.get(k) is None:
+                        break
+                    pairs.append((k, None))
+            pairs.append((b"height", (height - 1).to_bytes(8, "big")))
+            self.db.batch_set(pairs)
+            self._height = height - 1
+
     def prune_blocks(self, retain_height: int) -> int:
         """store/store.go:301-383: delete blocks below retain_height,
         keeping hash indices consistent. Returns number pruned."""

@@ -1,0 +1,98 @@
+"""Inspect mode + state rollback (reference: inspect/inspect.go,
+state/rollback.go): a stopped node's data served read-only; state reverted
+one height with and without block removal."""
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.node.node import Node, init_files
+
+
+async def _run_chain(tmp_path, heights=3):
+    cfg = init_files(str(tmp_path), chain_id="ir-chain")
+    cfg.consensus.timeout_commit = 0.05
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    node = Node(cfg)
+    await node.start()
+    try:
+        deadline = asyncio.get_running_loop().time() + 30
+        while node.block_store.height() < heights:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+    finally:
+        await node.stop()
+    return cfg
+
+
+def test_inspect_serves_stopped_node_data(tmp_path):
+    async def main():
+        cfg = await _run_chain(tmp_path)
+
+        from cometbft_tpu.libs import log as cmtlog
+        from cometbft_tpu.node.inspect import InspectNode
+        from cometbft_tpu.rpc.server import RPCServer
+
+        node = InspectNode(cfg, cmtlog.nop())
+        server = RPCServer(node, cfg.rpc, logger=cmtlog.nop())
+        await server.start()
+        try:
+            import json
+            import urllib.request
+
+            def get(route):
+                with urllib.request.urlopen(
+                        f"http://{server.bound_addr}/{route}", timeout=5) as r:
+                    return json.load(r)
+
+            status = await asyncio.to_thread(get, "status")
+            assert int(status["result"]["sync_info"]["latest_block_height"]) >= 3
+            blk = await asyncio.to_thread(get, "block?height=2")
+            assert blk["result"]["block"]["header"]["height"] == "2"
+            vals = await asyncio.to_thread(get, "validators?height=2")
+            assert len(vals["result"]["validators"]) == 1
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+
+
+def test_rollback_soft_and_hard(tmp_path):
+    async def main():
+        cfg = await _run_chain(tmp_path, heights=4)
+
+        from cometbft_tpu.state.rollback import rollback
+        from cometbft_tpu.state.store import StateStore
+        from cometbft_tpu.store import BlockStore
+        from cometbft_tpu.store.db import open_db
+
+        block_store = BlockStore(open_db(cfg.base.db_backend, cfg.db_path("blockstore")))
+        state_store = StateStore(open_db(cfg.base.db_backend, cfg.db_path("state")))
+        h0 = block_store.height()
+        s0 = state_store.load()
+        assert s0.last_block_height in (h0, h0 - 1)
+
+        # soft rollback: state to n-1, block store untouched (unless it was
+        # already one ahead, in which case rollback is a no-op fix)
+        new_h, app_hash = rollback(block_store, state_store, remove_block=False)
+        s1 = state_store.load()
+        if s0.last_block_height == h0:
+            assert new_h == h0 - 1
+            assert s1.last_block_height == h0 - 1
+            assert block_store.height() == h0
+            # app hash at n-1 is the one agreed in block n
+            meta_n = block_store.load_block_meta(h0)
+            assert app_hash == meta_n.header.app_hash
+            assert s1.validators.hash() == s0.last_validators.hash()
+        else:
+            assert new_h == s0.last_block_height
+
+        # hard rollback removes the now-orphaned block too
+        h_before = block_store.height()
+        rollback(block_store, state_store, remove_block=True)
+        assert block_store.height() == h_before - 1
+        assert block_store.load_block(h_before) is None
+        assert block_store.load_block_meta(h_before) is None
+
+    asyncio.run(main())
