@@ -1,27 +1,32 @@
 """Headline benchmark: Ed25519 signatures verified per second per chip.
 
-Reproduces BASELINE.json config 1/3/5 shape: a stream of 10k-signature
-mega-batches (the 10k-validator commit cap, types/vote_set.go:17) pushed
-through the TPU batch-verification pipeline end-to-end — host staging
-(SHA-512 challenges, packed-word layout), device kernel (Pallas fused
-ladder), mask readback — with the device-resident pubkey cache warm (a
-validator set re-verifies every height; the reference's expanded-key LRU
-plays the same role, crypto/ed25519/ed25519.go:44).
+Reproduces BASELINE.json shapes on the real device:
+  config 1/5 — a stream of 10k-signature mega-batches (the 10k-validator
+    commit cap, types/vote_set.go:17) through the TPU pipeline end-to-end
+    with the device pubkey cache warm. HEADLINE: streaming sigs/s/chip.
+  config 3 — blocksync catch-up: 1,000 consecutive 150-validator commits
+    through the windowed stage/prefetch pipeline (types/validation.py,
+    blocksync/reactor.py shape): blocks/s + device busy fraction.
+  config 4 — light-client bisection across a simulated 100k-height,
+    500-validator chain with valset churn (every hop's commit checks ride
+    the device batch verifier).
+  consensus-on-TPU — a 4-validator in-process net with the batched vote
+    path flushing through the REAL device (tests force the CPU backend;
+    this is the latency evidence VERDICT r2 item 8 asked for).
 
-Two numbers:
-  * streaming throughput (HEADLINE): N batches dispatched back-to-back
-    with async readback — the blocksync catch-up shape (BASELINE config 3),
-    host staging of batch i+1 overlapped with device verify of batch i.
-  * p50 single-batch latency: one synchronous verify_batch call. NOTE:
-    this dev box reaches its TPU through a network tunnel with an ~89 ms
-    round-trip floor and ~22 MB/s bandwidth; single-call latency is
-    tunnel-bound, not kernel-bound (device compute is ~31 ms/10k sigs).
+Baselines (both reported):
+  vs_serial — measured serial OpenSSL single-verify on this host's core.
+  vs_batch_pinned — serial extrapolated by a PINNED 4x batch-speedup
+    factor for the reference's curve25519-voi batch verifier
+    (crypto/ed25519/ed25519.go:208-241). No Go toolchain exists in this
+    image to measure it directly; published curve25519-voi/ed25519-dalek
+    batch-verification numbers sit at ~2-3x serial on one core, so 4x is
+    a deliberately conservative (baseline-favoring) bound.
 
-Baseline: serial OpenSSL single-verify on this host's one CPU core —
-the best CPU verifier available in this image (no Go toolchain, so the
-reference's curve25519-voi batch verifier, ed25519.go:208-241, cannot be
-run here; public numbers put it at roughly 3-4x serial OpenSSL on one
-core, which would still leave the TPU path >10x ahead).
+NOTE: this dev box reaches its TPU through a network tunnel (~89 ms RTT
+floor, ~22 MB/s). Single-batch p50 latency is tunnel-bound; the
+device_compute_ms figure isolates kernel time by rep-differencing (time
+of k+N chained kernels minus time of k, over N).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -40,6 +45,263 @@ BATCH = int(os.environ.get("BENCH_BATCH", "10240"))
 CPU_SAMPLE = int(os.environ.get("BENCH_CPU_SAMPLE", "2048"))
 ITERS = int(os.environ.get("BENCH_ITERS", "5"))
 STREAM_BATCHES = int(os.environ.get("BENCH_STREAM_BATCHES", "16"))
+BS_HEIGHTS = int(os.environ.get("BENCH_BS_HEIGHTS", "1000"))
+BS_VALS = int(os.environ.get("BENCH_BS_VALS", "150"))
+LC_HEIGHT = int(os.environ.get("BENCH_LC_HEIGHT", "100000"))
+LC_VALS = int(os.environ.get("BENCH_LC_VALS", "500"))
+PINNED_VOI_BATCH_FACTOR = 4.0
+VS_BATCH_NOTE = (
+    "serial OpenSSL x pinned 4.0 factor for curve25519-voi batch verify "
+    "(published numbers ~2-3x; 4x chosen to favor the baseline)"
+)
+
+
+def _mk_sigs(n, n_keys):
+    from cometbft_tpu.crypto import ed25519
+
+    privs = [ed25519.gen_priv_key() for _ in range(n_keys)]
+    pubs, msgs, sigs = [], [], []
+    for i in range(n):
+        p = privs[i % n_keys]
+        msg = b"bench-vote-" + i.to_bytes(4, "big") + secrets.token_bytes(8)
+        pubs.append(p.pub_key().bytes_())
+        msgs.append(msg)
+        sigs.append(p.sign(msg))
+    return privs, pubs, msgs, sigs
+
+
+def bench_device_compute(K, a_dev, rwd, swd, kwd) -> float:
+    """Kernel-only ms per batch via rep-differencing through the tunnel."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from cometbft_tpu.ops import pallas_verify as PV
+
+    @functools.partial(jax.jit, static_argnames=("reps",))
+    def run_n(ax, ay, az, at, rw, sw, kw, reps=1):
+        acc = jnp.zeros((), jnp.int32)
+        for i in range(reps):
+            acc = acc + PV.verify_pallas(ax, ay, az, at, rw, sw + jnp.uint32(i), kw).sum()
+        return acc
+
+    out = {}
+    for reps in (2, 8):
+        run_n(*a_dev, rwd, swd, kwd, reps=reps).block_until_ready()
+        ts = []
+        for _ in range(6):
+            t0 = time.perf_counter()
+            run_n(*a_dev, rwd, swd, kwd, reps=reps).block_until_ready()
+            ts.append(time.perf_counter() - t0)
+        out[reps] = min(ts)
+    return (out[8] - out[2]) / 6 * 1e3
+
+
+def bench_blocksync(detail: dict) -> None:
+    """BASELINE config 3: stream BS_HEIGHTS consecutive commits from a
+    BS_VALS-validator chain through the stage/prefetch window pipeline —
+    the exact device path blocksync's pool routine drives."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from light_harness import LightChain
+
+    from cometbft_tpu.types import validation
+
+    chain = LightChain("bench-bs", BS_HEIGHTS + 1, n_vals=BS_VALS)
+    vals = chain.valsets[1]
+    window = 32
+    heights = list(range(1, BS_HEIGHTS + 1))
+    # warm the kernel for this bucket size (compile happens once per shape)
+    lb1 = chain.blocks[1]
+    warm = validation.stage_verify_commit(
+        "bench-bs", vals, lb1.commit.block_id, 1, lb1.commit)
+    validation.prefetch_staged([warm])
+    t0 = time.perf_counter()
+    device_busy = 0.0
+    done = 0
+    while done < len(heights):
+        hs = heights[done:done + window]
+        staged = []
+        for h in hs:
+            lb = chain.blocks[h]
+            staged.append(validation.stage_verify_commit(
+                "bench-bs", vals, lb.commit.block_id, h, lb.commit))
+        tb = time.perf_counter()
+        validation.prefetch_staged(staged)
+        device_busy += time.perf_counter() - tb
+        for s in staged:
+            s.finish()
+        done += len(hs)
+    wall = time.perf_counter() - t0
+    detail["blocksync_blocks_per_s"] = round(BS_HEIGHTS / wall, 1)
+    detail["blocksync_sigs_per_s"] = round(BS_HEIGHTS * BS_VALS / wall, 1)
+    detail["blocksync_device_busy_fraction"] = round(device_busy / wall, 3)
+    detail["blocksync_shape"] = f"{BS_HEIGHTS} heights x {BS_VALS} validators, window {window}"
+
+
+def bench_light_client(detail: dict) -> None:
+    """BASELINE config 4: bisection over a lazily-generated LC_HEIGHT-high
+    chain with LC_VALS validators and periodic valset churn; every hop is
+    two device-batched commit verifications."""
+    import asyncio
+
+    from cometbft_tpu import light
+    from cometbft_tpu.crypto import ed25519
+    from cometbft_tpu.light.provider import Provider
+    from cometbft_tpu.light.store import LightStore
+    from cometbft_tpu.store import MemDB
+    from cometbft_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+    from cometbft_tpu.types.block import Header
+    from cometbft_tpu.types.light import LightBlock, SignedHeader
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+    from cometbft_tpu.types.vote import Vote
+    from cometbft_tpu.types.vote_set import VoteSet
+    from cometbft_tpu.utils import cmttime
+
+    CHURN_EVERY = max(LC_HEIGHT // 8, 1)  # 8 valset versions across the chain
+    REPLACE_FRAC = 0.5  # half the set changes per version: forces pivots
+    base_time = cmttime.now().seconds - LC_HEIGHT - 1000
+
+    pool = [ed25519.gen_priv_key() for _ in range(LC_VALS * 5)]
+
+    class LazyChain(Provider):
+        def __init__(self):
+            self._valsets: dict[int, tuple] = {}
+            self._blocks: dict[int, LightBlock] = {}
+
+        def _valset(self, h):
+            ver = h // CHURN_EVERY
+            got = self._valsets.get(ver)
+            if got is None:
+                # deterministic rolling selection from the key pool
+                start = (ver * int(LC_VALS * REPLACE_FRAC)) % (len(pool) - LC_VALS)
+                privs = pool[start:start + LC_VALS]
+                vs = ValidatorSet([Validator.new(p.pub_key(), 10) for p in privs])
+                by_addr = {p.pub_key().address(): p for p in privs}
+                privs = [by_addr[v.address] for v in vs.validators]
+                got = (vs, privs)
+                self._valsets[ver] = got
+            return got
+
+        def _block(self, h):
+            lb = self._blocks.get(h)
+            if lb is not None:
+                return lb
+            vs, privs = self._valset(h)
+            nvs, _ = self._valset(h + 1)
+            header = Header(
+                chain_id="bench-lc", height=h,
+                time=cmttime.Timestamp(base_time + h, 0),
+                last_block_id=BlockID(
+                    hash=b"\x07" * 32,
+                    part_set_header=PartSetHeader(total=1, hash=b"\x08" * 32)),
+                validators_hash=vs.hash(), next_validators_hash=nvs.hash(),
+                consensus_hash=b"\x01" * 32, app_hash=b"\x02" * 32,
+                last_results_hash=b"\x03" * 32, data_hash=b"\x04" * 32,
+                last_commit_hash=b"\x05" * 32, evidence_hash=b"\x06" * 32,
+                proposer_address=vs.validators[0].address,
+            )
+            bid = BlockID(hash=header.hash(),
+                          part_set_header=PartSetHeader(total=1, hash=b"\x09" * 32))
+            vote_set = VoteSet("bench-lc", h, 1, SignedMsgType.PRECOMMIT, vs)
+            for i, p in enumerate(privs):
+                v = Vote(type_=SignedMsgType.PRECOMMIT, height=h, round_=1,
+                         block_id=bid, timestamp=cmttime.canonical_now_ms(),
+                         validator_address=p.pub_key().address(), validator_index=i)
+                v.signature = p.sign(v.sign_bytes("bench-lc"))
+                vote_set.add_vote(v)
+            lb = LightBlock(
+                signed_header=SignedHeader(header=header, commit=vote_set.make_commit()),
+                validator_set=vs)
+            self._blocks[h] = lb
+            return lb
+
+        async def light_block(self, height):
+            return self._block(height if height else LC_HEIGHT)
+
+        async def report_evidence(self, ev):
+            pass
+
+    async def run():
+        provider = LazyChain()
+        first = provider._block(1)
+        client = light.Client(
+            "bench-lc",
+            light.TrustOptions(
+                period_ns=10**18, height=1, hash_=first.hash()),
+            provider, [LazyChain()], LightStore(MemDB()),
+        )
+        await client.initialize()
+        t0 = time.perf_counter()
+        await client.verify_light_block_at_height(LC_HEIGHT)
+        wall = time.perf_counter() - t0
+        return wall, client.store.size()
+
+    wall, hops = asyncio.run(run())
+    detail["lc_bisection_s"] = round(wall, 2)
+    detail["lc_bisection_hops"] = hops
+    detail["lc_shape"] = f"height {LC_HEIGHT}, {LC_VALS} validators, churn every {CHURN_EVERY}"
+
+
+def bench_consensus_tpu(detail: dict) -> None:
+    """VERDICT r2 item 8: the N=4 in-process net with batch_vote_verification
+    flushing through the REAL device backend — per-height commit latency."""
+    import asyncio
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from net_harness import make_net
+
+    from cometbft_tpu.consensus.config import test_consensus_config
+    from cometbft_tpu.crypto import batch as crypto_batch
+
+    crypto_batch.set_backend("tpu")
+
+    async def run():
+        cfg = test_consensus_config()
+        cfg.batch_vote_verification = True
+        net = await make_net(4, config=cfg, chain_id="bench-consensus")
+        heights = 6
+        stamps = {}
+
+        await net.start()
+        try:
+            last = 0
+            deadline = time.monotonic() + 120
+            while last < heights and time.monotonic() < deadline:
+                h = min(n.block_store.height() for n in net.nodes)
+                if h > last:
+                    # stamp only observed transitions; a multi-height jump
+                    # between polls would fabricate ~0 gaps, so record the
+                    # jump at its top height only
+                    stamps[h] = time.perf_counter()
+                    last = h
+                await asyncio.sleep(0.005)
+        finally:
+            await net.stop()
+        if len(stamps) < 2:
+            return None
+        # gaps only between ADJACENT observed heights (both really seen)
+        gaps = sorted(
+            stamps[i + 1] - stamps[i]
+            for i in stamps if i + 1 in stamps
+        )
+        if not gaps:
+            return None
+        return gaps[len(gaps) // 2], len(stamps)
+
+    try:
+        out = asyncio.run(run())
+    finally:
+        crypto_batch.set_backend("auto")
+    if out is None:
+        detail["consensus_tpu"] = "FAILED: net did not commit 2+ heights in 120s"
+    else:
+        p50, committed = out
+        detail["consensus_tpu_height_p50_ms"] = round(p50 * 1e3, 1)
+        detail["consensus_tpu_heights_committed"] = committed
+        detail["consensus_tpu_note"] = (
+            "4-validator in-proc net, vote flushes on the real device "
+            "(each flush pays the dev-box tunnel RTT)")
 
 
 def main() -> None:
@@ -48,23 +310,18 @@ def main() -> None:
     jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__), ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 
+    import jax.numpy as jnp
+
     from cometbft_tpu.crypto import ed25519
     from cometbft_tpu.ops import ed25519_kernel as K
 
+    detail: dict = {"backend": jax.devices()[0].platform, "batch": BATCH}
+
     # -- build the batch: one "validator set" signing distinct messages
-    n_vals = min(BATCH, 10240)
-    privs = [ed25519.gen_priv_key() for _ in range(n_vals)]
-    pubs, msgs, sigs = [], [], []
-    for i in range(BATCH):
-        p = privs[i % n_vals]
-        msg = b"bench-vote-" + i.to_bytes(4, "big") + secrets.token_bytes(8)
-        pubs.append(p.pub_key().bytes_())
-        msgs.append(msg)
-        sigs.append(p.sign(msg))
+    privs, pubs, msgs, sigs = _mk_sigs(BATCH, min(BATCH, 10240))
 
     cache = K.PubKeyCache()
-    # warm-up: compiles the kernel and fills the pubkey cache
-    ok, _ = K.verify_batch(pubs, msgs, sigs, cache=cache)
+    ok, _ = K.verify_batch(pubs, msgs, sigs, cache=cache)  # warm-up compile
     assert ok, "warm-up batch failed verification"
 
     # -- p50 synchronous single-batch latency
@@ -74,11 +331,20 @@ def main() -> None:
         ok, mask = K.verify_batch(pubs, msgs, sigs, cache=cache)
         lat.append(time.perf_counter() - t0)
         assert ok
-    p50_latency = sorted(lat)[len(lat) // 2]
+    detail["p50_batch_latency_ms"] = round(sorted(lat)[len(lat) // 2] * 1e3, 2)
+    detail["tunnel_note"] = "single-batch latency includes ~89ms axon-tunnel RTT floor"
 
-    # -- streaming throughput: async dispatch, one sync point at the end
-    #    (the blocksync catch-up shape: every height's commit re-verified
-    #    against the same validator set)
+    # -- kernel-only device compute (rep-differencing)
+    b = K.bucket_size(BATCH)
+    _, safe_pubs, rw, sw, kw = K.stage_batch(pubs, msgs, sigs, b)
+    _, a_dev = cache.stage(safe_pubs, b)
+    try:
+        detail["device_compute_ms_per_batch"] = round(
+            bench_device_compute(K, a_dev, jnp.asarray(rw), jnp.asarray(sw), jnp.asarray(kw)), 2)
+    except Exception as e:  # noqa: BLE001 - CPU backend has no pallas path
+        detail["device_compute_ms_per_batch"] = f"skipped: {e}"
+
+    # -- streaming throughput (HEADLINE)
     t0 = time.perf_counter()
     thunks = [
         K.verify_batch_async(pubs, msgs, sigs, cache=cache)
@@ -88,15 +354,27 @@ def main() -> None:
     t_stream = time.perf_counter() - t0
     assert all(m.all() for m in results)
     tpu_sigs_per_s = STREAM_BATCHES * BATCH / t_stream
+    detail["stream_batches"] = STREAM_BATCHES
 
-    # -- CPU baseline: serial OpenSSL loop on a sample, extrapolated
-    sample = CPU_SAMPLE
-    pk_objs = [ed25519.PubKey(pubs[i]) for i in range(sample)]
+    # -- CPU baselines
+    pk_objs = [ed25519.PubKey(pubs[i]) for i in range(CPU_SAMPLE)]
     t0 = time.perf_counter()
-    for i in range(sample):
+    for i in range(CPU_SAMPLE):
         assert pk_objs[i].verify_signature(msgs[i], sigs[i])
-    t_cpu = time.perf_counter() - t0
-    cpu_sigs_per_s = sample / t_cpu
+    cpu_serial = CPU_SAMPLE / (time.perf_counter() - t0)
+    cpu_batch_pinned = cpu_serial * PINNED_VOI_BATCH_FACTOR
+    detail["cpu_serial_sigs_per_s"] = round(cpu_serial, 1)
+    detail["cpu_batch_pinned_sigs_per_s"] = round(cpu_batch_pinned, 1)
+    detail["vs_serial"] = round(tpu_sigs_per_s / cpu_serial, 2)
+    detail["vs_batch_pinned"] = round(tpu_sigs_per_s / cpu_batch_pinned, 2)
+    detail["vs_batch_note"] = VS_BATCH_NOTE
+
+    # -- subsystem benches (each guarded: a failure reports, not aborts)
+    for fn in (bench_blocksync, bench_light_client, bench_consensus_tpu):
+        try:
+            fn(detail)
+        except Exception as e:  # noqa: BLE001
+            detail[fn.__name__] = f"FAILED: {type(e).__name__}: {e}"
 
     print(
         json.dumps(
@@ -104,16 +382,8 @@ def main() -> None:
                 "metric": "ed25519_verify_throughput",
                 "value": round(tpu_sigs_per_s, 1),
                 "unit": "sigs/sec/chip",
-                "vs_baseline": round(tpu_sigs_per_s / cpu_sigs_per_s, 2),
-                "detail": {
-                    "batch": BATCH,
-                    "stream_batches": STREAM_BATCHES,
-                    "p50_batch_latency_ms": round(p50_latency * 1e3, 2),
-                    "tunnel_note": "single-batch latency includes ~89ms axon-tunnel RTT floor",
-                    "cpu_baseline_sigs_per_s": round(cpu_sigs_per_s, 1),
-                    "cpu_baseline": "serial OpenSSL, 1 core (this host's only core; no Go toolchain for the reference batch verifier)",
-                    "backend": jax.devices()[0].platform,
-                },
+                "vs_baseline": round(tpu_sigs_per_s / cpu_batch_pinned, 2),
+                "detail": detail,
             }
         )
     )
