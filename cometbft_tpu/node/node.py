@@ -241,6 +241,28 @@ class Node(BaseService):
         self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
         self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
 
+        # ---- pex (node.go:498 createPEXReactorAndAddToSwitch)
+        self.addr_book = None
+        self.pex_reactor = None
+        if config.p2p.pex:
+            from cometbft_tpu.p2p.pex import AddrBook, PEXReactor
+
+            self.addr_book = AddrBook(
+                os.path.join(config.home, config.p2p.addr_book_file),
+                our_id=self.node_key.id(),
+            )
+            for seed in config.p2p.seed_list():
+                from cometbft_tpu.p2p.pex.addrbook import NetAddress
+
+                self.addr_book.add_address(NetAddress.parse(seed))
+            self.pex_reactor = PEXReactor(
+                self.addr_book,
+                max_outbound=config.p2p.max_num_outbound_peers,
+                seed_mode=config.p2p.seed_mode,
+                logger=self.logger.with_fields(module="pex"),
+            )
+            self.switch.add_reactor("PEX", self.pex_reactor)
+
         self.rpc_server = None  # attached on start when rpc.laddr set
 
     # ------------------------------------------------------------ lifecycle

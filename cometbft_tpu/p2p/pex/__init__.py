@@ -1,0 +1,6 @@
+"""Peer exchange (reference: p2p/pex/)."""
+
+from cometbft_tpu.p2p.pex.addrbook import AddrBook, NetAddress
+from cometbft_tpu.p2p.pex.reactor import PEXReactor
+
+__all__ = ["AddrBook", "NetAddress", "PEXReactor"]

@@ -1,0 +1,178 @@
+"""Address book (reference: p2p/pex/addrbook.go).
+
+Known peer addresses bucketed NEW (heard about) vs OLD (connected
+successfully), with attempt/success bookkeeping, biased random selection,
+ban marking, and JSON persistence. The reference's 256/64 hashed bucket
+scheme exists to bound a multi-million-address book under eclipse
+attempts; the same new/old split and selection bias are kept over flat
+dicts — the eclipse-resistant hashing belongs with a DHT-scale book.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NetAddress:
+    """pex/addrbook.go knownAddress + p2p.NetAddress."""
+
+    node_id: str
+    host: str
+    port: int
+    src_id: str = ""
+    attempts: int = 0
+    last_attempt: float = 0.0
+    last_success: float = 0.0
+    banned_until: float = 0.0
+    is_old: bool = False  # graduated to the OLD (tried) set
+
+    @property
+    def addr(self) -> str:
+        return f"{self.node_id}@{self.host}:{self.port}"
+
+    @classmethod
+    def parse(cls, s: str, src_id: str = "") -> "NetAddress":
+        node_id, _, hostport = s.partition("@")
+        host, _, port = hostport.rpartition(":")
+        return cls(node_id=node_id, host=host or "127.0.0.1",
+                   port=int(port), src_id=src_id)
+
+    def is_banned(self, now: float) -> bool:
+        return now < self.banned_until
+
+
+class AddrBook:
+    """pex/addrbook.go:70-640 (flat-bucket variant)."""
+
+    MAX_NEW_ADDRS = 1000
+    MAX_OLD_ADDRS = 500
+    # addrbook.go getSelection: up to 23% of the book, capped
+    SELECT_PCT = 23
+    MAX_SELECTION = 250
+
+    def __init__(self, file_path: str = "", our_id: str = ""):
+        self.file_path = file_path
+        self.our_id = our_id
+        self._addrs: dict[str, NetAddress] = {}
+        self._rng = random.Random()
+        if file_path and os.path.exists(file_path):
+            self._load()
+
+    # ------------------------------------------------------------- intake
+
+    def add_address(self, addr: NetAddress) -> bool:
+        """addrbook.go:178 AddAddress: new addresses land in NEW."""
+        if not addr.node_id or addr.node_id == self.our_id:
+            return False
+        existing = self._addrs.get(addr.node_id)
+        if existing is not None:
+            # keep the stronger record; refresh the routable address
+            existing.host, existing.port = addr.host, addr.port
+            return False
+        new_count = sum(1 for a in self._addrs.values() if not a.is_old)
+        if new_count >= self.MAX_NEW_ADDRS:
+            self._evict_worst_new()
+        self._addrs[addr.node_id] = addr
+        return True
+
+    def _evict_worst_new(self) -> None:
+        new = [a for a in self._addrs.values() if not a.is_old]
+        if not new:
+            return
+        worst = max(new, key=lambda a: (a.attempts, -a.last_attempt))
+        self._addrs.pop(worst.node_id, None)
+
+    # ----------------------------------------------------------- lifecycle
+
+    def mark_attempt(self, node_id: str) -> None:
+        a = self._addrs.get(node_id)
+        if a is not None:
+            a.attempts += 1
+            a.last_attempt = time.time()
+
+    def mark_good(self, node_id: str) -> None:
+        """addrbook.go MarkGood: graduate to OLD, reset attempts."""
+        a = self._addrs.get(node_id)
+        if a is not None:
+            a.attempts = 0
+            a.last_success = time.time()
+            old_count = sum(1 for x in self._addrs.values() if x.is_old)
+            if not a.is_old and old_count < self.MAX_OLD_ADDRS:
+                a.is_old = True
+
+    def mark_bad(self, node_id: str, ban_seconds: float = 24 * 3600) -> None:
+        a = self._addrs.get(node_id)
+        if a is not None:
+            a.banned_until = time.time() + ban_seconds
+            a.is_old = False
+
+    def remove(self, node_id: str) -> None:
+        self._addrs.pop(node_id, None)
+
+    # ----------------------------------------------------------- selection
+
+    def pick_address(self, new_bias_pct: int = 50) -> NetAddress | None:
+        """addrbook.go:260 PickAddress: choose OLD vs NEW with the given
+        bias, then uniformly within the chosen set."""
+        now = time.time()
+        usable = [a for a in self._addrs.values() if not a.is_banned(now)]
+        if not usable:
+            return None
+        old = [a for a in usable if a.is_old]
+        new = [a for a in usable if not a.is_old]
+        pick_new = self._rng.randrange(100) < new_bias_pct
+        pool = new if (pick_new and new) or not old else old
+        return self._rng.choice(pool)
+
+    def selection(self) -> list[NetAddress]:
+        """addrbook.go:315 GetSelection: a random ~23% sample (capped) for
+        answering a PEX request."""
+        now = time.time()
+        usable = [a for a in self._addrs.values() if not a.is_banned(now)]
+        n = min(self.MAX_SELECTION,
+                max(1, len(usable) * self.SELECT_PCT // 100)) if usable else 0
+        return self._rng.sample(usable, min(n, len(usable)))
+
+    def is_empty(self) -> bool:
+        return not self._addrs
+
+    def has(self, node_id: str) -> bool:
+        return node_id in self._addrs
+
+    def size(self) -> int:
+        return len(self._addrs)
+
+    # ---------------------------------------------------------- persistence
+
+    def save(self) -> None:
+        if not self.file_path:
+            return
+        doc = [
+            {"id": a.node_id, "host": a.host, "port": a.port,
+             "src": a.src_id, "attempts": a.attempts,
+             "last_success": a.last_success, "old": a.is_old,
+             "banned_until": a.banned_until}
+            for a in self._addrs.values()
+        ]
+        tmp = self.file_path + ".tmp"
+        os.makedirs(os.path.dirname(self.file_path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.file_path)
+
+    def _load(self) -> None:
+        with open(self.file_path) as f:
+            doc = json.load(f)
+        for d in doc:
+            self._addrs[d["id"]] = NetAddress(
+                node_id=d["id"], host=d["host"], port=d["port"],
+                src_id=d.get("src", ""), attempts=d.get("attempts", 0),
+                last_success=d.get("last_success", 0.0),
+                banned_until=d.get("banned_until", 0.0),
+                is_old=d.get("old", False),
+            )

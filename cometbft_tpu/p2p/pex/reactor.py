@@ -1,0 +1,211 @@
+"""PEX reactor (reference: p2p/pex/pex_reactor.go).
+
+Channel 0x00: PexRequest / PexAddrs. Outbound peers get asked for
+addresses on connect; inbound requests are rate-limited per peer and
+answered with a random book selection. An ensure-peers routine dials from
+the address book (biased toward NEW addresses while the node is young)
+until max_outbound is reached. Seed mode answers requests and disconnects
+(crawler behavior) — pex_reactor.go:54-70.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.p2p.base_reactor import Envelope, Reactor
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.p2p.pex.addrbook import AddrBook, NetAddress
+from cometbft_tpu.utils import protobuf as pb
+
+PEX_CHANNEL = 0x00
+ENSURE_PEERS_INTERVAL = 30.0  # pex_reactor.go:33
+MIN_REQUEST_INTERVAL = 10.0   # per-peer request rate limit
+
+
+def encode_request() -> bytes:
+    w = pb.Writer()
+    w.message(1, b"", always=True)
+    return w.output()
+
+
+def encode_addrs(addrs: list[NetAddress]) -> bytes:
+    inner = pb.Writer()
+    for a in addrs:
+        aw = pb.Writer()
+        aw.string(1, a.node_id)
+        aw.string(2, a.host)
+        aw.uvarint(3, a.port)
+        inner.message(1, aw.output(), always=True)
+    w = pb.Writer()
+    w.message(2, inner.output(), always=True)
+    return w.output()
+
+
+def decode(data: bytes):
+    """-> ('request', None) | ('addrs', [NetAddress])."""
+    r = pb.Reader(data)
+    f, wt = r.read_tag()
+    if f == 1:
+        return "request", None
+    if f == 2:
+        out: list[NetAddress] = []
+        ir = pb.Reader(r.read_bytes())
+        while not ir.at_end():
+            jf, jw = ir.read_tag()
+            if jf != 1:
+                ir.skip(jw)
+                continue
+            ar = pb.Reader(ir.read_bytes())
+            node_id, host, port = "", "", 0
+            while not ar.at_end():
+                kf, kw = ar.read_tag()
+                if kf == 1:
+                    node_id = ar.read_string()
+                elif kf == 2:
+                    host = ar.read_string()
+                elif kf == 3:
+                    port = ar.read_uvarint()
+                else:
+                    ar.skip(kw)
+            if node_id:
+                out.append(NetAddress(node_id=node_id, host=host, port=port))
+        return "addrs", out
+    raise ValueError(f"unknown pex message field {f}")
+
+
+class PEXReactor(Reactor):
+    """pex_reactor.go:75-520."""
+
+    def __init__(self, book: AddrBook, max_outbound: int = 10,
+                 seed_mode: bool = False,
+                 ensure_interval: float = ENSURE_PEERS_INTERVAL,
+                 logger: cmtlog.Logger | None = None):
+        super().__init__("PEXReactor", logger)
+        self.book = book
+        self.max_outbound = max_outbound
+        self.seed_mode = seed_mode
+        self.ensure_interval = ensure_interval
+        self._last_request: dict[str, float] = {}
+        self._requested: set[str] = set()
+        self._task: asyncio.Task | None = None
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(id=PEX_CHANNEL, priority=1,
+                                  send_queue_capacity=10)]
+
+    async def on_start(self) -> None:
+        self._task = asyncio.create_task(self._ensure_peers_routine())
+
+    async def on_stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        self.book.save()
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def add_peer(self, peer) -> None:
+        """pex_reactor.go:145-165: learn the peer's self-address; ask
+        outbound peers for more."""
+        addr = self._peer_net_address(peer)
+        if addr is not None:
+            self.book.add_address(addr)
+            self.book.mark_good(peer.id)
+        if peer.outbound and not self.seed_mode:
+            await self._request_addrs(peer)
+
+    async def remove_peer(self, peer, reason) -> None:
+        self._last_request.pop(peer.id, None)
+        self._requested.discard(peer.id)
+
+    def _peer_net_address(self, peer) -> NetAddress | None:
+        listen = getattr(peer.node_info, "listen_addr", "")
+        if not listen:
+            return None
+        try:
+            na = NetAddress.parse(f"{peer.id}@{listen.removeprefix('tcp://')}")
+            return na
+        except (ValueError, TypeError):
+            return None
+
+    # -------------------------------------------------------------- wire
+
+    async def _request_addrs(self, peer) -> None:
+        self._requested.add(peer.id)
+        await peer.send(PEX_CHANNEL, encode_request())
+
+    async def receive(self, e: Envelope) -> None:
+        try:
+            kind, payload = decode(e.message)
+        except Exception as err:  # noqa: BLE001
+            self.logger.error("bad pex message", err=str(err))
+            return
+        peer = e.src
+        if kind == "request":
+            # rate limit (pex_reactor.go:230 receiveRequest)
+            now = time.time()
+            last = self._last_request.get(peer.id, 0.0)
+            if now - last < MIN_REQUEST_INTERVAL:
+                self.logger.info("pex request too soon; disconnecting",
+                                 peer=peer.id)
+                if self.switch is not None:
+                    await self.switch.stop_peer_for_error(peer, "pex flood")
+                return
+            self._last_request[peer.id] = now
+            await peer.send(PEX_CHANNEL, encode_addrs(self.book.selection()))
+            if self.seed_mode and self.switch is not None:
+                # seed: serve and hang up (pex_reactor.go:205)
+                await self.switch.stop_peer_for_error(peer, "seed served")
+        else:  # addrs
+            if peer.id not in self._requested:
+                # unsolicited PexAddrs is protocol abuse (pex_reactor.go:260)
+                if self.switch is not None:
+                    await self.switch.stop_peer_for_error(
+                        peer, "unsolicited pex addrs")
+                return
+            self._requested.discard(peer.id)
+            for a in payload or []:
+                a.src_id = peer.id
+                self.book.add_address(a)
+
+    # ------------------------------------------------------------- dialing
+
+    async def _ensure_peers_routine(self) -> None:
+        """pex_reactor.go:300 ensurePeersRoutine."""
+        while True:
+            try:
+                await self._ensure_peers()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                self.logger.error("ensure peers failed", err=str(e))
+            await asyncio.sleep(self.ensure_interval)
+
+    async def _ensure_peers(self) -> None:
+        if self.switch is None:
+            return
+        out_count = sum(1 for p in self.switch.peers.values() if p.outbound)
+        needed = self.max_outbound - out_count
+        if needed <= 0:
+            return
+        # young nodes bias toward NEW addresses (pex_reactor.go:330)
+        bias = max(30, 100 - 10 * len(self.switch.peers))
+        dialed = 0
+        tried: set[str] = set()
+        while dialed < needed:
+            addr = self.book.pick_address(new_bias_pct=bias)
+            if addr is None or addr.node_id in tried:
+                break
+            tried.add(addr.node_id)
+            if addr.node_id in self.switch.peers or addr.node_id == self.book.our_id:
+                continue
+            self.book.mark_attempt(addr.node_id)
+            await self.switch.dial_peers_async([addr.addr])
+            dialed += 1
+        # still thin: ask a random existing peer for more addresses
+        if self.book.size() < self.max_outbound and self.switch.peers:
+            import random
+
+            peer = random.choice(list(self.switch.peers.values()))
+            await self._request_addrs(peer)

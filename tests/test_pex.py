@@ -1,0 +1,139 @@
+"""Peer exchange: address book semantics (new/old graduation, selection,
+bans, persistence) and peer discovery over real sockets — a node that only
+knows one peer learns and dials a third through PEX (reference:
+p2p/pex/addrbook_test.go, pex_reactor_test.go)."""
+
+import asyncio
+import time
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.p2p.key import NodeKey
+from cometbft_tpu.p2p.node_info import NodeInfo
+from cometbft_tpu.p2p.pex import AddrBook, NetAddress, PEXReactor
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.p2p.transport import Transport
+
+
+class TestAddrBook:
+    def test_add_pick_and_graduation(self):
+        book = AddrBook(our_id="me")
+        for i in range(20):
+            book.add_address(NetAddress(node_id=f"n{i}", host="127.0.0.1", port=1000 + i))
+        assert book.size() == 20
+        assert not book.add_address(NetAddress(node_id="me", host="x", port=1))
+        picked = book.pick_address()
+        assert picked is not None and picked.node_id.startswith("n")
+        book.mark_good("n3")
+        assert book._addrs["n3"].is_old
+        # old-biased pick can return the graduated address
+        assert any(book.pick_address(new_bias_pct=0).node_id == "n3"
+                   for _ in range(50))
+
+    def test_ban_and_selection(self):
+        book = AddrBook(our_id="me")
+        for i in range(10):
+            book.add_address(NetAddress(node_id=f"n{i}", host="h", port=i + 1))
+        book.mark_bad("n0", ban_seconds=3600)
+        assert all(a.node_id != "n0" for a in book.selection())
+        assert book._addrs["n0"].is_banned(time.time())
+        sel = book.selection()
+        assert 1 <= len(sel) <= book.MAX_SELECTION
+
+    def test_persistence_roundtrip(self, tmp_path):
+        path = str(tmp_path / "addrbook.json")
+        book = AddrBook(path, our_id="me")
+        book.add_address(NetAddress(node_id="n1", host="10.0.0.1", port=26656))
+        book.mark_good("n1")
+        book.save()
+        book2 = AddrBook(path, our_id="me")
+        assert book2.has("n1") and book2._addrs["n1"].is_old
+        assert book2._addrs["n1"].addr == "n1@10.0.0.1:26656"
+
+
+def _make_node(moniker: str, max_outbound=10, ensure_interval=0.2):
+    nk = NodeKey(ed25519.gen_priv_key())
+    info = NodeInfo(node_id=nk.id(), network="pex-chain", version="dev",
+                    moniker=moniker, channels=bytes([0x00]))
+    transport = Transport(nk, info, logger=cmtlog.nop())
+    switch = Switch(transport, logger=cmtlog.nop())
+    book = AddrBook(our_id=nk.id())
+    pex = PEXReactor(book, max_outbound=max_outbound,
+                     ensure_interval=ensure_interval, logger=cmtlog.nop())
+    switch.add_reactor("PEX", pex)
+    return nk, info, transport, switch, book, pex
+
+
+async def _wait(cond, timeout=10.0):
+    async def poll():
+        while not cond():
+            await asyncio.sleep(0.05)
+
+    await asyncio.wait_for(poll(), timeout)
+
+
+class TestPEXDiscovery:
+    def test_third_peer_discovered_via_pex(self):
+        """C knows only B; A is connected to B. C must learn A's address
+        through a PEX exchange with B and dial it."""
+
+        async def main():
+            nodes = [_make_node(m, ensure_interval=0.2) for m in ("A", "B", "C")]
+            (nkA, infoA, tA, sA, bookA, _) = nodes[0]
+            (nkB, infoB, tB, sB, bookB, _) = nodes[1]
+            (nkC, infoC, tC, sC, bookC, _) = nodes[2]
+            addrA = await tA.listen("127.0.0.1:0")
+            addrB = await tB.listen("127.0.0.1:0")
+            infoA.listen_addr = addrA
+            infoB.listen_addr = addrB
+            try:
+                await sA.start()
+                await sB.start()
+                # A dials B: B's book learns A via its self-reported
+                # listen addr; A marks B good
+                await sA.dial_peers_async([f"{nkB.id()}@{addrB}"])
+                await _wait(lambda: sA.n_peers() == 1 and sB.n_peers() == 1)
+                await _wait(lambda: bookB.has(nkA.id()))
+
+                # C knows only B
+                bookC.add_address(NetAddress.parse(f"{nkB.id()}@{addrB}"))
+                await sC.start()
+                # ensure-peers dials B; on connect C requests addrs and
+                # learns A; next ensure round dials A
+                await _wait(lambda: sC.n_peers() >= 2, timeout=15)
+                assert nkA.id() in sC.peers and nkB.id() in sC.peers
+                assert bookC.has(nkA.id())
+            finally:
+                await sA.stop()
+                await sB.stop()
+                await sC.stop()
+
+        asyncio.run(main())
+
+    def test_unsolicited_addrs_disconnects(self):
+        """A peer pushing PexAddrs without a request is dropped."""
+
+        async def main():
+            from cometbft_tpu.p2p.pex import reactor as pexmod
+
+            (nkA, infoA, tA, sA, bookA, pexA) = _make_node("A", ensure_interval=999)
+            (nkB, infoB, tB, sB, bookB, pexB) = _make_node("B", ensure_interval=999)
+            addrA = await tA.listen("127.0.0.1:0")
+            infoA.listen_addr = addrA
+            try:
+                await sA.start()
+                await sB.start()
+                await sB.dial_peers_async([f"{nkA.id()}@{addrA}"])
+                await _wait(lambda: sB.n_peers() == 1 and sA.n_peers() == 1)
+                # B pushes addrs A never asked for (B is inbound at A, so
+                # A did not request)
+                peer = next(iter(sB.peers.values()))
+                await peer.send(pexmod.PEX_CHANNEL, pexmod.encode_addrs(
+                    [NetAddress(node_id="x" * 40, host="10.0.0.9", port=1)]))
+                await _wait(lambda: sA.n_peers() == 0, timeout=10)
+            finally:
+                await sA.stop()
+                await sB.stop()
+
+        asyncio.run(main())
+
