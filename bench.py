@@ -162,7 +162,9 @@ def bench_light_client(detail: dict) -> None:
     REPLACE_FRAC = 0.5  # half the set changes per version: forces pivots
     base_time = cmttime.now().seconds - LC_HEIGHT - 1000
 
-    pool = [ed25519.gen_priv_key() for _ in range(LC_VALS * 5)]
+    # pool must not wrap across the 8 valset versions, or a distant version
+    # aliases the trusted one and bisection degenerates to a single jump
+    pool = [ed25519.gen_priv_key() for _ in range(LC_VALS * 8)]
 
     class LazyChain(Provider):
         def __init__(self):

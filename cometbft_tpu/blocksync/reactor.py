@@ -76,19 +76,37 @@ class BlocksyncReactor(Reactor):
 
     async def on_start(self) -> None:
         if self.active:
-            if self.state is None:
-                raise RuntimeError("BlocksyncReactor.set_state before start")
-            self.pool = BlockPool(
-                self.state.last_block_height + 1 if self.state.last_block_height
-                else self.state.initial_height,
-                self._send_block_request,
-                self._on_pool_peer_error,
-                logger=self.logger,
-            )
-            await self.pool.start()
-            self._tasks.spawn(self._pool_routine(), name="bcs-pool")
-            self._status_task = self._tasks.spawn(
-                self._status_broadcast_routine(), name="bcs-status")
+            await self._start_sync()
+
+    async def _start_sync(self) -> None:
+        if self.state is None:
+            raise RuntimeError("BlocksyncReactor.set_state before start")
+        self.pool = BlockPool(
+            self.state.last_block_height + 1 if self.state.last_block_height
+            else self.state.initial_height,
+            self._send_block_request,
+            self._on_pool_peer_error,
+            logger=self.logger,
+        )
+        await self.pool.start()
+        self._tasks.spawn(self._pool_routine(), name="bcs-pool")
+        self._status_task = self._tasks.spawn(
+            self._status_broadcast_routine(), name="bcs-status")
+
+    async def activate(self, state) -> None:
+        """Start syncing AFTER boot — the statesync handoff (node.go
+        stateSync -> blockSync switch): the pool begins at the restored
+        state's height + 1."""
+        if self.active:
+            return
+        self.active = True
+        self.state = state
+        await self._start_sync()
+        # peers that connected while we were state-syncing: ask for their
+        # ranges right away (the broadcast routine also fires immediately,
+        # this is belt-and-braces for a changed interval)
+        if self.switch is not None:
+            self.switch.broadcast(BLOCKSYNC_CHANNEL, bm.encode(bm.StatusRequest()))
 
     async def on_stop(self) -> None:
         await self._tasks.cancel_all()

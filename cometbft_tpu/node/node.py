@@ -196,17 +196,61 @@ class Node(BaseService):
         self.blocksync_active = config.block_sync.enable and not _only_validator_is_us(
             state, self.priv_validator.get_pub_key()
         )
+        # statesync bootstrap: only a node with no committed state
+        # (node.go:559 stateSync && state height == 0)
+        self.statesync_active = (
+            config.state_sync.enable and state.last_block_height == 0
+        )
         self.consensus_reactor = ConsensusReactor(
             self.consensus_state,
-            wait_sync=self.blocksync_active,
+            wait_sync=self.blocksync_active or self.statesync_active,
             logger=self.logger.with_fields(module="cons-reactor"),
         )
         self.blocksync_reactor = BlocksyncReactor(
             self.block_exec,
             self.block_store,
-            active=self.blocksync_active,
+            # with statesync the pool must start at the restored height:
+            # blocksync activates in the statesync handoff instead of boot
+            active=self.blocksync_active and not self.statesync_active,
             consensus_reactor=self.consensus_reactor,
             logger=self.logger.with_fields(module="blocksync"),
+        )
+        # Every node SERVES snapshots on the statesync channels (reference:
+        # the reactor always registers, node.go:374); only a fresh node with
+        # statesync.enable also SYNCS (state provider + syncer attached).
+        from cometbft_tpu.statesync import LightClientStateProvider, StatesyncReactor
+
+        state_provider = None
+        if config.state_sync.enable and self.statesync_active:
+            from cometbft_tpu.light import Client as LightClient
+            from cometbft_tpu.light import TrustOptions
+            from cometbft_tpu.light.rpc_provider import RPCProvider
+            from cometbft_tpu.light.store import LightStore
+            from cometbft_tpu.store.db import MemDB
+
+            ss = config.state_sync
+            providers = [
+                RPCProvider(genesis_doc.chain_id, url) for url in ss.rpc_servers
+            ]
+            lc = LightClient(
+                genesis_doc.chain_id,
+                TrustOptions(
+                    period_ns=int(ss.trust_period * 1e9),
+                    height=ss.trust_height,
+                    hash_=bytes.fromhex(ss.trust_hash),
+                ),
+                providers[0], providers[1:], LightStore(MemDB()),
+                logger=self.logger.with_fields(module="light"),
+            )
+            self._statesync_light_client = lc
+            state_provider = LightClientStateProvider(
+                lc, initial_height=state.initial_height,
+                consensus_params=state.consensus_params,
+            )
+        self.statesync_reactor = StatesyncReactor(
+            None,  # snapshot conn wired at start (proxy conns live then)
+            state_provider=state_provider,
+            logger=self.logger.with_fields(module="statesync"),
         )
         self.mempool_reactor = MempoolReactor(
             self.mempool, logger=self.logger.with_fields(module="mempool"))
@@ -240,6 +284,7 @@ class Node(BaseService):
         self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
         self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
         self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
+        self.switch.add_reactor("STATESYNC", self.statesync_reactor)
 
         # ---- pex (node.go:498 createPEXReactorAndAddToSwitch)
         self.addr_book = None
@@ -301,6 +346,11 @@ class Node(BaseService):
         self.consensus_state.sync_to_state(state)
         self.blocksync_reactor.set_state(self.consensus_state.state)
 
+        # the statesync reactor needs the live snapshot connection
+        self.statesync_reactor.conn = self.proxy_app.snapshot
+        if self.statesync_reactor.syncer is not None:
+            self.statesync_reactor.syncer.conn = self.proxy_app.snapshot
+
         addr = await self.transport.listen(_strip_tcp(self.config.p2p.laddr))
         self.node_info.listen_addr = addr
         await self.switch.start()
@@ -308,13 +358,47 @@ class Node(BaseService):
         if peers:
             await self.switch.dial_peers_async(peers, persistent=True)
 
+        # statesync bootstrap (node.go:559 startStateSync): restore a
+        # snapshot anchored in light-client-verified headers, then hand off
+        # to blocksync starting at the restored height + 1
+        if self.statesync_active and self.statesync_reactor.syncer is not None:
+            import asyncio as _asyncio
+
+            self._statesync_task = _asyncio.create_task(self._run_statesync())
+
         if self.config.rpc.laddr:
             from cometbft_tpu.rpc.server import RPCServer
 
             self.rpc_server = RPCServer(self, self.config.rpc)
             await self.rpc_server.start()
 
+    async def _run_statesync(self) -> None:
+        """node.go startStateSync: sync, persist, hand off to blocksync."""
+        try:
+            state, commit = await self.statesync_reactor.sync(
+                discovery_time=self.config.state_sync.discovery_time)
+            self.state_store.bootstrap(state)
+            # the light-client-verified commit seeds LastCommit
+            # reconstruction (node.go startStateSync SaveSeenCommit)
+            self.block_store.save_seen_commit(state.last_block_height, commit)
+            self.consensus_state.sync_to_state(state)
+            self.logger.info("state sync complete; switching to block sync",
+                             height=state.last_block_height,
+                             app_hash=state.app_hash.hex()[:12])
+            await self.blocksync_reactor.activate(state)
+        except Exception as e:  # noqa: BLE001 - bootstrap failed: stay put
+            import traceback
+
+            self.logger.error("state sync failed", err=str(e),
+                              tb=traceback.format_exc(limit=5).replace("\n", " | "))
+        finally:
+            # stop soliciting snapshots: the sync ran once (ref clears the
+            # syncer when the sync ends); serving continues
+            self.statesync_reactor.syncer = None
+
     async def on_stop(self) -> None:
+        if getattr(self, "_statesync_task", None) is not None:
+            self._statesync_task.cancel()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
         await self.switch.stop()

@@ -196,23 +196,87 @@ class Environment:
         return {"last_height": str(top), "block_metas": metas}
 
     async def commit(self, params: dict) -> dict:
+        """rpc/core/blocks.go Commit: the COMPLETE signed header — every
+        header field and every commit signature — so a light client can
+        verify it (lossless, unlike a summary view)."""
         height = self._height_param(params, self.node.block_store.height())
         commit = self.node.block_store.load_block_commit(height)
         meta = self.node.block_store.load_block_meta(height)
         if commit is None or meta is None:
             raise RPCError(-32603, f"commit at height {height} not found")
+        h = meta.header
         return {
             "canonical": True,
             "signed_header": {
-                "header": {"height": str(meta.header.height),
-                           "app_hash": _hex(meta.header.app_hash)},
+                "header": {
+                    "version": {"block": str(h.version.block), "app": str(h.version.app)},
+                    "chain_id": h.chain_id,
+                    "height": str(h.height),
+                    "time": str(h.time),
+                    "last_block_id": {
+                        "hash": _hex(h.last_block_id.hash),
+                        "parts": {"total": h.last_block_id.part_set_header.total,
+                                  "hash": _hex(h.last_block_id.part_set_header.hash)},
+                    },
+                    "last_commit_hash": _hex(h.last_commit_hash),
+                    "data_hash": _hex(h.data_hash),
+                    "validators_hash": _hex(h.validators_hash),
+                    "next_validators_hash": _hex(h.next_validators_hash),
+                    "consensus_hash": _hex(h.consensus_hash),
+                    "app_hash": _hex(h.app_hash),
+                    "last_results_hash": _hex(h.last_results_hash),
+                    "evidence_hash": _hex(h.evidence_hash),
+                    "proposer_address": _hex(h.proposer_address),
+                },
                 "commit": {
                     "height": str(commit.height),
                     "round": commit.round_,
-                    "block_id": {"hash": _hex(commit.block_id.hash)},
+                    "block_id": {
+                        "hash": _hex(commit.block_id.hash),
+                        "parts": {"total": commit.block_id.part_set_header.total,
+                                  "hash": _hex(commit.block_id.part_set_header.hash)},
+                    },
+                    "signatures": [
+                        {
+                            "block_id_flag": int(cs.block_id_flag),
+                            "validator_address": _hex(cs.validator_address),
+                            "timestamp": str(cs.timestamp),
+                            "signature": _b64(cs.signature),
+                        }
+                        for cs in commit.signatures
+                    ],
                 },
             },
         }
+
+    async def light_block(self, params: dict) -> dict:
+        """Framework extension: the wire-exact LightBlock proto (base64) at
+        a height — SignedHeader from the stores + the valset whose hash the
+        header carries. The RPC light provider (light/rpc_provider.py) and
+        statesync bootstrap consume this; a JSON rebuild of a commit can
+        never be trusted to be byte-exact, the proto is."""
+        top = self.node.block_store.height()
+        try:
+            height = self._height_param(params, top)
+        except RPCError as e:
+            raise RPCError(-32001, str(e)) from e  # out of range = no material
+        meta = self.node.block_store.load_block_meta(height)
+        # canonical commit lands with block height+1; the head falls back to
+        # the seen commit (rpc/core/blocks.go Commit canonical=false)
+        commit = (self.node.block_store.load_block_commit(height)
+                  or self.node.block_store.load_seen_commit(height))
+        vals = self.node.state_store.load_validators(height)
+        if meta is None or commit is None or vals is None:
+            # -32001: no block material at this height (distinct code so the
+            # RPC light provider classifies without parsing message text)
+            raise RPCError(-32001, f"light block at height {height} not available")
+        from cometbft_tpu.types.light import LightBlock, SignedHeader
+
+        lb = LightBlock(
+            signed_header=SignedHeader(header=meta.header, commit=commit),
+            validator_set=vals,
+        )
+        return {"height": str(height), "light_block": _b64(lb.to_proto())}
 
     async def validators(self, params: dict) -> dict:
         """rpc/core/consensus.go Validators. Unlike block queries, validator
@@ -477,6 +541,7 @@ class Environment:
             "block_by_hash": self.block_by_hash,
             "blockchain": self.blockchain,
             "commit": self.commit,
+            "light_block": self.light_block,
             "validators": self.validators,
             "consensus_state": self.consensus_state,
             "abci_info": self.abci_info,

@@ -33,22 +33,33 @@ class LightClientStateProvider(StateProvider):
         self.lc = light_client
         self.initial_height = initial_height or 1
         self._consensus_params = consensus_params
+        self._initialized = False
+
+    async def _ensure_init(self) -> None:
+        """Subjective initialization happens on first use — at node boot
+        the trust root's providers may not be reachable yet."""
+        if not self._initialized:
+            await self.lc.initialize()
+            self._initialized = True
 
     async def app_hash(self, height: int) -> bytes:
         """The app hash AFTER `height` commits lives in header height+1;
         also probe height+2 so State() can't fail later
         (stateprovider.go:88-110)."""
+        await self._ensure_init()
         lb = await self.lc.verify_light_block_at_height(height + 1)
         await self.lc.verify_light_block_at_height(height + 2)
         return lb.header.app_hash
 
     async def commit(self, height: int):
+        await self._ensure_init()
         lb = await self.lc.verify_light_block_at_height(height)
         return lb.commit
 
     async def state(self, height: int) -> State:
         """stateprovider.go:124-186: snapshot height h -> last block h,
         current h+1, next h+2 (valset changes at h land at h+2)."""
+        await self._ensure_init()
         last = await self.lc.verify_light_block_at_height(height)
         current = await self.lc.verify_light_block_at_height(height + 1)
         next_ = await self.lc.verify_light_block_at_height(height + 2)

@@ -182,6 +182,11 @@ class BlockStore:
 
     # ------------------------------------------------------------- prune
 
+    def save_seen_commit(self, height: int, commit: Commit) -> None:
+        """store/store.go SaveSeenCommit — the statesync bootstrap hook:
+        consensus reconstructs LastCommit from it at the restored height."""
+        self.db.set(_hkey(b"SC:", height), commit.to_proto())
+
     def delete_latest_block(self) -> None:
         """store/store.go DeleteLatestBlock — the rollback tool's hook."""
         with self._lock:
