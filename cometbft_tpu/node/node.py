@@ -37,7 +37,12 @@ from cometbft_tpu.p2p.node_info import NodeInfo
 from cometbft_tpu.p2p.switch import Switch
 from cometbft_tpu.p2p.transport import Transport
 from cometbft_tpu.privval.file_pv import FilePV
-from cometbft_tpu.proxy import AppConns, local_client_creator, socket_client_creator
+from cometbft_tpu.proxy import (
+    AppConns,
+    grpc_client_creator,
+    local_client_creator,
+    socket_client_creator,
+)
 from cometbft_tpu.state import BlockExecutor, State, StateStore
 from cometbft_tpu.state.txindex import (
     BlockIndexer,
@@ -130,6 +135,8 @@ class Node(BaseService):
         elif config.base.proxy_app == "kvstore":
             app = KVStoreApplication()
             creator = local_client_creator(app)
+        elif config.base.proxy_app.startswith("grpc://"):
+            creator = grpc_client_creator(config.base.proxy_app)
         elif config.base.proxy_app.startswith("tcp://") or config.base.proxy_app.startswith("unix://"):
             creator = socket_client_creator(config.base.proxy_app)
         else:

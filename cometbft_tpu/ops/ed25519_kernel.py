@@ -358,6 +358,6 @@ def _xfer_pool():
         import concurrent.futures
 
         _pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=3, thread_name_prefix="ed25519-xfer"
+            max_workers=4, thread_name_prefix="ed25519-xfer"
         )
     return _pool

@@ -9,6 +9,7 @@ socket creators open 4 sockets.
 from cometbft_tpu.proxy.app_conns import (  # noqa: F401
     AppConns,
     ClientCreator,
+    grpc_client_creator,
     local_client_creator,
     socket_client_creator,
 )

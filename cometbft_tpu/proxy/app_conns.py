@@ -29,6 +29,16 @@ def socket_client_creator(addr: str) -> ClientCreator:
     return lambda: SocketClient(addr)
 
 
+def grpc_client_creator(addr: str) -> ClientCreator:
+    """proxy/client.go NewRemoteClientCreator with transport=grpc."""
+    def make():
+        from cometbft_tpu.abci.grpc import GRPCClient
+
+        return GRPCClient(addr)
+
+    return make
+
+
 class AppConns(BaseService):
     """Owns the 4 logical connections (consensus/mempool/query/snapshot)."""
 

@@ -114,3 +114,52 @@ def test_socket_parallel_connections(tmp_path):
             await server.stop()
 
     run(main())
+
+
+class TestGRPCTransport:
+    """ABCI over gRPC (reference: abci/client/grpc_client.go + grpc server):
+    a kvstore served over a real gRPC port, driven through the proxy's
+    4-connection facade."""
+
+    def test_grpc_roundtrip_and_proxy(self):
+        import asyncio
+
+        from cometbft_tpu.abci.grpc import GRPCClient, serve_grpc
+        from cometbft_tpu.abci.kvstore import KVStoreApplication
+        from cometbft_tpu.proxy import AppConns, grpc_client_creator
+
+        app = KVStoreApplication()
+        server, bound = serve_grpc(app, "127.0.0.1:0")
+        try:
+            async def main():
+                client = GRPCClient(bound)
+                echo = await client.echo("grpc-hello")
+                assert echo.message == "grpc-hello"
+                info = await client.info(abci.RequestInfo())
+                assert info.last_block_height == 0
+                res = await client.check_tx(
+                    abci.RequestCheckTx(tx=b"gk=gv", type_=abci.CheckTxType.NEW))
+                assert res.is_ok()
+                fin = await client.finalize_block(
+                    abci.RequestFinalizeBlock(txs=[b"gk=gv"], height=1))
+                assert fin.tx_results[0].is_ok()
+                await client.commit(abci.RequestCommit())
+                q = await client.query(abci.RequestQuery(data=b"gk"))
+                assert q.value == b"gv"
+                await client.close()
+
+                # the proxy facade over grpc: 4 independent channels
+                conns = AppConns(grpc_client_creator(bound))
+                await conns.start()
+                try:
+                    info = await conns.query.info(abci.RequestInfo())
+                    assert info.last_block_height == 1
+                    snap = await conns.snapshot.list_snapshots(
+                        abci.RequestListSnapshots())
+                    assert snap.snapshots == []
+                finally:
+                    await conns.stop()
+
+            asyncio.run(main())
+        finally:
+            server.stop(None)
