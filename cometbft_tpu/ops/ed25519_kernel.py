@@ -9,10 +9,10 @@ height, but its keys decompress once):
   verify(A-coords, rW, sW, kW)       -> per-lane validity mask
 
 verify computes, per lane:  [8]([s]B - [k]A - R) == O   (cofactored,
-ZIP-215), via a 4-bit windowed double-scalar ladder (curve.py), one add of
--R, three doublings, and a projective identity test. The mask pinpoints bad
-signatures directly — the reference's fallback-to-serial re-verify
-(types/validation.go:266) has no analog here.
+ZIP-215), via a signed 5-bit windowed double-scalar ladder (curve.py), one
+add of -R, three doublings, and a projective identity test. The mask
+pinpoints bad signatures directly — the reference's fallback-to-serial
+re-verify (types/validation.go:266) has no analog here.
 
 Wire layout (the perf-critical design point): R / s / k cross the host link
 as packed (8, B) uint32 words — 96 B per signature — and are unpacked to
