@@ -258,6 +258,7 @@ class Node(BaseService):
             None,  # snapshot conn wired at start (proxy conns live then)
             state_provider=state_provider,
             logger=self.logger.with_fields(module="statesync"),
+            chunk_timeout=config.state_sync.chunk_request_timeout,
         )
         self.mempool_reactor = MempoolReactor(
             self.mempool, logger=self.logger.with_fields(module="mempool"))

@@ -26,14 +26,15 @@ class StatesyncReactor(Reactor):
     """reactor.go:38-280."""
 
     def __init__(self, snapshot_conn, state_provider=None,
-                 logger: cmtlog.Logger | None = None):
+                 logger: cmtlog.Logger | None = None,
+                 chunk_timeout: float = 15.0):
         super().__init__("StatesyncReactor", logger)
         self.conn = snapshot_conn
         self.syncer: Optional[Syncer] = None
         if state_provider is not None:
             self.syncer = Syncer(
                 state_provider, snapshot_conn, self._request_chunk,
-                logger=self.logger,
+                logger=self.logger, chunk_timeout=chunk_timeout,
             )
 
     def get_channels(self) -> list[ChannelDescriptor]:
