@@ -175,13 +175,14 @@ def decompress_zip215(y_limbs: jnp.ndarray, sign: jnp.ndarray) -> tuple[jnp.ndar
 
 
 # ---------------------------------------------------------------------------
-# Signed 5-bit ladder: 52 windows x (5 doublings + 2 adds) with digits in
+# Signed 5-bit ladder: 51 windows x (5 doublings + 2 adds) with digits in
 # [-16, 15] (ops.unpack.words_to_digits5_signed). vs the 4-bit ladder's
 # 64 x (4 dbl + 2 add):
-#   - 260 doublings -> 260, but 4 of every 5 skip the T mul (double_no_t)
-#   - 128 adds -> 104, the base half mixed (madd: Z=1) and all adds one
-#     mul cheaper via premultiplied table T (add_pre/madd_pre)
-#   - per-signature field muls: ~3226 -> ~2606 (-19%)
+#   - 255 doublings, 4 of every 5 skipping the T mul (double_no_t)
+#   - 102 adds, the base half mixed (madd: Z=1) and all adds one mul
+#     cheaper via premultiplied table T (add_pre/madd_pre); the A-add skips
+#     its T output on every window but the last (only the final add(-R)
+#     reads it)
 # Negative digits select the negated entry lane-locally (x, t sign flip) —
 # table stays 17 entries, so VMEM footprint is ~equal to the 16-entry
 # unsigned table.
@@ -246,32 +247,41 @@ def _select17_signed(table: tuple[jnp.ndarray, ...], digit: jnp.ndarray) -> Poin
     return Point(x, y, z, t)
 
 
+def window_step(
+    acc: Point, ds: jnp.ndarray, dk: jnp.ndarray, table_b, table_a,
+    out_t: bool,
+) -> Point:
+    """One ladder window: 5 doublings (4 skipping T) + base madd + A add.
+    The base add goes first (mixed, produces the T the A add consumes);
+    out_t=False elides the A-add's T mul — legal on every window except the
+    last, because the next window re-derives T in its final double()."""
+    for _ in range(4):
+        acc = double_no_t(acc)
+    acc = double(acc)
+    acc = madd_pre(acc, _select17_signed(table_b, ds), out_t=True)
+    return add_pre(acc, _select17_signed(table_a, dk), out_t=out_t)
+
+
 def windowed_double_scalar_signed(
     s_digits: jnp.ndarray, k_digits: jnp.ndarray, a: Point
 ) -> Point:
-    """[s]B + [k]A, signed 5-bit windows. s_digits/k_digits: (52, B) int32
+    """[s]B + [k]A, signed 5-bit windows. s_digits/k_digits: (51, B) int32
     in [-16, 15], little-endian (ops.unpack.words_to_digits5_signed)."""
     table_a = build_point_table17(a)
     bx = jnp.zeros_like(a.x)
     table_b = tuple(c + bx[None] for c in _BASE_TABLE17)
 
-    sd = s_digits[::-1]
-    kd = k_digits[::-1]
+    sd = s_digits[::-1][:-1]  # MSB-first, final (LSB) window handled below
+    kd = k_digits[::-1][:-1]
 
     def body(acc: Point, digs):
         ds, dk = digs
-        for _ in range(4):
-            acc = double_no_t(acc)
-        acc = double(acc)
-        # base add first (mixed, produces T for the A add); the A add keeps
-        # T so the loop body is uniform (one traced window — the caller's
-        # final add(-R) reads it)
-        acc = madd_pre(acc, _select17_signed(table_b, ds), out_t=True)
-        acc = add_pre(acc, _select17_signed(table_a, dk), out_t=True)
-        return acc, None
+        return window_step(acc, ds, dk, table_b, table_a, out_t=False), None
 
     zero = jnp.zeros_like(a.x)
     one = zero + F.ONE
     init = Point(zero, one, one, zero)
     acc, _ = jax.lax.scan(body, init, (sd, kd))
-    return acc
+    return window_step(
+        acc, s_digits[0], k_digits[0], table_b, table_a, out_t=True
+    )

@@ -7,13 +7,16 @@ element); the 20-limb axis sits on sublanes. The transposed layout is worth
 ~6x utilization over (B, 20), where the limb axis would waste 108/128 lanes.
 Chosen so every intermediate of a schoolbook 20x20 limb convolution fits
 signed int32 — the TPU VPU's native integer width (no int64, no widening
-multiply): carried limbs are <= CARRIED_MAX, so each product is < 2^26.3 and
-a 20-term column sum is < 2^31.
+multiply).
 
-Invariant ("carried"): limbs in [0, CARRIED_MAX]. add/sub/mul/sq take and
-return carried values. Values are redundant mod p (anywhere in [0, ~2^260));
-canonicalize() produces the unique representative in [0, p) for comparisons,
-parity checks, and re-compression.
+Invariant ("carried"): per-limb SIGNED intervals — the least fixpoint of
+{mul, sq, add, sub, neg} over their own outputs, computed and proved int32-
+safe by tests/test_field_intervals.py (see the block comment above
+CARRIED_MAX; the naive "every limb small enough for any column sum" bound
+does NOT hold). add/sub/mul/sq take and return carried values. Values are
+redundant mod p (anywhere in [0, ~2^260)); canonicalize() produces the
+unique representative in [0, p) for comparisons, parity checks, and
+re-compression.
 
 Reference seam: this replaces the 64-bit limb arithmetic inside
 curve25519-voi that the Go reference leans on (crypto/ed25519/ed25519.go:37);
@@ -72,10 +75,28 @@ def zeros_like(a: jnp.ndarray) -> jnp.ndarray:
     return jnp.zeros_like(a)
 
 
-# Carried-limb invariant: limbs in [0, CARRIED_MAX]. The parallel carry
-# rounds below converge to this bound (not to a strict 13 bits) — sized so a
-# 20-term product column still fits int32: 20 * 8800^2 = 1.55e9 < 2^31.
-CARRIED_MAX = 8800
+# Carried-limb invariant ("C"): per-limb signed intervals, the least
+# fixpoint of {mul, sq, add, sub, neg} over their own outputs, mechanically
+# verified by tests/test_field_intervals.py, which mirrors every op below in
+# exact interval arithmetic and proves (a) closure, (b) every intermediate —
+# conv columns included — fits int32, (c) the value bound stays under the
+# subtraction bias M = 33p. The fixpoint's shape: limbs 0 and 1 reach ~25.5k
+# (the 2^260 wrap concentrates carry mass there), limbs 2..19 stay ~8.2k —
+# the naive "every limb below sqrt(2^31/20)" bound is FALSE, and only the
+# per-limb exact analysis shows the conv columns still fit int32 (columns
+# pair at most two oversized limbs). CARRIED_MAX is the checker-proved
+# per-limb ceiling.
+CARRIED_MAX = 25600
+
+# Carry-round counts per op, tuned on-device (ops/microbench.py) and proved
+# sufficient by the interval checker. One round is a whole-array
+# shift/mask/roll; each extra round costs ~20 ns per 128-lane block inside
+# the Pallas ladder, and the ladder runs ~2.6k reduced ops per signature —
+# round counts are THE device-time knob of the whole kernel.
+ADD_ROUNDS = 1
+SUB_ROUNDS = 1
+HI_ROUNDS = 1
+CONV20_ROUNDS = 2
 
 
 def _carry_round20(x: jnp.ndarray) -> jnp.ndarray:
@@ -92,63 +113,83 @@ def _carry_round20(x: jnp.ndarray) -> jnp.ndarray:
 
 def weak_carry(x: jnp.ndarray) -> jnp.ndarray:
     """Reduce limbs to the carried range. Three rounds handle any input with
-    |limb| <= ~2^15 (add/sub magnitudes); post-convolution values go through
-    _conv_reduce which runs more rounds."""
+    |limb| <= ~2^15 (add/sub magnitudes); canonicalize and the comparison
+    entry points call this before interpreting limbs."""
     for _ in range(3):
         x = _carry_round20(x)
     return x
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return weak_carry(a + b)
+    x = a + b
+    for _ in range(ADD_ROUNDS):
+        x = _carry_round20(x)
+    return x
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return weak_carry(a + M_SUB - b)
+    x = a + M_SUB - b
+    for _ in range(SUB_ROUNDS):
+        x = _carry_round20(x)
+    return x
 
 
 def neg(a: jnp.ndarray) -> jnp.ndarray:
-    return weak_carry(M_SUB - a)
+    x = M_SUB - a
+    for _ in range(SUB_ROUNDS):
+        x = _carry_round20(x)
+    return x
 
 
 _NCONV = 2 * NLIMBS  # 39 product columns + 1 carry headroom column
 
 
-def _carry_round40(x: jnp.ndarray) -> jnp.ndarray:
-    """Parallel carry round on the 40-column product vector. Carry out of
-    column 39 (value 2^(13*40) = 2^260 * 2^260) wraps to column 20 with
-    factor FOLD, keeping the ring closed without a sequential chain."""
+def _carry_round20_nowrap(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One carry round WITHOUT the 2^260 wrap: returns (rounded, top carry
+    (1, B)). Used on the high half of the product, whose own wrap factor
+    would be FOLD^2 — the top carry is folded in exactly once at the end."""
     c = x >> RADIX
     r = x & MASK
-    shifted = jnp.concatenate(
-        [
-            jnp.zeros_like(c[:1]),
-            c[: NLIMBS - 1],
-            c[NLIMBS - 1: NLIMBS] + c[_NCONV - 1:] * FOLD,
-            c[NLIMBS: _NCONV - 1],
-        ],
-        axis=0,
-    )
-    return r + shifted
+    shifted = jnp.concatenate([jnp.zeros_like(c[:1]), c[: NLIMBS - 1]], axis=0)
+    return r + shifted, c[NLIMBS - 1:]
 
 
 def _conv_reduce(conv: jnp.ndarray) -> jnp.ndarray:
-    """(..., 40) product columns (col 39 zero) -> carried (..., 20):
-    4 parallel carry rounds, fold 2^260 = FOLD, 3 more rounds."""
-    for _ in range(4):
-        conv = _carry_round40(conv)
-    folded = conv[:NLIMBS] + FOLD * conv[NLIMBS:]
-    return weak_carry(folded)
+    """(..., 40) product columns (col 39 zero) -> carried (..., 20).
+
+    Split form: lo = cols 0..19, hi = cols 20..39 (weight 2^260 = FOLD per
+    lo-column). hi is carried on 20 columns only (no 40-wide vector ever
+    materializes — measured faster than carry rounds on the (40, B) array,
+    ops/microbench.py), its top carries (weight 2^520 = FOLD^2 at column 0)
+    are accumulated separately, then everything folds into lo and two
+    20-column rounds restore the carried invariant. Round counts proved by
+    tests/test_field_intervals.py."""
+    lo, hi = conv[:NLIMBS], conv[NLIMBS:]
+    top = None
+    for _ in range(HI_ROUNDS):
+        hi, t = _carry_round20_nowrap(hi)
+        top = t if top is None else top + t
+    folded = lo + FOLD * hi
+    folded = jnp.concatenate(
+        [folded[:1] + (FOLD * FOLD) * top, folded[1:]], axis=0
+    )
+    for _ in range(CONV20_ROUNDS):
+        folded = _carry_round20(folded)
+    return folded
 
 
 def _conv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Schoolbook polynomial product as one outer product + shifted row
-    sums: row i of the (20, 20) product tensor lands at columns i..i+19."""
-    prods = a[:, None] * b[None, :]  # (20, 20, ...)
-    acc = None
-    for i in range(NLIMBS):
-        row = jnp.pad(prods[i], [(i, _NCONV - NLIMBS - i)] + [(0, 0)] * (prods.ndim - 2))
-        acc = row if acc is None else acc + row
+    """Schoolbook polynomial product, pre-rolled form: row i (a_i * b) lands
+    at columns i..i+19 of the zero-extended accumulator via a sublane roll
+    of the zero-padded b — Mosaic turns each roll into cheap vreg funnel
+    shifts, measured 3x faster per conv than materializing jnp.pad'ed rows
+    (ops/microbench.py)."""
+    pad_shape = list(b.shape)
+    pad_shape[0] = _NCONV - NLIMBS
+    bz = jnp.concatenate([b, jnp.zeros(pad_shape, dtype=b.dtype)], axis=0)
+    acc = a[0:1] * bz
+    for i in range(1, NLIMBS):
+        acc = acc + a[i: i + 1] * jnp.roll(bz, i, axis=0)
     return acc
 
 
@@ -160,10 +201,19 @@ def sq(a: jnp.ndarray) -> jnp.ndarray:
     return _conv_reduce(_conv(a, a))
 
 
+# Squaring-run unroll threshold. Default keeps the XLA HLO small (runs of
+# up to 100 squarings become fori_loops). The Pallas kernel raises it for
+# the duration of its trace (pallas_verify._verify_block_kernel's
+# constant-swap try/finally): inside Mosaic a fori_loop whose body is ONE
+# squaring pays per-iteration loop overhead comparable to the squaring
+# itself — unrolling the pow22523 chain cut the R-decompression stage ~3x
+# on device (ops/microbench.py bisect probe).
+SQN_UNROLL_LIMIT = 4
+
+
 def _sqn(x: jnp.ndarray, n: int) -> jnp.ndarray:
-    """x^(2^n) via n squarings. Uses fori_loop so the HLO stays small for
-    the long runs inside the inversion/sqrt addition chains."""
-    if n <= 4:
+    """x^(2^n) via n squarings."""
+    if n <= SQN_UNROLL_LIMIT:
         for _ in range(n):
             x = sq(x)
         return x

@@ -6,11 +6,11 @@ XLA-compiled ladder materializes each field-op result to HBM (a (20, B)
 int32 array per op, ~2.6k field muls per verify), which makes the kernel
 HBM-bound ~20x off the VPU roofline; the Pallas version streams each block
 of signatures through VMEM once: reads 4x(20,128) A-coords, one (8,128)
-packed R block and 2x(52,128) signed window digits, writes a (1,128) mask,
+packed R block and 2x(51,128) signed window digits, writes a (1,128) mask,
 and does the entire signed-window double-scalar ladder + R decompression
 in on-chip memory.
 
-Ladder: 52 windows of signed 5-bit digits — 5 doublings (4 of them
+Ladder: 51 windows of signed 5-bit digits — 5 doublings (4 of them
 skipping the unused T output) + a mixed premultiplied-T base add + a
 premultiplied-T point add per window (curve.windowed_double_scalar_signed
 is the shape-polymorphic source of truth; the kernel body inlines its loop
@@ -22,7 +22,7 @@ closing over device constants, so the field constants (M_SUB, D2, the
 17-entry [d]B window table, ...) enter as broadcast kernel inputs and are
 swapped into the field/curve modules for the duration of the
 (single-threaded) kernel trace. Signed digit recoding runs as a tiny XLA
-prelude (unpack.words_to_digits5_signed) — its 52-step carry scan is
+prelude (unpack.words_to_digits5_signed) — its 51-step carry scan is
 hostile to the fused kernel but trivial for XLA.
 
 Reference seam: crypto/ed25519/ed25519.go:208-241 (curve25519-voi batch
@@ -70,28 +70,40 @@ def _const_args() -> tuple[np.ndarray, ...]:
 _N_CONSTS = len(_FIELD_CONST_NAMES) + 4
 
 
-def _verify_block_kernel(*refs):
+def _verify_block_kernel(*refs, n_windows: int = 0, stages: str = "full"):
     """consts..., A-coords (20, L) int32, packed R words (8, L) uint32,
-    signed digits s/k (52, L) int32, out (1, L) int32 mask."""
+    signed digits s/k (51, L) int32, out (1, L) int32 mask.
+
+    n_windows/stages are microbench bisection knobs (ops/microbench.py):
+    n_windows truncates the ladder, stages="nodecomp" skips the R
+    decompression — both produce WRONG masks and exist only to slope out
+    per-stage in-context device cost. Production callers use the defaults."""
     consts = refs[:_N_CONSTS]
     ax, ay, az, at, rw, sdig_ref, kdig_ref, out = refs[_N_CONSTS:]
 
     saved_f = {n: getattr(F, n) for n in _FIELD_CONST_NAMES}
     saved_table = curve._BASE_TABLE17
+    saved_sqn = F.SQN_UNROLL_LIMIT
     try:
         for n, ref in zip(_FIELD_CONST_NAMES, consts):
             setattr(F, n, ref[:])
         curve._BASE_TABLE17 = tuple(
             r[:] for r in consts[len(_FIELD_CONST_NAMES):]
         )
+        # fully unroll squaring runs: Mosaic loop overhead per iteration is
+        # comparable to one squaring (see field.SQN_UNROLL_LIMIT)
+        F.SQN_UNROLL_LIMIT = 1 << 30
         table_b = curve._BASE_TABLE17
 
-        r_words = rw[:]
-        y_r = U.words_to_y_limbs(r_words)
-        sign_r = U.words_sign(r_words)
-        ok_r, r = curve.decompress_zip215(y_r, sign_r)
-
         a = curve.Point(ax[:], ay[:], az[:], at[:])
+        if stages == "nodecomp":
+            ok_r, r = jnp.ones(a.x.shape[1:], dtype=bool), a
+        else:
+            r_words = rw[:]
+            y_r = U.words_to_y_limbs(r_words)
+            sign_r = U.words_sign(r_words)
+            ok_r, r = curve.decompress_zip215(y_r, sign_r)
+
         neg_a = curve.neg(a)
         table_a = curve.build_point_table17(neg_a)
 
@@ -99,19 +111,22 @@ def _verify_block_kernel(*refs):
         one = zero + F.ONE
         init = curve.Point(zero, one, one, zero)
 
+        nw = n_windows or NDIG
+
         def body(j, acc):
-            # most-significant digit first: index NDIG-1-j
-            i = NDIG - 1 - j
+            # most-significant digit first: index nw-1-j
+            i = nw - 1 - j
             ds = sdig_ref[pl.ds(i, 1), :][0]
             dk = kdig_ref[pl.ds(i, 1), :][0]
-            for _ in range(4):
-                acc = curve.double_no_t(acc)
-            acc = curve.double(acc)
-            acc = curve.madd_pre(acc, curve._select17_signed(table_b, ds), out_t=True)
-            acc = curve.add_pre(acc, curve._select17_signed(table_a, dk), out_t=True)
-            return acc
+            return curve.window_step(acc, ds, dk, table_b, table_a, out_t=False)
 
-        sb_ka = jax.lax.fori_loop(0, NDIG, body, init)
+        acc = jax.lax.fori_loop(0, nw - 1, body, init)
+        # final (LSB) window outside the loop: the only one whose A-add must
+        # materialize T (the add of -R below reads it)
+        sb_ka = curve.window_step(
+            acc, sdig_ref[pl.ds(0, 1), :][0], kdig_ref[pl.ds(0, 1), :][0],
+            table_b, table_a, out_t=True,
+        )
         diff = curve.add(sb_ka, curve.neg(r))
         valid = curve.is_identity(curve.mul_by_cofactor(diff))
         out[0, :] = (valid & ok_r).astype(jnp.int32)
@@ -119,13 +134,19 @@ def _verify_block_kernel(*refs):
         for n, v in saved_f.items():
             setattr(F, n, v)
         curve._BASE_TABLE17 = saved_table
+        F.SQN_UNROLL_LIMIT = saved_sqn
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def verify_pallas(ax, ay, az, at, r_words, s_words, k_words, interpret=False):
-    """(20, B) int32 A-coords + (8, B) uint32 packed r/s/k words ->
-    (B,) bool mask. B must be a multiple of LANES (callers fall back to
-    the XLA path for smaller buckets)."""
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "n_windows", "stages")
+)
+def _verify_pallas_bench(
+    ax, ay, az, at, r_words, s_words, k_words, interpret=False,
+    n_windows=0, stages="full",
+):
+    """Internal entry with microbench bisection knobs (n_windows/stages,
+    see _verify_block_kernel) — non-default knob values produce WRONG
+    masks. Production code uses verify_pallas, which cannot express them."""
     b = ax.shape[1]
     assert b % LANES == 0, f"batch {b} not a multiple of {LANES}"
     s_dig = U.words_to_digits5_signed(s_words)
@@ -144,7 +165,9 @@ def verify_pallas(ax, ay, az, at, r_words, s_words, k_words, interpret=False):
     dig_spec = pl.BlockSpec((NDIG, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
     out_spec = pl.BlockSpec((1, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
     mask = pl.pallas_call(
-        _verify_block_kernel,
+        functools.partial(
+            _verify_block_kernel, n_windows=n_windows, stages=stages
+        ),
         grid=grid,
         in_specs=const_specs + [limb_spec] * 4 + [word_spec] + [dig_spec] * 2,
         out_specs=out_spec,
@@ -152,3 +175,12 @@ def verify_pallas(ax, ay, az, at, r_words, s_words, k_words, interpret=False):
         interpret=interpret,
     )(*_const_args(), ax, ay, az, at, r_words, s_dig, k_dig)
     return mask[0] != 0
+
+
+def verify_pallas(ax, ay, az, at, r_words, s_words, k_words, interpret=False):
+    """(20, B) int32 A-coords + (8, B) uint32 packed r/s/k words ->
+    (B,) bool mask. B must be a multiple of LANES (callers fall back to
+    the XLA path for smaller buckets)."""
+    return _verify_pallas_bench(
+        ax, ay, az, at, r_words, s_words, k_words, interpret=interpret
+    )

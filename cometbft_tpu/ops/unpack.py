@@ -42,19 +42,21 @@ def words_sign(w: jnp.ndarray) -> jnp.ndarray:
     return (w[WORDS - 1] >> 31).astype(jnp.int32)
 
 
-NDIGITS5 = 52  # ceil(256/5) windows + headroom for the signed carry
+# Scalars are < L < 2^253: digit 50 covers bits 250..254, of which bits
+# 253/254 are always zero, so its raw value is <= 7 and even with a ripple
+# carry (+1) stays < 16 — the signed recoding never carries out of digit 50.
+# Hence 51 digits, not ceil(256/5) + 1 = 53: each digit trimmed deletes a
+# full ladder window (5 doublings + 2 adds = ~51 field muls per signature).
+NDIGITS5 = 51
 
 
 def words_to_digits5_signed(w: jnp.ndarray) -> jnp.ndarray:
-    """(8, B) uint32 scalar words -> (52, B) int32 SIGNED 5-bit window
+    """(8, B) uint32 scalar words -> (51, B) int32 SIGNED 5-bit window
     digits in [-16, 15], little-endian: scalar = sum d_j * 32^j. Standard
     signed recoding (d >= 16 -> d - 32, carry 1 up) shortens the ladder to
-    52 windows of 5 doublings and, because -d selects as a lane-local
-    negation, keeps the table at 17 entries. The carry ripple is a 52-step
-    scan over (B,) rows — noise next to one field mul.
-
-    Scalars are < L < 2^253, so window 51 absorbs the final carry without
-    overflow (bits 255.. are zero)."""
+    51 windows of 5 doublings and, because -d selects as a lane-local
+    negation, keeps the table at 17 entries. The carry ripple is a 51-step
+    scan over (B,) rows — noise next to one field mul."""
     raw = []
     for j in range(NDIGITS5):
         bit = 5 * j
@@ -66,7 +68,7 @@ def words_to_digits5_signed(w: jnp.ndarray) -> jnp.ndarray:
             if off > 27 and wi + 1 < WORDS:
                 v = v | (w[wi + 1] << (32 - off))
         raw.append((v & 31).astype(jnp.int32))
-    digits = jnp.stack(raw, axis=0)  # (52, B) in [0, 31]
+    digits = jnp.stack(raw, axis=0)  # (51, B) in [0, 31]
 
     import jax
 
@@ -78,6 +80,8 @@ def words_to_digits5_signed(w: jnp.ndarray) -> jnp.ndarray:
     carry_out, signed = jax.lax.scan(
         body, jnp.zeros_like(digits[0]), digits
     )
-    # carry out of the top window is impossible for scalars < 2^253
-    # (windows 51 covers bits 255..259 = zero), asserted by construction
+    # carry_out is provably zero for scalars < 2^253 (see the NDIGITS5
+    # comment: digit 50's post-carry value is <= 8 < 16, so the recoding
+    # never adjusts it); callers enforce s, k < L < 2^253 host-side
+    # (ed25519_kernel.stage_batch rejects s >= L, k is reduced mod L).
     return signed

@@ -1,0 +1,224 @@
+"""Mechanical interval analysis of the GF(2^255-19) limb arithmetic.
+
+field.py's carry-round counts (ADD_ROUNDS/SUB_ROUNDS/HI_ROUNDS/
+CONV20_ROUNDS) are the device-time knob of the whole Ed25519 kernel: each
+round costs ~20 ns per 128-lane block and the ladder runs ~2.6k reduced ops
+per signature. This test PROVES the configured counts sound instead of
+trusting hand analysis: it mirrors every op of field.py in exact per-limb
+interval arithmetic (Python ints, no overflow), computes the least fixpoint
+of {mul, sq, add, sub, neg} over their own outputs starting from canonical
+inputs, and asserts:
+
+  1. closure — the fixpoint exists and every op maps it into itself;
+  2. int32 safety — every intermediate (conv columns included) stays inside
+     signed 32-bit range, with the multiply-by-FOLD checked pre-add;
+  3. bias domination — the max value representable by carried limbs stays
+     below the subtraction bias M = 33p, so a + M - b never goes negative;
+  4. the documented CARRIED_MAX really is a per-limb ceiling.
+
+If someone lowers a round count that the hardware could not absorb, this
+test fails before any random test would (random inputs almost never reach
+the interval extremes).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.ops import field as F
+
+RADIX = F.RADIX
+MASK = F.MASK
+FOLD = F.FOLD
+N = F.NLIMBS
+NCONV = F._NCONV
+INT32_MIN, INT32_MAX = -(2**31), 2**31 - 1
+
+M_SUB = [int(x) for x in np.asarray(F.M_SUB)[:, 0]]
+
+Interval = tuple[int, int]
+
+
+def _chk(iv: Interval) -> Interval:
+    lo, hi = iv
+    assert lo <= hi
+    assert INT32_MIN <= lo and hi <= INT32_MAX, f"int32 overflow: [{lo}, {hi}]"
+    return iv
+
+
+def iv_add(a: Interval, b: Interval) -> Interval:
+    return _chk((a[0] + b[0], a[1] + b[1]))
+
+
+def iv_sub(a: Interval, b: Interval) -> Interval:
+    return _chk((a[0] - b[1], a[1] - b[0]))
+
+
+def iv_mul(a: Interval, b: Interval) -> Interval:
+    ps = [a[0] * b[0], a[0] * b[1], a[1] * b[0], a[1] * b[1]]
+    return _chk((min(ps), max(ps)))
+
+
+def iv_scale(k: int, a: Interval) -> Interval:
+    return _chk((k * a[0], k * a[1])) if k >= 0 else _chk((k * a[1], k * a[0]))
+
+
+def iv_shift(a: Interval) -> Interval:
+    return (a[0] >> RADIX, a[1] >> RADIX)
+
+
+def iv_mask(a: Interval) -> Interval:
+    # exact when the interval sits inside one RADIX-block, else [0, MASK]
+    if (a[0] >> RADIX) == (a[1] >> RADIX):
+        return (a[0] & MASK, a[1] & MASK)
+    return (0, MASK)
+
+
+def iv_join(a: Interval, b: Interval) -> Interval:
+    return (min(a[0], b[0]), max(a[1], b[1]))
+
+
+Vec = list  # list of Interval, one per limb/column
+
+
+def carry_round20(x: Vec) -> Vec:
+    c = [iv_shift(v) for v in x]
+    r = [iv_mask(v) for v in x]
+    shifted = [iv_scale(FOLD, c[N - 1])] + c[: N - 1]
+    return [iv_add(ri, si) for ri, si in zip(r, shifted)]
+
+
+def carry_round20_nowrap(x: Vec) -> tuple[Vec, Interval]:
+    c = [iv_shift(v) for v in x]
+    r = [iv_mask(v) for v in x]
+    shifted = [(0, 0)] + c[: N - 1]
+    return [iv_add(ri, si) for ri, si in zip(r, shifted)], c[N - 1]
+
+
+def conv(a: Vec, b: Vec) -> Vec:
+    cols: Vec = [(0, 0)] * NCONV
+    for i in range(N):
+        for j in range(N):
+            cols[i + j] = iv_add(cols[i + j], iv_mul(a[i], b[j]))
+    return cols
+
+
+def conv_reduce(cols: Vec) -> Vec:
+    lo, hi = cols[:N], cols[N:]
+    top: Interval = (0, 0)
+    for _ in range(F.HI_ROUNDS):
+        hi, t = carry_round20_nowrap(hi)
+        top = iv_add(top, t)
+    folded = [iv_add(lo[i], iv_scale(FOLD, hi[i])) for i in range(N)]
+    folded[0] = iv_add(folded[0], iv_scale(FOLD * FOLD, top))
+    for _ in range(F.CONV20_ROUNDS):
+        folded = carry_round20(folded)
+    return folded
+
+
+def op_mul(a: Vec, b: Vec) -> Vec:
+    return conv_reduce(conv(a, b))
+
+
+def op_add(a: Vec, b: Vec) -> Vec:
+    x = [iv_add(ai, bi) for ai, bi in zip(a, b)]
+    for _ in range(F.ADD_ROUNDS):
+        x = carry_round20(x)
+    return x
+
+
+def op_sub(a: Vec, b: Vec) -> Vec:
+    x = [iv_sub(iv_add(ai, (mi, mi)), bi) for ai, bi, mi in zip(a, b, M_SUB)]
+    for _ in range(F.SUB_ROUNDS):
+        x = carry_round20(x)
+    return x
+
+
+def op_neg(a: Vec) -> Vec:
+    x = [iv_sub((mi, mi), ai) for ai, mi in zip(a, M_SUB)]
+    for _ in range(F.SUB_ROUNDS):
+        x = carry_round20(x)
+    return x
+
+
+CANONICAL: Vec = [(0, MASK)] * N  # constants, unpacked wire inputs
+
+
+def compute_fixpoint(max_iters: int = 64) -> Vec:
+    c = list(CANONICAL)
+    for _ in range(max_iters):
+        outs = [op_mul(c, c), op_add(c, c), op_sub(c, c), op_neg(c)]
+        joined = list(c)
+        for o in outs:
+            joined = [iv_join(x, y) for x, y in zip(joined, o)]
+        if joined == c:
+            return c
+        c = joined
+    pytest.fail("carried-limb invariant did not reach a fixpoint")
+
+
+def test_fixpoint_closure_and_int32_safety():
+    """Closure + int32 safety: computing the fixpoint runs every op over
+    interval extremes; _chk raises inside if anything can overflow."""
+    c = compute_fixpoint()
+    # the ops map the fixpoint into itself (re-verify explicitly)
+    for out in (op_mul(c, c), op_add(c, c), op_sub(c, c), op_neg(c)):
+        for limb_out, limb_c in zip(out, c):
+            assert limb_c[0] <= limb_out[0] and limb_out[1] <= limb_c[1]
+
+
+def test_carried_max_is_a_ceiling():
+    c = compute_fixpoint()
+    worst = max(hi for _, hi in c)
+    assert worst <= F.CARRIED_MAX, (
+        f"fixpoint limb max {worst} exceeds documented CARRIED_MAX "
+        f"{F.CARRIED_MAX}"
+    )
+    # int32 safety of the conv does NOT follow from a naive
+    # 20 * CARRIED_MAX^2 bound (that is ~1.3e10) — it holds only because the
+    # oversized limbs sit at fixed positions, which compute_fixpoint checks
+    # column-exactly via _chk inside conv().
+
+
+def test_sub_bias_dominates_every_carried_value():
+    """a + M - b >= 0 requires M >= value(b) for every carried b."""
+    c = compute_fixpoint()
+    max_value = sum(hi * (1 << (RADIX * i)) for i, (_, hi) in enumerate(c))
+    m_value = sum(mi * (1 << (RADIX * i)) for i, mi in enumerate(M_SUB))
+    assert m_value == 33 * F.P
+    assert max_value < m_value, (
+        f"carried value can reach {max_value:#x}, bias is only {m_value:#x}"
+    )
+
+
+def test_weak_carry_domain_for_canonicalize():
+    """canonicalize() runs weak_carry (3 rounds) before interpreting limbs;
+    from the fixpoint this must land limbs in a [-FOLD, MASK + 2*FOLD] band
+    so the fold-bits loop and borrow chain operate in their designed
+    range."""
+    c = compute_fixpoint()
+    x = list(c)
+    for _ in range(3):
+        x = carry_round20(x)
+    for i, (lo, hi) in enumerate(x):
+        assert -FOLD <= lo and hi <= MASK + 2 * FOLD, (i, lo, hi)
+
+
+def test_conv_matches_schoolbook_on_randoms():
+    """The pre-rolled conv in field._conv is algebraically the schoolbook
+    product: cross-check column-exactly against a numpy reference."""
+    rng = np.random.default_rng(7)
+    c = compute_fixpoint()  # draw within the proved invariant, per limb
+    a = np.stack([rng.integers(lo, hi + 1, size=33) for lo, hi in c])
+    b = np.stack([rng.integers(lo, hi + 1, size=33) for lo, hi in c])
+    import jax.numpy as jnp
+
+    got = np.asarray(
+        F._conv(jnp.asarray(a, dtype=jnp.int32), jnp.asarray(b, dtype=jnp.int32))
+    )
+    want = np.zeros((NCONV, 33), dtype=np.int64)
+    for i in range(N):
+        for j in range(N):
+            want[i + j] += a[i] * b[j]
+    np.testing.assert_array_equal(got, want)
