@@ -186,23 +186,41 @@ class BlocksyncReactor(Reactor):
     # ------------------------------------------------- the TPU apply loop
 
     async def _pool_routine(self) -> None:
-        """reactor.go:286 poolRoutine, windowed: stage a run of consecutive
-        ready heights on the device, fetch all masks at once, then apply
-        sequentially."""
+        """reactor.go:286 poolRoutine, windowed AND pipelined: while window
+        N's masks are in flight on the device, window N+1 is staged (the
+        host-heavy part: sign-bytes + SHA-512 challenges + dispatch), so
+        the device never idles between windows. The staged-ahead window is
+        used only if the pool height after applying window N lands exactly
+        on its first height; any redo/invalid-block path discards it (the
+        staging is speculative work, never speculative state)."""
         chain_id = self.state.chain_id
+        staged_ahead: list | None = None
         while True:
             if self.pool.is_caught_up():
                 await self._switch_to_consensus()
                 return
-            entries = self._stage_window(chain_id)
+            if staged_ahead and staged_ahead[0][0] == self.pool.height:
+                entries = staged_ahead
+            else:
+                entries = self._stage_window(chain_id, self.pool.height)
+            staged_ahead = None
             if not entries:
                 await asyncio.sleep(TRY_SYNC_INTERVAL)
                 continue
-            t0 = time.monotonic()
-            # device->host mask fetch must not stall the p2p event loop
-            await asyncio.to_thread(
-                validation.prefetch_staged, [e[-1] for e in entries])
-            self.device_busy_s += time.monotonic() - t0
+            # device->host mask fetch must not stall the p2p event loop;
+            # timing runs INSIDE the worker so device_busy_s measures the
+            # fetch alone, not the overlapped staging below
+            def _timed_prefetch(batch=[e[-1] for e in entries]):
+                t0 = time.monotonic()
+                validation.prefetch_staged(batch)
+                return time.monotonic() - t0
+
+            fetch = asyncio.get_running_loop().run_in_executor(
+                None, _timed_prefetch)
+            # overlap: stage the next window while the fetch is in flight
+            # (same valset assumption — _stage_window stops at a change)
+            staged_ahead = self._stage_window(chain_id, entries[-1][0] + 1)
+            self.device_busy_s += await fetch
             for h, first, first_ext, second, parts, first_id, staged in entries:
                 if h != self.pool.height:
                     break  # an earlier redo shifted the window
@@ -238,11 +256,12 @@ class BlocksyncReactor(Reactor):
                         max_peer=self.pool.max_peer_height,
                         bps=round(self.pool.sync_rate(), 1))
 
-    def _stage_window(self, chain_id: str):
-        """Stage up to `window` consecutive verifications. Stops at a valset
-        change boundary (staged batches assume the current valset)."""
+    def _stage_window(self, chain_id: str, start_height: int):
+        """Stage up to `window` consecutive verifications from
+        start_height. Stops at a valset change boundary (staged batches
+        assume the current valset)."""
         entries = []
-        h = self.pool.height
+        h = start_height
         vals = self.state.validators
         vals_hash = vals.hash()
         while len(entries) < self.window:

@@ -189,6 +189,48 @@ def cmd_inspect(args) -> int:
     return 0
 
 
+def cmd_light(args) -> int:
+    """cmd/cometbft/commands/light.go:30-150: run the verified light-client
+    RPC proxy against a primary + witnesses."""
+    from cometbft_tpu import light
+    from cometbft_tpu.light.proxy import LightProxy
+    from cometbft_tpu.light.rpc_provider import RPCProvider
+    from cometbft_tpu.light.store import LightStore
+    from cometbft_tpu.store import MemDB
+
+    chain_id = args.chain_id
+    primary = RPCProvider(chain_id, args.primary)
+    witnesses = [RPCProvider(chain_id, w)
+                 for w in args.witness.split(",") if w]
+    store = LightStore(MemDB())
+
+    async def run():
+        client = light.Client(
+            chain_id,
+            light.TrustOptions(
+                period_ns=int(args.trusting_period * 1e9),
+                height=args.trusted_height,
+                hash_=bytes.fromhex(args.trusted_hash),
+            ),
+            primary, witnesses, store,
+        )
+        proxy = LightProxy(client, args.primary, args.laddr)
+        await proxy.start()
+        print(f"light proxy for {chain_id} listening on {proxy.bound_addr} "
+              f"(primary {args.primary}, {len(witnesses)} witnesses)")
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        finally:
+            await proxy.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_version(_args) -> int:
     print(VERSION)
     return 0
@@ -230,6 +272,20 @@ def main(argv: list[str] | None = None) -> int:
     sp = sub.add_parser("inspect", help="serve read-only RPC over a stopped node's data")
     sp.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
     sp.set_defaults(fn=cmd_inspect)
+
+    sp = sub.add_parser("light", help="verified light-client RPC proxy")
+    sp.add_argument("chain_id")
+    sp.add_argument("--primary", required=True, help="primary node RPC URL")
+    sp.add_argument("--witness", default="",
+                    help="comma-separated witness RPC URLs")
+    sp.add_argument("--trusted-height", type=int, required=True)
+    sp.add_argument("--trusted-hash", required=True,
+                    help="hex header hash at the trusted height")
+    sp.add_argument("--trusting-period", type=float, default=168 * 3600,
+                    help="seconds (default one week)")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888",
+                    help="proxy listen address")
+    sp.set_defaults(fn=cmd_light)
 
     sp = sub.add_parser("show-node-id")
     sp.set_defaults(fn=cmd_show_node_id)

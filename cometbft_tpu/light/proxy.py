@@ -1,0 +1,252 @@
+"""Light-client proxy: a local RPC endpoint whose answers are VERIFIED.
+
+Reference: light/proxy/proxy.go:20-80 + light/rpc/client.go — the
+`cometbft light <chainID> --primary --witness ...` daemon. Every block-ish
+route answers from (or is cross-checked against) a light-client-verified
+header chain:
+
+  - light_block / header / header_by_hash / commit / validators answer
+    straight from verified light blocks (bisection against the primary,
+    divergence cross-check against witnesses — light/client.py);
+  - block / block_by_hash fetch the raw block from the primary, then prove
+    the payload against the VERIFIED header: the tx set must hash to the
+    verified data_hash, and the served header IS the verified one — a lying
+    primary cannot alter a single byte of what this proxy returns;
+  - broadcast_tx_* / abci_query / status pass through to the primary,
+    marked unverified (abci_query proof-op verification is app-specific;
+    the reference's KeyPathFn hook is likewise opt-in).
+
+A primary caught lying fails verification (wrong commit signatures over a
+forged header → ErrVerification; conflicting-but-valid headers → witness
+divergence handling with attack evidence, light/client.py:298-380); the
+proxy surfaces the error instead of the forged data.
+
+Serving plumbing reuses rpc/server.RPCServer with this module's route
+table (no node behind it — websocket subscriptions are not proxied; the
+reference proxies events, a documented delta).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import urllib.request
+
+from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.light.rpc_provider import normalize_rpc_url
+from cometbft_tpu.rpc.core import RPCError, _b64, _hex, header_dict
+from cometbft_tpu.rpc.server import RPCServer
+from cometbft_tpu.types.block import Data
+
+
+class _PrimaryRPC:
+    """Raw JSON-RPC calls to the primary node (unverified plane). Uses
+    POST with a JSON-RPC body so params keep their exact JSON types — a
+    GET re-encode would strip quoting and retype base64/bool params on the
+    primary's URI handler."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = normalize_rpc_url(base_url)
+        self.timeout = timeout
+
+    async def call(self, route: str, params: dict | None = None) -> dict:
+        body = json.dumps({
+            "jsonrpc": "2.0", "id": 1, "method": route,
+            "params": params or {},
+        }).encode()
+
+        def _post():
+            req = urllib.request.Request(
+                self.base_url + "/", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.load(r)
+
+        doc = await asyncio.to_thread(_post)
+        if "error" in doc:
+            e = doc["error"]
+            raise RPCError(e.get("code", -32603), f"primary: {e.get('message', '')}")
+        return doc["result"]
+
+
+class ProxyEnv:
+    """Route environment for the verified proxy (mirrors rpc/core
+    Environment's handler signature: async fn(params) -> result dict)."""
+
+    def __init__(self, client, primary_url: str):
+        self.client = client  # light.Client
+        self.primary = _PrimaryRPC(primary_url)
+
+    async def _verified(self, params: dict):
+        h = params.get("height")
+        if h in (None, ""):
+            lb = await self.client.update()
+            if lb is None:
+                lb = self.client.store.latest_light_block()
+            if lb is None:
+                raise RPCError(-32603, "no trusted light block yet")
+            return lb
+        return await self.client.verify_light_block_at_height(int(h))
+
+    # ------------------------------------------------------ verified plane
+
+    async def light_block(self, params: dict) -> dict:
+        lb = await self._verified(params)
+        return {"height": str(lb.height), "light_block": _b64(lb.to_proto())}
+
+    async def header(self, params: dict) -> dict:
+        lb = await self._verified(params)
+        return {"header": header_dict(lb.signed_header.header)}
+
+    async def header_by_hash(self, params: dict) -> dict:
+        want = bytes.fromhex(params["hash"])
+        lb = self.client.store.light_block_by_hash(want)
+        if lb is None:
+            raise RPCError(-32603, "header not found among trusted light blocks")
+        return {"header": header_dict(lb.signed_header.header)}
+
+    async def commit(self, params: dict) -> dict:
+        lb = await self._verified(params)
+        c = lb.signed_header.commit
+        return {
+            "canonical": True,
+            "signed_header": {
+                "header": header_dict(lb.signed_header.header),
+                "commit": {
+                    "height": str(c.height),
+                    "round": c.round_,
+                    "block_id": {
+                        "hash": _hex(c.block_id.hash),
+                        "parts": {
+                            "total": c.block_id.part_set_header.total,
+                            "hash": _hex(c.block_id.part_set_header.hash)},
+                    },
+                    "signatures": [
+                        {
+                            "block_id_flag": int(cs.block_id_flag),
+                            "validator_address": _hex(cs.validator_address),
+                            "timestamp": str(cs.timestamp),
+                            "signature": _b64(cs.signature) if cs.signature else None,
+                        }
+                        for cs in c.signatures
+                    ],
+                },
+            },
+        }
+
+    async def validators(self, params: dict) -> dict:
+        lb = await self._verified(params)
+        return {
+            "block_height": str(lb.height),
+            "validators": [
+                {
+                    "address": _hex(v.address),
+                    "pub_key": {"type": v.pub_key.type_(),
+                                "value": _b64(v.pub_key.bytes_())},
+                    "voting_power": str(v.voting_power),
+                    "proposer_priority": str(v.proposer_priority),
+                }
+                for v in lb.validator_set.validators
+            ],
+            "count": str(len(lb.validator_set.validators)),
+            "total": str(len(lb.validator_set.validators)),
+        }
+
+    async def block(self, params: dict) -> dict:
+        """Raw block from the primary, proven against the verified header:
+        served header = verified header; primary txs must hash to its
+        data_hash (light/rpc/client.go Block + validateBlock shape)."""
+        lb = await self._verified(params)
+        raw = await self.primary.call("block", {"height": str(lb.height)})
+        txs = [base64.b64decode(t) for t in raw["block"]["data"]["txs"]]
+        got = Data(txs=txs).hash()
+        want = lb.signed_header.header.data_hash
+        if got != want:
+            raise RPCError(
+                -32603,
+                f"primary returned txs not matching the verified data_hash "
+                f"at height {lb.height} (got {got.hex()}, want {want.hex()})")
+        return {
+            "block_id": {"hash": _hex(lb.signed_header.header.hash())},
+            "block": {
+                "header": header_dict(lb.signed_header.header),
+                "data": {"txs": [_b64(t) for t in txs]},
+            },
+        }
+
+    # ---------------------------------------------------- unverified plane
+
+    async def health(self, _params: dict) -> dict:
+        return {}
+
+    async def status(self, _params: dict) -> dict:
+        res = await self.primary.call("status")
+        res["light_client_info"] = {
+            "primary": self.client.primary.id_(),
+            "witnesses": [w.id_() for w in self.client.witnesses],
+            "first_trusted_height": str(self.client.first_trusted_height()),
+            "last_trusted_height": str(self.client.last_trusted_height()),
+        }
+        return res
+
+    async def abci_query(self, params: dict) -> dict:
+        return await self.primary.call("abci_query", params)
+
+    async def abci_info(self, _params: dict) -> dict:
+        return await self.primary.call("abci_info")
+
+    async def broadcast_tx_sync(self, params: dict) -> dict:
+        return await self.primary.call("broadcast_tx_sync", params)
+
+    async def broadcast_tx_async(self, params: dict) -> dict:
+        return await self.primary.call("broadcast_tx_async", params)
+
+    async def broadcast_tx_commit(self, params: dict) -> dict:
+        return await self.primary.call("broadcast_tx_commit", params)
+
+    def routes(self) -> dict:
+        return {
+            "health": self.health,
+            "status": self.status,
+            "light_block": self.light_block,
+            "header": self.header,
+            "header_by_hash": self.header_by_hash,
+            "commit": self.commit,
+            "validators": self.validators,
+            "block": self.block,
+            "abci_query": self.abci_query,
+            "abci_info": self.abci_info,
+            "broadcast_tx_sync": self.broadcast_tx_sync,
+            "broadcast_tx_async": self.broadcast_tx_async,
+            "broadcast_tx_commit": self.broadcast_tx_commit,
+        }
+
+
+class LightProxy(BaseService):
+    """The daemon: a light.Client plus an RPCServer serving ProxyEnv."""
+
+    def __init__(self, client, primary_url: str, listen_addr: str,
+                 logger: cmtlog.Logger | None = None):
+        super().__init__("LightProxy", logger or cmtlog.default().with_fields(
+            module="light-proxy"))
+        self.client = client
+        self.env = ProxyEnv(client, primary_url)
+
+        class _Cfg:
+            laddr = listen_addr
+
+        self.server = RPCServer(
+            None, _Cfg(), logger=self.logger, env=self.env)
+
+    @property
+    def bound_addr(self) -> str:
+        return self.server.bound_addr
+
+    async def on_start(self) -> None:
+        await self.client.initialize()
+        await self.server.start()
+
+    async def on_stop(self) -> None:
+        await self.server.stop()

@@ -23,14 +23,21 @@ from cometbft_tpu.light.errors import (
 from cometbft_tpu.light.provider import Provider
 
 
+def normalize_rpc_url(base_url: str) -> str:
+    """tcp://host:port or bare host:port -> http URL (shared by the RPC
+    provider and the light proxy's primary client)."""
+    url = base_url.rstrip("/")
+    if not url.startswith("http"):
+        url = "http://" + url.removeprefix("tcp://")
+    return url
+
+
 class RPCProvider(Provider):
     """light/provider/http/http.go shape over the framework's JSON-RPC."""
 
     def __init__(self, chain_id: str, base_url: str, timeout: float = 10.0):
         self.chain_id = chain_id
-        self.base_url = base_url.rstrip("/")
-        if not self.base_url.startswith("http"):
-            self.base_url = "http://" + self.base_url.removeprefix("tcp://")
+        self.base_url = normalize_rpc_url(base_url)
         self.timeout = timeout
 
     def _get(self, route: str) -> dict:

@@ -58,6 +58,15 @@ class LightStore:
         i = bisect.bisect_left(self._heights, height)
         return self.light_block(self._heights[i - 1]) if i > 0 else None
 
+    def light_block_by_hash(self, want: bytes) -> Optional[LightBlock]:
+        """Linear scan over trusted blocks (proxy header_by_hash; the store
+        is bounded by prune())."""
+        for h in self._heights:
+            lb = self.light_block(h)
+            if lb is not None and lb.signed_header.header.hash() == want:
+                return lb
+        return None
+
     def delete_light_block(self, height: int) -> None:
         self.db.delete(_key(height))
         try:

@@ -1,0 +1,17 @@
+"""Shared kernel-dispatch lock.
+
+The Pallas Ed25519 kernel trace temporarily swaps the field/curve module
+constants for VMEM refs (pallas_verify._verify_block_kernel). ANY other
+trace that reads those module globals — the sr25519 XLA ladder, the
+ed25519 XLA fallback — must never interleave with that swap, or it bakes
+another kernel's refs/tracers into its compiled program. Every jit
+dispatch of a curve kernel therefore serializes on this one lock
+(compiled-cache dispatch under the lock is sub-ms; the expensive
+host<->device transfers stay outside it).
+"""
+
+from __future__ import annotations
+
+import threading
+
+KERNEL_DISPATCH_LOCK = threading.Lock()

@@ -109,14 +109,10 @@ def _pallas_available() -> bool:
     return _use_pallas
 
 
-import threading
-
-# Serializes jit dispatch (and therefore tracing): the Pallas kernel trace
-# temporarily swaps field/curve module constants (pallas_verify.py), which
-# must never interleave across the transfer-pool threads. Compiled-cache
-# dispatch under the lock is sub-ms; the expensive host->device copies stay
-# outside it.
-_dispatch_lock = threading.Lock()
+# Serializes jit dispatch (and therefore tracing) across ALL curve kernels
+# and threads — see ops/dispatch.py for why the Pallas constant swap makes
+# this mandatory.
+from cometbft_tpu.ops.dispatch import KERNEL_DISPATCH_LOCK as _dispatch_lock
 
 
 def _dispatch_verify(a_dev, r_words, s_words, k_words):
@@ -144,7 +140,8 @@ def decompress_points(enc: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         pad = np.zeros((b - n, 8), dtype=np.uint32)
         pad[:, 0] = 1  # y = 1: the identity point, always decompressible
         words = np.concatenate([words, pad])
-    ok, x, yy, z, t = _decompress_kernel(jnp.asarray(words.T))
+    with _dispatch_lock:
+        ok, x, yy, z, t = _decompress_kernel(jnp.asarray(words.T))
     coords = np.stack(
         [np.asarray(x).T, np.asarray(yy).T, np.asarray(z).T, np.asarray(t).T], axis=1
     )

@@ -13,6 +13,34 @@ import base64
 
 from cometbft_tpu.abci import types as abci
 
+# rpc/core/env.go:32 genesisChunkSize (16 MB)
+GENESIS_CHUNK_SIZE = 16 * 1024 * 1024
+
+
+def header_dict(h) -> dict:
+    """Complete JSON header — every field, lossless. Shared by the node RPC
+    and the light proxy (light/proxy.py)."""
+    return {
+        "version": {"block": str(h.version.block), "app": str(h.version.app)},
+        "chain_id": h.chain_id,
+        "height": str(h.height),
+        "time": str(h.time),
+        "last_block_id": {
+            "hash": _hex(h.last_block_id.hash),
+            "parts": {"total": h.last_block_id.part_set_header.total,
+                      "hash": _hex(h.last_block_id.part_set_header.hash)},
+        },
+        "last_commit_hash": _hex(h.last_commit_hash),
+        "data_hash": _hex(h.data_hash),
+        "validators_hash": _hex(h.validators_hash),
+        "next_validators_hash": _hex(h.next_validators_hash),
+        "consensus_hash": _hex(h.consensus_hash),
+        "app_hash": _hex(h.app_hash),
+        "last_results_hash": _hex(h.last_results_hash),
+        "evidence_hash": _hex(h.evidence_hash),
+        "proposer_address": _hex(h.proposer_address),
+    }
+
 
 def _b64(b: bytes) -> str:
     return base64.b64encode(b).decode()
@@ -48,6 +76,7 @@ class Environment:
     def __init__(self, node):
         self.node = node
         self._bg_tasks: set = set()
+        self._gen_chunks: list[str] | None = None
 
     # ------------------------------------------------------------- info
 
@@ -195,6 +224,153 @@ class Environment:
                 })
         return {"last_height": str(top), "block_metas": metas}
 
+    def _header_dict(self, h) -> dict:
+        return header_dict(h)
+
+    async def header(self, params: dict) -> dict:
+        """rpc/core/blocks.go:176 Header."""
+        height = self._height_param(params, self.node.block_store.height())
+        meta = self.node.block_store.load_block_meta(height)
+        if meta is None:
+            raise RPCError(-32603, f"header at height {height} not found")
+        return {"header": self._header_dict(meta.header)}
+
+    async def header_by_hash(self, params: dict) -> dict:
+        """rpc/core/blocks.go:205 HeaderByHash."""
+        h = bytes.fromhex(params["hash"])
+        block = self.node.block_store.load_block_by_hash(h)
+        if block is None:
+            raise RPCError(-32603, "header not found")
+        return {"header": self._header_dict(block.header)}
+
+    async def block_results(self, params: dict) -> dict:
+        """rpc/core/blocks.go:244 BlockResults: the persisted
+        FinalizeBlock response for a committed height — tx results, events,
+        validator and consensus-param updates, app hash."""
+        from cometbft_tpu.abci import codec as abci_codec
+
+        height = self._height_param(params, self.node.block_store.height())
+        resp = self.node.state_store.load_finalize_block_response(height)
+        if resp is None:
+            raise RPCError(
+                -32603, f"block results at height {height} not found")
+        return {
+            "height": str(height),
+            "txs_results": [abci_codec._to_jsonable(r) for r in resp.tx_results],
+            "finalize_block_events": [
+                abci_codec._to_jsonable(e) for e in resp.events],
+            "validator_updates": [
+                abci_codec._to_jsonable(u) for u in resp.validator_updates],
+            "consensus_param_updates": (
+                abci_codec._to_jsonable(resp.consensus_param_updates)
+                if resp.consensus_param_updates is not None else None),
+            "app_hash": _hex(resp.app_hash),
+        }
+
+    async def consensus_params(self, params: dict) -> dict:
+        """rpc/core/consensus.go:99 ConsensusParams: params in effect at a
+        height (default: latest uncommitted = store top + 1, and explicit
+        heights up to top + 1 are valid — like validators)."""
+        top = self.node.block_store.height()
+        h = params.get("height")
+        if h in (None, ""):
+            height = top + 1
+        else:
+            height = int(h)
+            base = self.node.block_store.base()
+            if height < base or height > top + 1:
+                raise RPCError(
+                    -32603,
+                    f"height {height} is not available (range {base}-{top + 1})")
+        cp = self.node.state_store.load_consensus_params(height)
+        if cp is None:
+            raise RPCError(
+                -32603, f"consensus params at height {height} not found")
+        return {
+            "block_height": str(height),
+            "consensus_params": {
+                "block": {
+                    "max_bytes": str(cp.block.max_bytes),
+                    "max_gas": str(cp.block.max_gas),
+                },
+                "evidence": {
+                    "max_age_num_blocks": str(cp.evidence.max_age_num_blocks),
+                    "max_age_duration": str(cp.evidence.max_age_duration_ns),
+                    "max_bytes": str(cp.evidence.max_bytes),
+                },
+                "validator": {"pub_key_types": cp.validator.pub_key_types},
+                "version": {"app": str(cp.version.app)},
+                "abci": {
+                    "vote_extensions_enable_height": str(
+                        cp.abci.vote_extensions_enable_height),
+                },
+            },
+        }
+
+    async def dump_consensus_state(self, _params: dict) -> dict:
+        """rpc/core/consensus.go:56 DumpConsensusState: own round state
+        plus every peer's tracked consensus round state."""
+        from cometbft_tpu.consensus.reactor import PEER_STATE_KEY
+
+        own = await self.consensus_state({})
+        peer_states = []
+        sw = self.node.switch
+        for p in (list(sw.peers.values()) if sw is not None else []):
+            ps = p.get(PEER_STATE_KEY)
+            if ps is None:
+                continue
+            prs = ps.prs
+            peer_states.append({
+                "node_address": f"{p.id}@{p.node_info.listen_addr}",
+                "peer_state": {
+                    "round_state": {
+                        "height": str(prs.height),
+                        "round": prs.round_,
+                        "step": int(prs.step),
+                        "proposal": prs.proposal,
+                        "catchup_commit_round": prs.catchup_commit_round,
+                        "last_commit_round": prs.last_commit_round,
+                    },
+                },
+            })
+        return {"round_state": own["round_state"], "peers": peer_states}
+
+    async def check_tx(self, params: dict) -> dict:
+        """rpc/core/mempool.go:188 CheckTx: run the app's CheckTx WITHOUT
+        adding to the mempool."""
+        from cometbft_tpu.abci import codec as abci_codec
+
+        tx = self._tx_param(params)
+        res = await self.node.proxy_app.mempool.check_tx(
+            abci.RequestCheckTx(tx=tx))
+        return abci_codec._to_jsonable(res)
+
+    async def genesis_chunked(self, params: dict) -> dict:
+        """rpc/core/net.go:107 GenesisChunked: base64 chunks of the genesis
+        document for payloads too large for one response."""
+        chunks = self._genesis_chunks()
+        if not chunks:
+            raise RPCError(-32603, "genesis chunks are not initialized")
+        cid = int(params.get("chunk") or 0)
+        if cid < 0 or cid >= len(chunks):
+            raise RPCError(
+                -32602,
+                f"there are {len(chunks)} chunks, {cid} is invalid")
+        return {
+            "chunk": str(cid),
+            "total": str(len(chunks)),
+            "data": chunks[cid],
+        }
+
+    def _genesis_chunks(self) -> list[str]:
+        if self._gen_chunks is None:
+            data = self.node.genesis_doc.to_json().encode()
+            size = GENESIS_CHUNK_SIZE
+            self._gen_chunks = [
+                _b64(data[i:i + size]) for i in range(0, len(data), size)
+            ]
+        return self._gen_chunks
+
     async def commit(self, params: dict) -> dict:
         """rpc/core/blocks.go Commit: the COMPLETE signed header — every
         header field and every commit signature — so a light client can
@@ -204,30 +380,10 @@ class Environment:
         meta = self.node.block_store.load_block_meta(height)
         if commit is None or meta is None:
             raise RPCError(-32603, f"commit at height {height} not found")
-        h = meta.header
         return {
             "canonical": True,
             "signed_header": {
-                "header": {
-                    "version": {"block": str(h.version.block), "app": str(h.version.app)},
-                    "chain_id": h.chain_id,
-                    "height": str(h.height),
-                    "time": str(h.time),
-                    "last_block_id": {
-                        "hash": _hex(h.last_block_id.hash),
-                        "parts": {"total": h.last_block_id.part_set_header.total,
-                                  "hash": _hex(h.last_block_id.part_set_header.hash)},
-                    },
-                    "last_commit_hash": _hex(h.last_commit_hash),
-                    "data_hash": _hex(h.data_hash),
-                    "validators_hash": _hex(h.validators_hash),
-                    "next_validators_hash": _hex(h.next_validators_hash),
-                    "consensus_hash": _hex(h.consensus_hash),
-                    "app_hash": _hex(h.app_hash),
-                    "last_results_hash": _hex(h.last_results_hash),
-                    "evidence_hash": _hex(h.evidence_hash),
-                    "proposer_address": _hex(h.proposer_address),
-                },
+                "header": self._header_dict(meta.header),
                 "commit": {
                     "height": str(commit.height),
                     "round": commit.round_,
@@ -539,8 +695,15 @@ class Environment:
             "genesis": self.genesis,
             "block": self.block,
             "block_by_hash": self.block_by_hash,
+            "block_results": self.block_results,
+            "header": self.header,
+            "header_by_hash": self.header_by_hash,
             "blockchain": self.blockchain,
             "commit": self.commit,
+            "consensus_params": self.consensus_params,
+            "dump_consensus_state": self.dump_consensus_state,
+            "check_tx": self.check_tx,
+            "genesis_chunked": self.genesis_chunked,
             "light_block": self.light_block,
             "validators": self.validators,
             "consensus_state": self.consensus_state,

@@ -34,11 +34,15 @@ WS_MAX_MESSAGE = 1 << 21  # aggregate cap across fragments (HTTP has MAX_BODY)
 
 
 class RPCServer(BaseService):
-    def __init__(self, node, config, logger: cmtlog.Logger | None = None):
+    def __init__(self, node, config, logger: cmtlog.Logger | None = None,
+                 env=None):
+        """node may be None when `env` supplies the routes (light proxy) —
+        then logger is required and node-backed extras (metrics endpoint,
+        websocket subscriptions) are disabled."""
         super().__init__("RPC", logger or node.logger.with_fields(module="rpc"))
         self.node = node
         self.config = config
-        self.env = Environment(node)
+        self.env = env if env is not None else Environment(node)
         self.routes = self.env.routes()
         self._server: asyncio.Server | None = None
         self.bound_addr = ""
@@ -226,7 +230,8 @@ class RPCServer(BaseService):
         finally:
             await tasks.cancel_all()
             try:
-                self.node.event_bus.unsubscribe_all(client_id)
+                if getattr(self.node, "event_bus", None) is not None:
+                    self.node.event_bus.unsubscribe_all(client_id)
             except Exception:  # noqa: BLE001
                 pass
 
@@ -235,7 +240,11 @@ class RPCServer(BaseService):
         rid = req.get("id", -1)
         method = req.get("method", "")
         params = req.get("params") or {}
-        bus = self.node.event_bus
+        bus = getattr(self.node, "event_bus", None)
+        if bus is None:
+            await send_json(_err_envelope(
+                rid, -32601, "subscriptions unavailable on this endpoint"))
+            return
         if method == "subscribe":
             query = params.get("query", "")
             if not query:

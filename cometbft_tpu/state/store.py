@@ -66,6 +66,17 @@ class StateStore:
             return None
         return codec._from_jsonable(ResponseFinalizeBlock, json.loads(raw))
 
+    # ---------------------------------------------------- consensus params
+
+    def load_consensus_params(self, height: int):
+        """Consensus params in effect AT `height` (state/store.go
+        LoadConsensusParams). save() writes a CP: row per height holding the
+        state snapshot whose params apply to that height."""
+        raw = self.db.get(_hkey(b"CP:", height))
+        if raw is None:
+            return None
+        return State.from_bytes(raw).consensus_params
+
     # --------------------------------------------------------- validators
 
     def load_validators(self, height: int) -> ValidatorSet | None:

@@ -1,0 +1,157 @@
+"""Light-client proxy daemon (VERDICT r3 item 4; reference
+cmd/cometbft/commands/light.go:30-150 + light/proxy/proxy.go:20-80).
+
+Two tiers:
+  1. live net — a real node + LightProxy: block/header/commit/validators
+     queried THROUGH the proxy match the node's stores byte-for-byte, and
+     passthrough broadcast works;
+  2. forged primary — a primary serving a forked chain behind the proxy is
+     detected by the witness cross-check and the proxy surfaces the attack
+     instead of the forged data.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import urllib.request
+
+import pytest
+
+from cometbft_tpu import light
+from cometbft_tpu.light.proxy import LightProxy, ProxyEnv
+from cometbft_tpu.light.rpc_provider import RPCProvider
+from cometbft_tpu.light.store import LightStore
+from cometbft_tpu.node.node import Node, init_files
+from cometbft_tpu.store import MemDB
+
+from cometbft_tpu.light.provider import MemProvider
+
+from tests.light_harness import LightChain
+
+
+async def _proxy_get(addr: str, route: str) -> dict:
+    def _get():
+        with urllib.request.urlopen(f"http://{addr}/{route}", timeout=10) as r:
+            return json.load(r)
+
+    return await asyncio.to_thread(_get)
+
+
+async def _proxy_post(addr: str, method: str, params: dict) -> dict:
+    body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                       "params": params}).encode()
+
+    def _post():
+        req = urllib.request.Request(
+            f"http://{addr}/", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return json.load(r)
+
+    return await asyncio.to_thread(_post)
+
+
+def test_light_proxy_serves_verified_data(tmp_path):
+    async def main():
+        cfg = init_files(str(tmp_path), chain_id="lpx-chain")
+        cfg.consensus.timeout_commit = 0.05
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        node = Node(cfg)
+        await node.start()
+        proxy = None
+        try:
+            deadline = asyncio.get_running_loop().time() + 30
+            while node.block_store.height() < 6:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+
+            url = f"http://{node.rpc_server.bound_addr}"
+            root = await RPCProvider("lpx-chain", url).light_block(1)
+            client = light.Client(
+                "lpx-chain",
+                light.TrustOptions(
+                    period_ns=3600 * 10**9, height=1, hash_=root.hash()),
+                RPCProvider("lpx-chain", url),
+                [RPCProvider("lpx-chain", url)],
+                LightStore(MemDB()),
+            )
+            proxy = LightProxy(client, url, "tcp://127.0.0.1:0")
+            await proxy.start()
+            addr = proxy.bound_addr
+
+            # verified header through the proxy == node's own header
+            hd = (await _proxy_get(addr, "header?height=5"))["result"]["header"]
+            meta = node.block_store.load_block_meta(5)
+            assert hd["app_hash"] == meta.header.app_hash.hex().upper()
+            assert bytes.fromhex(hd["validators_hash"]) == meta.header.validators_hash
+
+            # block through the proxy: header verified, txs proven
+            blk = (await _proxy_get(addr, "block?height=5"))["result"]
+            assert bytes.fromhex(blk["block_id"]["hash"]) == meta.block_id.hash
+
+            # commit carries every signature of the real commit
+            cm = (await _proxy_get(addr, "commit?height=5"))["result"]
+            real = node.block_store.load_block_commit(5)
+            sigs = cm["signed_header"]["commit"]["signatures"]
+            assert len(sigs) == len(real.signatures)
+            assert base64.b64decode(sigs[0]["signature"]) == real.signatures[0].signature
+
+            # validators match the valset the header committed to
+            vals = (await _proxy_get(addr, "validators?height=5"))["result"]
+            stored = node.state_store.load_validators(5)
+            assert [v["address"] for v in vals["validators"]] == [
+                v.address.hex().upper() for v in stored.validators]
+
+            # status passthrough + light client info
+            st = (await _proxy_get(addr, "status"))["result"]
+            assert st["node_info"]["network"] == "lpx-chain"
+            assert int(st["light_client_info"]["last_trusted_height"]) >= 5
+
+            # unverifiable hash -> error, not data
+            bogus = await _proxy_get(addr, "header_by_hash?hash=" + "ab" * 32)
+            assert "error" in bogus
+
+            # broadcast passthrough preserves JSON param types end-to-end
+            # (base64 tx must reach the primary as base64, not get
+            # re-typed by a URI round-trip)
+            tx_b64 = base64.b64encode(b"proxy-tx=1").decode()
+            bres = await _proxy_post(addr, "broadcast_tx_sync", {"tx": tx_b64})
+            assert bres["result"]["code"] == 0
+            deadline = asyncio.get_running_loop().time() + 15
+            while node.mempool.size() > 0:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+        finally:
+            if proxy is not None:
+                await proxy.stop()
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_light_proxy_rejects_forged_primary():
+    """The primary serves a forked chain; the witness is honest. A query
+    through the proxy triggers the divergence check: the proxy must surface
+    an error (the attack), never the forged block."""
+    async def main():
+        chain = LightChain("lpx-forge", 20, n_vals=4)
+        forked = chain.forked_from(fork_height=11, suffix_heights=10)
+        primary = MemProvider("lpx-forge", forked.blocks, name="liar")
+        witness = MemProvider("lpx-forge", chain.blocks, name="honest")
+        client = light.Client(
+            "lpx-forge",
+            light.TrustOptions(
+                period_ns=10**18, height=1, hash_=chain.blocks[1].hash()),
+            primary, [witness], LightStore(MemDB()),
+        )
+        await client.initialize()
+        env = ProxyEnv(client, "http://127.0.0.1:1")  # primary RPC never hit
+        with pytest.raises(light.ErrLightClientAttack):
+            await env.header({"height": "20"})
+        # detection produced evidence against the primary at the witness
+        assert witness.evidence
+
+    asyncio.run(main())

@@ -1,0 +1,98 @@
+"""RPC route parity against a live node: block_results, header,
+header_by_hash, consensus_params, dump_consensus_state, check_tx,
+genesis_chunked (VERDICT r3 item 3; reference rpc/core/routes.go:12-56).
+
+One node boot serves all routes — each assertion cross-checks the payload
+against the node's own stores, not just shape.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import os
+
+from cometbft_tpu.node import Node, init_files
+
+from tests.test_node import _node_config, _rpc_call, _wait_height
+
+
+def test_rpc_route_parity(tmp_path):
+    home = str(tmp_path / "home")
+    init_files(home, chain_id="parity-chain", moniker="parity0")
+
+    async def main():
+        node = Node(_node_config(home))
+        await node.start()
+        try:
+            addr = node.rpc_server.bound_addr
+
+            # commit a tx so block_results has a non-empty height
+            tx = f"pk-{os.getpid()}=pv".encode()
+            resp = await asyncio.wait_for(_rpc_call(
+                addr, "broadcast_tx_commit",
+                {"tx": base64.b64encode(tx).decode()}), 15)
+            h = int(resp["result"]["height"])
+
+            # block_results: the persisted FinalizeBlock response
+            br = (await _rpc_call(addr, "block_results", {"height": str(h)}))["result"]
+            assert br["height"] == str(h)
+            assert len(br["txs_results"]) == 1
+            assert br["txs_results"][0]["code"] == 0
+            stored = node.state_store.load_finalize_block_response(h)
+            assert br["app_hash"] == stored.app_hash.hex().upper()
+
+            # header / header_by_hash agree with block + each other
+            blk = (await _rpc_call(addr, "block", {"height": str(h)}))["result"]
+            hd = (await _rpc_call(addr, "header", {"height": str(h)}))["result"]["header"]
+            assert hd["height"] == str(h)
+            assert hd["app_hash"] == blk["block"]["header"]["app_hash"]
+            meta = node.block_store.load_block_meta(h)
+            hbh = (await _rpc_call(
+                addr, "header_by_hash",
+                {"hash": meta.block_id.hash.hex()}))["result"]["header"]
+            assert hbh == hd
+
+            # consensus_params at the committed height match state
+            cp = (await _rpc_call(
+                addr, "consensus_params", {"height": str(h)}))["result"]
+            want = node.consensus_state.state.consensus_params
+            assert cp["consensus_params"]["block"]["max_bytes"] == str(
+                want.block.max_bytes)
+            assert cp["consensus_params"]["validator"]["pub_key_types"] == (
+                want.validator.pub_key_types)
+            # default (no height): latest uncommitted
+            cp_latest = (await _rpc_call(addr, "consensus_params", {}))["result"]
+            assert int(cp_latest["block_height"]) >= h
+
+            # dump_consensus_state: own round state advances; peers empty
+            # (single-node net)
+            dcs = (await _rpc_call(addr, "dump_consensus_state", {}))["result"]
+            assert int(dcs["round_state"]["height"]) >= h
+            assert dcs["peers"] == []
+
+            # check_tx runs the app's CheckTx without touching the mempool
+            before = node.mempool.size()
+            ct = (await _rpc_call(
+                addr, "check_tx",
+                {"tx": base64.b64encode(b"cknew=1").decode()}))["result"]
+            assert ct["code"] == 0
+            assert node.mempool.size() == before
+
+            # genesis_chunked reassembles to the exact genesis document
+            chunk0 = (await _rpc_call(addr, "genesis_chunked", {"chunk": 0}))["result"]
+            total = int(chunk0["total"])
+            parts = []
+            for i in range(total):
+                ck = await _rpc_call(addr, "genesis_chunked", {"chunk": i})
+                parts.append(base64.b64decode(ck["result"]["data"]))
+            data = b"".join(parts)
+            assert json.loads(data) == json.loads(node.genesis_doc.to_json())
+            # out-of-range chunk errors
+            bad = await _rpc_call(addr, "genesis_chunked", {"chunk": total})
+            assert "error" in bad
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
