@@ -99,6 +99,12 @@ class KVStoreApplication(abci.BaseApplication):
 
     def finalize_block(self, req: abci.RequestFinalizeBlock) -> abci.ResponseFinalizeBlock:
         staged = dict(self.state)
+        if req.misbehavior:
+            # make Misbehavior deliveries app-observable (queryable via
+            # abci_query) — deterministic: req.misbehavior comes from the
+            # committed block, identical on every node
+            prev = int(staged.get("__misbehavior_count__", "0"))
+            staged["__misbehavior_count__"] = str(prev + len(req.misbehavior))
         results: list[abci.ExecTxResult] = []
         updates: list[abci.ValidatorUpdate] = []
         for tx in req.txs:

@@ -39,8 +39,14 @@ def cmd_init(args) -> int:
 
 
 def cmd_start(args) -> int:
+    import faulthandler
+
     from cometbft_tpu.config import Config
     from cometbft_tpu.node import Node
+
+    # stack dump on demand (SIGUSR1) — the operator analog of the
+    # reference's pprof goroutine dump (cmd/cometbft/commands/debug)
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
 
     home = _home(args)
     config = Config.load(home)

@@ -78,6 +78,9 @@ class RPCConfig:
     max_body_bytes: int = 1_000_000
     max_header_bytes: int = 1 << 20
     pprof_laddr: str = ""
+    # expose the operator control routes (dial_seeds/dial_peers/
+    # unsafe_flush_mempool/unsafe_disconnect_peers; config.go Unsafe)
+    unsafe: bool = False
 
     def validate_basic(self) -> None:
         if self.max_open_connections < 0:
@@ -106,6 +109,13 @@ class P2PConfig:
     addr_book_strict: bool = True
     handshake_timeout: float = 20.0
     dial_timeout: float = 3.0
+    # fault injection for soak testing (config.go:739-740 TestFuzz; knobs
+    # flattened instead of a subtable)
+    test_fuzz: bool = False
+    test_fuzz_prob_drop_rw: float = 0.01
+    test_fuzz_prob_drop_conn: float = 0.003
+    test_fuzz_prob_sleep: float = 0.01
+    test_fuzz_max_delay: float = 0.05
 
     def validate_basic(self) -> None:
         if self.max_num_inbound_peers < 0 or self.max_num_outbound_peers < 0:

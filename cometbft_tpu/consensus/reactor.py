@@ -320,6 +320,13 @@ class ConsensusReactor(Reactor):
                         )
                         if sent:
                             ps.set_has_proposal_block_part(prs.height, prs.round_, index)
+                        else:
+                            # a stopped mconn fails the send WITHOUT ever
+                            # suspending; continuing unthrottled would spin
+                            # the (cooperative) event loop and starve every
+                            # other task — observed as a whole-node freeze
+                            # in the restart-all e2e perturbation
+                            await asyncio.sleep(sleep)
                         continue
 
                 # 2. peer is on an older height: serve committed-block parts

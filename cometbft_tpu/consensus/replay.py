@@ -190,7 +190,7 @@ class Handshaker:
     ) -> State:
         """replay.go:500-530 applyBlock loop: FinalizeBlock+Commit only —
         state is NOT re-saved (it is already correct)."""
-        from cometbft_tpu.state.execution import _abci_commit_info
+        from cometbft_tpu.state.execution import _abci_commit_info, _abci_misbehavior
 
         app_hash = b""
         for h in range(app_height + 1, final_height + 1):
@@ -204,7 +204,9 @@ class Handshaker:
             req = abci.RequestFinalizeBlock(
                 txs=block.data.txs,
                 decided_last_commit=_abci_commit_info(block, last_vals),
-                misbehavior=[],
+                # the app must re-see the block's evidence exactly as it did
+                # live, or a misbehavior-sensitive app forks its own hash
+                misbehavior=_abci_misbehavior(block.evidence.evidence),
                 hash=block.hash(),
                 height=h,
                 time=block.header.time,

@@ -92,6 +92,9 @@ class ConsensusState(BaseService):
 
         self.rs = RoundState()
         self.state: State | None = None
+        # set by _contain_failure when the receive routine dies; surfaced
+        # through /health and /status (rpc/core.py)
+        self.failed = False
 
         # One multiplexed queue of (from_peer, msg) — the analog of the
         # reference's select over peerMsgQueue/internalMsgQueue/tockChan.
@@ -279,7 +282,22 @@ class ConsensusState(BaseService):
                 self.logger.error(
                     "CONSENSUS FAILURE!!!", err=traceback.format_exc()
                 )
+                self._contain_failure()
                 return
+
+    def _contain_failure(self) -> None:
+        """state.go:789-802 containment, made observable: a node whose
+        consensus routine died must not keep looking healthy. Flush+fsync
+        the WAL (evidence of what was seen survives the crash), mark the
+        service failed — /health and /status report it (rpc/core.py) — and
+        let operators decide whether to kill the process; the reference
+        likewise keeps the process up so the WAL/evidence can be pulled."""
+        self.failed = True
+        try:
+            if self.wal is not None:
+                self.wal.flush()
+        except Exception as e:  # noqa: BLE001 - best effort on the way down
+            self.logger.error("WAL flush on consensus failure", err=str(e))
 
     async def _handle_msg(self, msg) -> None:
         if isinstance(msg, M.ProposalMessage):

@@ -107,32 +107,35 @@ class ProxyEnv:
             raise RPCError(-32603, "header not found among trusted light blocks")
         return {"header": header_dict(lb.signed_header.header)}
 
+    @staticmethod
+    def _commit_dict(c) -> dict:
+        return {
+            "height": str(c.height),
+            "round": c.round_,
+            "block_id": {
+                "hash": _hex(c.block_id.hash),
+                "parts": {
+                    "total": c.block_id.part_set_header.total,
+                    "hash": _hex(c.block_id.part_set_header.hash)},
+            },
+            "signatures": [
+                {
+                    "block_id_flag": int(cs.block_id_flag),
+                    "validator_address": _hex(cs.validator_address),
+                    "timestamp": str(cs.timestamp),
+                    "signature": _b64(cs.signature) if cs.signature else None,
+                }
+                for cs in c.signatures
+            ],
+        }
+
     async def commit(self, params: dict) -> dict:
         lb = await self._verified(params)
-        c = lb.signed_header.commit
         return {
             "canonical": True,
             "signed_header": {
                 "header": header_dict(lb.signed_header.header),
-                "commit": {
-                    "height": str(c.height),
-                    "round": c.round_,
-                    "block_id": {
-                        "hash": _hex(c.block_id.hash),
-                        "parts": {
-                            "total": c.block_id.part_set_header.total,
-                            "hash": _hex(c.block_id.part_set_header.hash)},
-                    },
-                    "signatures": [
-                        {
-                            "block_id_flag": int(cs.block_id_flag),
-                            "validator_address": _hex(cs.validator_address),
-                            "timestamp": str(cs.timestamp),
-                            "signature": _b64(cs.signature) if cs.signature else None,
-                        }
-                        for cs in c.signatures
-                    ],
-                },
+                "commit": self._commit_dict(lb.signed_header.commit),
             },
         }
 
@@ -157,7 +160,12 @@ class ProxyEnv:
     async def block(self, params: dict) -> dict:
         """Raw block from the primary, proven against the verified header:
         served header = verified header; primary txs must hash to its
-        data_hash (light/rpc/client.go Block + validateBlock shape)."""
+        data_hash; last_commit is the VERIFIED commit for height-1 (block
+        h's last_commit IS the canonical commit for h-1, which the light
+        chain already carries), cross-checked against last_commit_hash.
+        Evidence entries pass through as unverified summaries (they are
+        summaries on the node RPC too; the evidence_hash in the verified
+        header is the authoritative statement)."""
         lb = await self._verified(params)
         raw = await self.primary.call("block", {"height": str(lb.height)})
         txs = [base64.b64decode(t) for t in raw["block"]["data"]["txs"]]
@@ -168,11 +176,23 @@ class ProxyEnv:
                 -32603,
                 f"primary returned txs not matching the verified data_hash "
                 f"at height {lb.height} (got {got.hex()}, want {want.hex()})")
+        last_commit = None
+        if lb.height > 1:
+            prev = await self.client.verify_light_block_at_height(lb.height - 1)
+            c = prev.signed_header.commit
+            if c.hash() != lb.signed_header.header.last_commit_hash:
+                raise RPCError(
+                    -32603,
+                    f"verified commit for {lb.height - 1} does not hash to "
+                    f"the verified header's last_commit_hash")
+            last_commit = self._commit_dict(c)
         return {
             "block_id": {"hash": _hex(lb.signed_header.header.hash())},
             "block": {
                 "header": header_dict(lb.signed_header.header),
                 "data": {"txs": [_b64(t) for t in txs]},
+                "evidence": raw["block"].get("evidence", {"evidence": []}),
+                "last_commit": last_commit,
             },
         }
 

@@ -182,9 +182,19 @@ class Node(BaseService):
         self.p2p_metrics = cmtmetrics.P2PMetrics(self.metrics_registry)
         self.mempool.metrics = self.mempool_metrics
 
+        # background pruning honoring app/companion retain heights
+        # (node.go:263-524 createPruner; state/pruner.go)
+        from cometbft_tpu.state.pruner import Pruner
+
+        self.pruner = Pruner(
+            self.state_store, self.block_store,
+            tx_indexer=self.tx_indexer, block_indexer=self.block_indexer,
+            logger=self.logger.with_fields(module="pruner"),
+        )
+
         self.block_exec = BlockExecutor(
             self.state_store, None, self.mempool, evidence_pool=self.evidence_pool,
-            event_bus=self.event_bus,
+            event_bus=self.event_bus, pruner=self.pruner,
         )
         wal = WAL(os.path.join(config.wal_path(), "wal"))
         self.consensus_state = ConsensusState(
@@ -273,9 +283,20 @@ class Node(BaseService):
             moniker=config.base.moniker,
             rpc_address=config.rpc.laddr,
         )
+        fuzz_cfg = None
+        if config.p2p.test_fuzz:
+            from cometbft_tpu.p2p.fuzz import FuzzConnConfig
+
+            fuzz_cfg = FuzzConnConfig(
+                prob_drop_rw=config.p2p.test_fuzz_prob_drop_rw,
+                prob_drop_conn=config.p2p.test_fuzz_prob_drop_conn,
+                prob_sleep=config.p2p.test_fuzz_prob_sleep,
+                max_delay=config.p2p.test_fuzz_max_delay,
+            )
         self.transport = Transport(
             self.node_key, self.node_info,
             logger=self.logger.with_fields(module="p2p"),
+            fuzz_config=fuzz_cfg,
         )
         self.switch = Switch(
             self.transport,
@@ -324,6 +345,7 @@ class Node(BaseService):
         """node.go:527 OnStart."""
         if self.indexer_service is not None:
             await self.indexer_service.start()
+        await self.pruner.start()
 
         # bridge the consensus fast-path EventSwitch into the async EventBus
         # so RPC subscribers see round transitions (state.go:129-131 dual
@@ -411,6 +433,8 @@ class Node(BaseService):
             await self.rpc_server.stop()
         await self.switch.stop()
         await self.proxy_app.stop()
+        if self.pruner.is_running:
+            await self.pruner.stop()
         if self.indexer_service is not None and self.indexer_service.is_running:
             await self.indexer_service.stop()
         for db in (self.block_store.db, self.state_store.db, self._evidence_db,

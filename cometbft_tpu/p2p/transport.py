@@ -45,12 +45,16 @@ class Transport:
         node_key: NodeKey,
         node_info: NodeInfo,
         logger: cmtlog.Logger | None = None,
+        fuzz_config=None,
     ):
         self.node_key = node_key
         self.node_info = node_info
         self.logger = logger or cmtlog.nop()
         self._server: asyncio.Server | None = None
         self._accept_queue: asyncio.Queue[UpgradedConn] = asyncio.Queue(64)
+        # p2p.FuzzConnConfig | None: wrap every raw conn in the fault
+        # injector before upgrade (transport.go:221-223 TestFuzz)
+        self.fuzz_config = fuzz_config
 
     # ------------------------------------------------------------- listen
 
@@ -108,6 +112,10 @@ class Transport:
         outbound: bool,
         expect_id: str,
     ) -> UpgradedConn:
+        if self.fuzz_config is not None:
+            from cometbft_tpu.p2p.fuzz import fuzz_streams
+
+            reader, writer = fuzz_streams(reader, writer, self.fuzz_config)
         sconn = await SecretConnection.make(reader, writer, self.node_key.priv_key)
         authed_id = node_id_from_pubkey(sconn.remote_pubkey)
         if expect_id and authed_id != expect_id:

@@ -81,6 +81,12 @@ class Environment:
     # ------------------------------------------------------------- info
 
     async def health(self, _params: dict) -> dict:
+        """Errors (not an empty OK) once the consensus routine has died —
+        a validator that stopped committing must not answer healthy
+        (ref consensus/state.go:789-802 containment)."""
+        cs = getattr(self.node, "consensus_state", None)
+        if cs is not None and getattr(cs, "failed", False):
+            raise RPCError(-32603, "consensus failure: receive routine dead")
         return {}
 
     async def status(self, _params: dict) -> dict:
@@ -107,6 +113,8 @@ class Environment:
                 "earliest_block_height": str(earliest),
                 "earliest_block_hash": _hex(emeta.block_id.hash) if emeta else "",
                 "catching_up": n.consensus_reactor.wait_sync,
+                "consensus_failed": bool(
+                    getattr(n.consensus_state, "failed", False)),
             },
             "validator_info": {
                 "address": _hex(pub_key.address()) if pub_key else "",
@@ -167,6 +175,16 @@ class Environment:
                 "proposer_address": _hex(block.header.proposer_address),
             },
             "data": {"txs": [_b64(tx) for tx in block.data.txs]},
+            "evidence": {"evidence": [
+                {
+                    "type": type(ev).__name__,
+                    "height": str(ev.height()),
+                    "validator_addresses": [
+                        d["validator_address"].hex().upper()
+                        for d in ev.abci()],
+                }
+                for ev in block.evidence.evidence
+            ]},
             "last_commit": {
                 "height": str(block.last_commit.height),
                 "round": block.last_commit.round_,
@@ -684,10 +702,71 @@ class Environment:
             self.node.evidence_pool.add_evidence(ev)
         return {"hash": _hex(evs[0].hash()) if evs else ""}
 
+    # ------------------------------------------------------ unsafe routes
+
+    @staticmethod
+    def _addr_list(value) -> list[str]:
+        """JSON body sends a real list; the URI handler sends one string
+        (comma-separated) — list() on a str would explode it into
+        characters."""
+        if isinstance(value, str):
+            return [a for a in value.split(",") if a]
+        return [str(a) for a in (value or [])]
+
+    @staticmethod
+    def _bool_param(value) -> bool:
+        if isinstance(value, str):
+            return value.strip().lower() in ("1", "true", "t", "yes")
+        return bool(value)
+
+    async def unsafe_dial_seeds(self, params: dict) -> dict:
+        """rpc/core/net.go:42 UnsafeDialSeeds."""
+        seeds = self._addr_list(params.get("seeds"))
+        if not seeds:
+            raise RPCError(-32602, "no seeds provided")
+        await self.node.switch.dial_peers_async(seeds)
+        return {"log": f"dialing seeds: {seeds}"}
+
+    async def unsafe_dial_peers(self, params: dict) -> dict:
+        """rpc/core/net.go:55 UnsafeDialPeers."""
+        peers = self._addr_list(params.get("peers"))
+        if not peers:
+            raise RPCError(-32602, "no peers provided")
+        persistent = self._bool_param(params.get("persistent", False))
+        await self.node.switch.dial_peers_async(peers, persistent=persistent)
+        return {"log": f"dialing peers: {peers}"}
+
+    async def unsafe_flush_mempool(self, _params: dict) -> dict:
+        await self.node.mempool.flush()
+        return {}
+
+    async def unsafe_disconnect_peers(self, _params: dict) -> dict:
+        """Framework extension (the e2e 'disconnect' perturbation,
+        test/e2e/runner/perturb.go:44-100 severs the container network;
+        process-level nets sever here instead): drop every current peer
+        conn. Persistent peers redial on their own backoff."""
+        sw = self.node.switch
+        peers = list(sw.peers.values())
+        for p in peers:
+            await sw.stop_peer_for_error(p, "unsafe_disconnect_peers")
+        return {"disconnected": len(peers)}
+
     # ------------------------------------------------------------ table
 
     def routes(self) -> dict:
-        """routes.go:12-56."""
+        """routes.go:12-56 (+ AddUnsafeRoutes when config.rpc.unsafe)."""
+        table = self._routes_table()
+        cfg = getattr(self.node, "config", None)
+        if cfg is not None and getattr(cfg.rpc, "unsafe", False):
+            table.update({
+                "dial_seeds": self.unsafe_dial_seeds,
+                "dial_peers": self.unsafe_dial_peers,
+                "unsafe_flush_mempool": self.unsafe_flush_mempool,
+                "unsafe_disconnect_peers": self.unsafe_disconnect_peers,
+            })
+        return table
+
+    def _routes_table(self) -> dict:
         return {
             "health": self.health,
             "status": self.status,

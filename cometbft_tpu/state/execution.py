@@ -121,6 +121,7 @@ class BlockExecutor:
         evidence_pool=None,
         event_bus=None,
         logger: cmtlog.Logger | None = None,
+        pruner=None,
     ):
         self.state_store = state_store
         self.app_conn = app_conn
@@ -128,6 +129,7 @@ class BlockExecutor:
         self.evidence_pool = evidence_pool
         self.event_bus = event_bus
         self.logger = logger or cmtlog.nop()
+        self.pruner = pruner  # state.Pruner | None, set by node assembly
 
     # ------------------------------------------------------------ propose
 
@@ -311,7 +313,15 @@ class BlockExecutor:
         if self.event_bus is not None:
             await self._fire_events(block, block_id, resp)
 
-        new_state.retain_height = getattr(commit_resp, "retain_height", 0)  # advisory
+        new_state.retain_height = getattr(commit_resp, "retain_height", 0)
+        if self.pruner is not None and new_state.retain_height > 0:
+            # execution.go:305: hand the app's retain height to the pruner
+            # service; actual deletion happens on its own cadence
+            try:
+                self.pruner.set_application_block_retain_height(
+                    new_state.retain_height)
+            except ValueError as e:
+                self.logger.error("app retain height rejected", err=str(e))
         return new_state
 
     def _update_state(

@@ -88,12 +88,38 @@ class StateStore:
         blocksync/statesync backfill and test fixtures."""
         self.db.set(_hkey(b"V:", height), _valset_bytes(vals))
 
+    # ----------------------------------------------------- retain heights
+    # Persisted so the pruner service resumes where it left off across
+    # restarts (state/pruner.go keys; monotonicity enforced by the pruner).
+
+    def save_retain_height(self, which: str, height: int) -> None:
+        self.db.set(b"RH:" + which.encode(), height.to_bytes(8, "big"))
+
+    def load_retain_height(self, which: str) -> int:
+        raw = self.db.get(b"RH:" + which.encode())
+        return int.from_bytes(raw, "big") if raw is not None else 0
+
     # ------------------------------------------------------------- prune
 
-    def prune_states(self, retain_height: int) -> int:
+    def prune_abci_responses(self, retain_height: int) -> int:
+        """Delete FinalizeBlock responses below retain_height only (the
+        ABCI-results retain height moves independently of state rows,
+        state/pruner.go:201-222)."""
         pruned = 0
         pairs: list[tuple[bytes, bytes | None]] = []
-        for prefix in (b"V:", b"CP:", b"FBR:"):
+        for k, _ in list(self.db.iterate(b"FBR:", _hkey(b"FBR:", retain_height))):
+            pairs.append((k, None))
+            pruned += 1
+        self.db.batch_set(pairs)
+        return pruned
+
+    def prune_states(self, retain_height: int) -> int:
+        """Valset + params rows below retain_height. FinalizeBlock
+        responses are NOT touched here — they live under the independent
+        ABCI-results retain height (prune_abci_responses)."""
+        pruned = 0
+        pairs: list[tuple[bytes, bytes | None]] = []
+        for prefix in (b"V:", b"CP:"):
             for k, _ in list(self.db.iterate(prefix, _hkey(prefix, retain_height))):
                 pairs.append((k, None))
                 pruned += 1

@@ -87,6 +87,28 @@ class TxIndexer:
         raw = self.db.get(b"TX:" + hash_)
         return TxResult.from_json(raw) if raw is not None else None
 
+    def prune(self, retain_height: int) -> int:
+        """Delete index rows for txs below retain_height (the pruner
+        service's analog of kv.go pruning). The TXE event rows embed the
+        height two path segments from the end; a full-prefix scan per pass
+        is acceptable at the pruner's cadence."""
+        pairs: list[tuple[bytes, bytes | None]] = []
+        pruned = 0
+        end = f"TXH:{retain_height:020d}".encode()
+        for k, v in list(self.db.iterate(b"TXH:", end)):
+            pairs.append((k, None))
+            pairs.append((b"TX:" + v, None))
+            pruned += 1
+        for k, _ in list(self.db.iterate(b"TXE:", b"TXE;")):
+            try:
+                h = int(k.decode().rsplit("/", 2)[-2])
+            except (ValueError, IndexError):
+                continue
+            if h < retain_height:
+                pairs.append((k, None))
+        self.db.batch_set(pairs)
+        return pruned
+
     def search(self, query: str | pubsub.Query, limit: int = 100) -> list[TxResult]:
         """kv.go Search: intersect per-condition hash sets; tx.hash short-
         circuits; ranged height conditions scan the TXH index."""
@@ -174,6 +196,23 @@ class BlockIndexer:
     def has(self, height: int) -> bool:
         return self.db.has(f"BLH:{height:020d}".encode())
 
+    def prune(self, retain_height: int) -> int:
+        """Delete block-event index rows below retain_height."""
+        pairs: list[tuple[bytes, bytes | None]] = []
+        pruned = 0
+        end = f"BLH:{retain_height:020d}".encode()
+        for k, _ in list(self.db.iterate(b"BLH:", end)):
+            pairs.append((k, None))
+            pruned += 1
+        for k, v in list(self.db.iterate(b"BLE:", b"BLE;")):
+            try:
+                if int(v) < retain_height:
+                    pairs.append((k, None))
+            except ValueError:
+                continue
+        self.db.batch_set(pairs)
+        return pruned
+
     def search(self, query: str | pubsub.Query, limit: int = 100) -> list[int]:
         q = query if isinstance(query, pubsub.Query) else pubsub.Query(query)
         sets: list[set[int]] = []
@@ -211,6 +250,9 @@ class NullTxIndexer:
 
     def search(self, query, limit: int = 100) -> list:
         return []
+
+    def prune(self, retain_height: int) -> int:
+        return 0
 
 
 class IndexerService(BaseService):

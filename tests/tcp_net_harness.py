@@ -97,6 +97,7 @@ async def make_tcp_node(
     priv,
     gdoc: GenesisDoc,
     config: ConsensusConfig,
+    fuzz_config=None,
 ) -> TcpNode:
     state = State.from_genesis(gdoc)
     app = KVStoreApplication()
@@ -126,7 +127,7 @@ async def make_tcp_node(
     info = NodeInfo(
         node_id=node_key.id(), network=gdoc.chain_id, version="dev", moniker=name,
     )
-    transport = Transport(node_key, info)
+    transport = Transport(node_key, info, fuzz_config=fuzz_config)
     # tight mconn config for tests: fast pings, generous rate
     switch = Switch(transport, mconn_config=MConnConfig(
         send_rate=50_000_000, recv_rate=50_000_000, ping_interval=5.0, pong_timeout=10.0,
@@ -145,6 +146,7 @@ async def make_tcp_net(
     n_vals: int = 4,
     config: ConsensusConfig | None = None,
     chain_id: str = "tcp-test-chain",
+    fuzz_config=None,
 ) -> TcpNet:
     privs = [ed25519.gen_priv_key() for _ in range(n_vals)]
     gdoc = GenesisDoc(
@@ -159,6 +161,7 @@ async def make_tcp_net(
     net = TcpNet(privs=privs, chain_id=chain_id)
     cfg = config or make_test_config()
     for i in range(n_vals):
-        node = await make_tcp_node(f"val{i}", privs[i], gdoc, cfg)
+        node = await make_tcp_node(f"val{i}", privs[i], gdoc, cfg,
+                                   fuzz_config=fuzz_config)
         net.nodes.append(node)
     return net

@@ -1,0 +1,48 @@
+"""Consensus-failure containment (VERDICT r3 item 8; reference
+consensus/state.go:789-802): when the receive routine dies, the node must
+not keep answering healthy — /health errors, /status carries the flag, and
+the WAL is flushed so the failure's evidence survives.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from cometbft_tpu.consensus import messages as M
+from cometbft_tpu.node import Node, init_files
+
+from tests.test_node import _node_config, _rpc_call
+
+
+def test_consensus_failure_flips_health(tmp_path):
+    home = str(tmp_path / "home")
+    init_files(home, chain_id="cfail-chain", moniker="cf0")
+
+    async def main():
+        node = Node(_node_config(home))
+        await node.start()
+        try:
+            addr = node.rpc_server.bound_addr
+            # healthy first
+            ok = await _rpc_call(addr, "health")
+            assert "error" not in ok
+
+            # poison pill: a VoteMessage whose vote is garbage explodes
+            # inside _handle_msg -> CONSENSUS FAILURE path
+            await node.consensus_state.msg_queue.put(
+                ("", M.VoteMessage(vote=None)))
+            deadline = asyncio.get_running_loop().time() + 10
+            while not node.consensus_state.failed:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.02)
+
+            # the node stops committing but keeps serving RPC — and says so
+            unhealthy = await _rpc_call(addr, "health")
+            assert "error" in unhealthy
+            assert "consensus failure" in unhealthy["error"]["message"]
+            st = await _rpc_call(addr, "status")
+            assert st["result"]["sync_info"]["consensus_failed"] is True
+        finally:
+            await node.stop()
+
+    asyncio.run(main())

@@ -1,0 +1,120 @@
+"""Pruner service: background retain-height pruning (VERDICT r3 item 9;
+reference state/pruner.go:17-140) + FuzzedConnection soak
+(p2p/fuzz.go:12-67): a reactor net keeps committing under random
+drop/delay/kill fault injection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.state.pruner import Pruner
+
+from tests.test_blocksync import build_chain
+
+
+def test_pruner_prunes_to_min_retain_height():
+    async def main():
+        _, _, state_store, block_store = await build_chain(10)
+        p = Pruner(state_store, block_store, interval=0.02,
+                   companion_enabled=True)
+
+        # nothing prunes until BOTH sides have spoken (companion enabled)
+        p.set_application_block_retain_height(8)
+        assert p.prune_once() == (0, 0)
+        assert block_store.base() == 1
+
+        # companion lags: min(8, 5) = 5 drives the pass
+        p.set_companion_block_retain_height(5)
+        blocks, _ = p.prune_once()
+        assert blocks == 4  # heights 1..4
+        assert block_store.base() == 5
+        assert block_store.load_block(4) is None
+        assert block_store.load_block(5) is not None
+        # state rows below 5 went too
+        assert state_store.load_validators(4) is None
+        assert state_store.load_validators(6) is not None
+        # ...but FinalizeBlock responses did NOT (independent retain height)
+        assert state_store.load_finalize_block_response(2) is not None
+
+        # ABCI results prune on their own height
+        assert state_store.load_finalize_block_response(6) is not None
+        p.set_abci_res_retain_height(7)
+        _, res = p.prune_once()
+        assert res > 0
+        assert state_store.load_finalize_block_response(6) is None
+        assert state_store.load_finalize_block_response(7) is not None
+
+        # tx/block indexers prune with the block retain height
+        from cometbft_tpu.state.txindex import BlockIndexer, TxIndexer, TxResult
+        from cometbft_tpu.abci.types import ExecTxResult
+        from cometbft_tpu.store import MemDB
+
+        txi, bli = TxIndexer(MemDB()), BlockIndexer(MemDB())
+        for h in range(1, 10):
+            txi.index(TxResult(height=h, index=0, tx=b"t%d" % h,
+                               result=ExecTxResult()))
+            bli.index(h, [])
+        p_idx = Pruner(state_store, block_store, tx_indexer=txi,
+                       block_indexer=bli, companion_enabled=True)
+        p_idx.set_application_block_retain_height(8)
+        p_idx.set_companion_block_retain_height(8)
+        p_idx.prune_once()
+        from cometbft_tpu.types.block import tx_hash
+        assert txi.get(tx_hash(b"t3")) is None
+        assert txi.get(tx_hash(b"t8")) is not None
+        assert not bli.has(5) and bli.has(8)
+
+        # monotonicity + bounds (pruner.go:139-199)
+        with pytest.raises(ValueError):
+            p.set_application_block_retain_height(6)  # lower than current
+        with pytest.raises(ValueError):
+            p.set_application_block_retain_height(12)  # beyond top + 1
+
+        # heights persist across a service restart
+        p2 = Pruner(state_store, block_store, companion_enabled=True)
+        assert p2.get_block_retain_height() == 8
+        assert p2.get_abci_res_retain_height() == 7
+
+    asyncio.run(main())
+
+
+def test_fuzzed_net_still_commits():
+    """Soak: a 4-validator real-TCP net with FuzzedConnection fault
+    injection (write drops, random delays, conn kills) still commits —
+    reconnect/backoff and the consensus retry paths absorb the faults."""
+    from cometbft_tpu.p2p.fuzz import FuzzConnConfig
+
+    from tests.tcp_net_harness import make_tcp_net
+
+    async def main():
+        fuzz = FuzzConnConfig(
+            prob_drop_rw=0.005, prob_drop_conn=0.002, prob_sleep=0.02,
+            max_delay=0.02, arm_after=1.0)
+        net = await make_tcp_net(4, chain_id="fuzz-chain", fuzz_config=fuzz)
+        await net.start()
+        try:
+            await net.wait_for_height(4, timeout=90)
+        finally:
+            await net.stop()
+
+    asyncio.run(main())
+
+
+def test_pruner_service_runs_in_background():
+    async def main():
+        _, _, state_store, block_store = await build_chain(8)
+        p = Pruner(state_store, block_store, interval=0.01)
+        await p.start()
+        try:
+            p.set_application_block_retain_height(6)
+            deadline = asyncio.get_running_loop().time() + 5
+            while block_store.base() < 6:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.01)
+        finally:
+            await p.stop()
+
+    asyncio.run(main())
