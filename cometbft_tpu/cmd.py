@@ -237,6 +237,40 @@ def cmd_light(args) -> int:
     return 0
 
 
+def cmd_loadtime(args) -> int:
+    """test/loadtime analog: 'run' drives stamped-tx load at RPC
+    endpoints; 'report' recomputes per-tx latency from committed blocks."""
+    from cometbft_tpu import loadtime
+
+    if args.mode == "run":
+        endpoints = [e for e in args.endpoints.split(",") if e]
+        exp_id, res = asyncio.run(loadtime.generate_load(
+            endpoints, rate=args.rate, duration=args.duration,
+            size=args.size, method=args.method))
+        print(json.dumps({
+            "experiment_id": exp_id, "sent": res.sent,
+            "accepted": res.accepted, "rejected": res.rejected,
+            "errors": res.errors,
+        }))
+        return 0
+    # report
+    if args.endpoints:
+        url = args.endpoints.split(",")[0]
+        blocks = loadtime.blocks_from_rpc(url)
+    else:
+        from cometbft_tpu.config import Config
+        from cometbft_tpu.store import BlockStore
+        from cometbft_tpu.store.db import open_db
+
+        cfg = Config.load(_home(args))
+        bs = BlockStore(open_db(cfg.base.db_backend, cfg.db_path("blockstore")))
+        blocks = loadtime.blocks_from_store(bs)
+    reports = loadtime.report_from_blocks(blocks)
+    for rep in reports.values():
+        print(json.dumps(rep.stats()))
+    return 0
+
+
 def cmd_version(_args) -> int:
     print(VERSION)
     return 0
@@ -292,6 +326,18 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("--laddr", default="tcp://127.0.0.1:8888",
                     help="proxy listen address")
     sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser("loadtime", help="tx load generator + latency report")
+    sp.add_argument("mode", choices=["run", "report"])
+    sp.add_argument("--endpoints", default="",
+                    help="comma-separated RPC URLs (report falls back to "
+                         "the node home's blockstore when empty)")
+    sp.add_argument("--rate", type=float, default=100.0, help="tx/s")
+    sp.add_argument("--duration", type=float, default=10.0, help="seconds")
+    sp.add_argument("--size", type=int, default=256, help="tx bytes")
+    sp.add_argument("--method", default="broadcast_tx_async",
+                    choices=["broadcast_tx_async", "broadcast_tx_sync"])
+    sp.set_defaults(fn=cmd_loadtime)
 
     sp = sub.add_parser("show-node-id")
     sp.set_defaults(fn=cmd_show_node_id)

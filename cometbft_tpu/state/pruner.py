@@ -137,6 +137,12 @@ class Pruner(BaseService):
             if blocks:
                 self.logger.info("pruned blocks", to_height=retain, n=blocks)
         res_retain = self.state_store.load_retain_height(ABCI_RES_RETAIN)
+        if res_retain == 0 and not self.companion_enabled:
+            # no companion and no explicit ABCI-results height: follow the
+            # block retain height so finalize responses cannot grow
+            # unboundedly (framework policy; the reference leaves results
+            # pruning entirely to the pruning-service API)
+            res_retain = retain
         if res_retain > 0:
             responses = self.state_store.prune_abci_responses(res_retain)
         self.blocks_pruned += blocks
