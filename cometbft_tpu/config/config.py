@@ -173,6 +173,16 @@ class StorageConfig:
 
 
 @dataclass
+class GRPCConfig:
+    """config.go:520-543 GRPCConfig: the gRPC service surface. Empty
+    addresses disable the listeners. The pruning (data-companion) service
+    is only ever served on the privileged listener."""
+
+    laddr: str = ""
+    privileged_laddr: str = ""
+
+
+@dataclass
 class TxIndexConfig:
     """config.go:1279-1302."""
 
@@ -209,6 +219,7 @@ class Config:
     base: BaseConfig = field(default_factory=BaseConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     rpc: RPCConfig = field(default_factory=RPCConfig)
+    grpc: GRPCConfig = field(default_factory=GRPCConfig)
     p2p: P2PConfig = field(default_factory=P2PConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
@@ -255,6 +266,7 @@ class Config:
         ("base", ""),  # base fields live at top level, like the reference
         ("crypto", "crypto"),
         ("rpc", "rpc"),
+        ("grpc", "grpc"),
         ("p2p", "p2p"),
         ("mempool", "mempool"),
         ("consensus", "consensus"),

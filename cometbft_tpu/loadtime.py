@@ -40,7 +40,8 @@ def make_tx(experiment_id: str, seq: int, size: int, rate: float,
     }
     body = PREFIX + json.dumps(doc, separators=(",", ":")).encode()
     if len(body) < size:
-        body += b"/" + secrets.token_hex((size - len(body) - 1) // 2).encode()
+        pad = size - len(body) - 1
+        body += b"/" + secrets.token_hex((pad + 1) // 2).encode()[:pad]
     return body
 
 

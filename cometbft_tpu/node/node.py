@@ -189,6 +189,9 @@ class Node(BaseService):
         self.pruner = Pruner(
             self.state_store, self.block_store,
             tx_indexer=self.tx_indexer, block_indexer=self.block_indexer,
+            # a configured privileged gRPC listener means a data companion
+            # may set retain heights — the pruner must then honor them
+            companion_enabled=bool(config.grpc.privileged_laddr),
             logger=self.logger.with_fields(module="pruner"),
         )
 
@@ -338,6 +341,8 @@ class Node(BaseService):
             self.switch.add_reactor("PEX", self.pex_reactor)
 
         self.rpc_server = None  # attached on start when rpc.laddr set
+        self.grpc_server = None
+        self.grpc_priv_server = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -402,6 +407,25 @@ class Node(BaseService):
             self.rpc_server = RPCServer(self, self.config.rpc)
             await self.rpc_server.start()
 
+        # gRPC service surface (node.go:527 + rpc/grpc/server; disabled
+        # unless configured)
+        if self.config.grpc.laddr:
+            from cometbft_tpu.rpc import grpc_services as gs
+
+            self.grpc_server, self.grpc_bound = gs.serve(
+                [gs.VersionService(), gs.BlockService(self.block_store),
+                 gs.BlockResultsService(self.state_store, self.block_store)],
+                self.config.grpc.laddr)
+            self.logger.info("gRPC services listening", addr=self.grpc_bound)
+        if self.config.grpc.privileged_laddr:
+            from cometbft_tpu.rpc import grpc_services as gs
+
+            self.grpc_priv_server, self.grpc_priv_bound = gs.serve(
+                [gs.PruningService(self.pruner)],
+                self.config.grpc.privileged_laddr)
+            self.logger.info("privileged gRPC listening",
+                             addr=self.grpc_priv_bound)
+
     async def _run_statesync(self) -> None:
         """node.go startStateSync: sync, persist, hand off to blocksync."""
         try:
@@ -431,6 +455,12 @@ class Node(BaseService):
             self._statesync_task.cancel()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
+        import asyncio as _asyncio
+
+        for srv in (self.grpc_server, self.grpc_priv_server):
+            if srv is not None:
+                # wait for drain so a restart can rebind the same port
+                await _asyncio.to_thread(srv.stop(grace=0.5).wait)
         await self.switch.stop()
         await self.proxy_app.stop()
         if self.pruner.is_running:

@@ -1,0 +1,293 @@
+"""gRPC RPC services: version / block / block-results / pruning.
+
+Reference: rpc/grpc/server/services/{versionservice,blockservice,
+blockresultservice,pruningservice} — a gRPC surface beside the JSON-RPC
+server, with the pruning (data-companion) service on a separate
+PRIVILEGED listener (config.go:520-543 GRPCConfig/GRPCPrivilegedConfig).
+
+Transport follows abci/grpc.py: unary methods on grpc's generic-handler
+API with the framework's JSON encoding (no generated stubs; a documented
+delta from the reference's proto wire). GetLatestHeight is a server
+stream, as in the reference (blockservice/service.go:98): it yields a
+height whenever the store head advances.
+
+Service names:
+  cometbft_tpu.rpc.VersionService / GetVersion
+  cometbft_tpu.rpc.BlockService   / GetByHeight, GetLatest,
+                                    GetLatestHeight (stream)
+  cometbft_tpu.rpc.BlockResultsService / GetBlockResults
+  cometbft_tpu.rpc.PruningService (privileged) /
+      SetBlockRetainHeight, GetBlockRetainHeight,
+      SetBlockResultsRetainHeight, GetBlockResultsRetainHeight,
+      SetTxIndexerRetainHeight, GetTxIndexerRetainHeight,
+      SetBlockIndexerRetainHeight, GetBlockIndexerRetainHeight
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from concurrent import futures
+
+import grpc
+
+from cometbft_tpu import version as ver
+from cometbft_tpu.state import pruner as pruner_mod
+
+
+def _ident(b: bytes) -> bytes:
+    return b
+
+
+# long-lived streams each hold one thread-pool worker; bound them so idle
+# subscribers can never starve the unary RPCs sharing the executor
+_MAX_STREAMS = 4
+_stream_slots = None  # initialized lazily (threading.BoundedSemaphore)
+
+
+class _JsonServicer:
+    """Maps /<service>/<Method> onto self.<snake_case Method>(dict)->dict.
+    Only methods listed in rpc_methods / stream_methods are reachable —
+    never arbitrary attributes (untrusted input picks the method name)."""
+
+    service_name = ""
+    rpc_methods: frozenset[str] = frozenset()
+    stream_methods: frozenset[str] = frozenset()
+
+    def service(self, handler_call_details):
+        path = handler_call_details.method
+        service, _, method = path.lstrip("/").partition("/")
+        if service != self.service_name:
+            return None
+        snake = "".join(
+            ("_" + c.lower()) if c.isupper() else c for c in method
+        ).lstrip("_")
+        if method in self.rpc_methods:
+            fn = getattr(self, snake)
+
+            def unary(request: bytes, context) -> bytes:
+                try:
+                    out = fn(json.loads(request or b"{}"))
+                except KeyError as e:
+                    context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+                except ValueError as e:
+                    context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                return json.dumps(out).encode()
+
+            return grpc.unary_unary_rpc_method_handler(
+                unary, request_deserializer=_ident,
+                response_serializer=_ident)
+        if method in self.stream_methods:
+            sfn = getattr(self, "stream_" + snake)
+
+            def streaming(request: bytes, context):
+                import threading
+
+                global _stream_slots
+                if _stream_slots is None:
+                    _stream_slots = threading.BoundedSemaphore(_MAX_STREAMS)
+                if not _stream_slots.acquire(blocking=False):
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        f"too many concurrent streams (max {_MAX_STREAMS})")
+                try:
+                    for out in sfn(json.loads(request or b"{}"), context):
+                        yield json.dumps(out).encode()
+                finally:
+                    _stream_slots.release()
+
+            return grpc.unary_stream_rpc_method_handler(
+                streaming, request_deserializer=_ident,
+                response_serializer=_ident)
+        return None
+
+
+class VersionService(_JsonServicer):
+    service_name = "cometbft_tpu.rpc.VersionService"
+    rpc_methods = frozenset({"GetVersion"})
+
+    def get_version(self, _req: dict) -> dict:
+        return {
+            "node": ver.CMTSemVer,
+            "abci": ver.ABCIVersion,
+            "p2p": ver.P2PProtocol,
+            "block": ver.BlockProtocol,
+        }
+
+
+class BlockService(_JsonServicer):
+    service_name = "cometbft_tpu.rpc.BlockService"
+    rpc_methods = frozenset({"GetByHeight", "GetLatest"})
+    stream_methods = frozenset({"GetLatestHeight"})
+
+    def __init__(self, block_store):
+        self.block_store = block_store
+
+    def _block_payload(self, height: int) -> dict:
+        meta = self.block_store.load_block_meta(height)
+        block = self.block_store.load_block(height)
+        if meta is None or block is None:
+            raise KeyError(f"block at height {height} not found")
+        return {
+            "block_id": {
+                "hash": meta.block_id.hash.hex(),
+                "part_set_header": {
+                    "total": meta.block_id.part_set_header.total,
+                    "hash": meta.block_id.part_set_header.hash.hex(),
+                },
+            },
+            "height": str(height),
+            "block_proto": block.to_proto().hex(),
+        }
+
+    def get_by_height(self, req: dict) -> dict:
+        return self._block_payload(int(req["height"]))
+
+    def get_latest(self, _req: dict) -> dict:
+        return self._block_payload(self.block_store.height())
+
+    def stream_get_latest_height(self, _req: dict, context):
+        """blockservice/service.go:98 GetLatestHeight: push the head
+        height whenever it advances, until the client goes away."""
+        last = 0
+        while context.is_active():
+            h = self.block_store.height()
+            if h > last:
+                last = h
+                yield {"height": str(h)}
+            time.sleep(0.05)
+
+
+class BlockResultsService(_JsonServicer):
+    service_name = "cometbft_tpu.rpc.BlockResultsService"
+    rpc_methods = frozenset({"GetBlockResults"})
+
+    def __init__(self, state_store, block_store):
+        self.state_store = state_store
+        self.block_store = block_store
+
+    def get_block_results(self, req: dict) -> dict:
+        from cometbft_tpu.abci import codec as abci_codec
+
+        height = int(req.get("height") or self.block_store.height())
+        resp = self.state_store.load_finalize_block_response(height)
+        if resp is None:
+            raise KeyError(f"block results at height {height} not found")
+        return {
+            "height": str(height),
+            "txs_results": [abci_codec._to_jsonable(r) for r in resp.tx_results],
+            "finalize_block_events": [
+                abci_codec._to_jsonable(e) for e in resp.events],
+            "app_hash": resp.app_hash.hex(),
+        }
+
+
+class PruningService(_JsonServicer):
+    """The data-companion control plane (pruningservice/service.go):
+    retain heights set here gate what the background pruner may delete."""
+
+    service_name = "cometbft_tpu.rpc.PruningService"
+    rpc_methods = frozenset({
+        "SetBlockRetainHeight", "GetBlockRetainHeight",
+        "SetBlockResultsRetainHeight", "GetBlockResultsRetainHeight",
+        "SetTxIndexerRetainHeight", "GetTxIndexerRetainHeight",
+        "SetBlockIndexerRetainHeight", "GetBlockIndexerRetainHeight",
+    })
+
+    def __init__(self, pruner):
+        self.pruner = pruner
+
+    def set_block_retain_height(self, req: dict) -> dict:
+        self.pruner.set_companion_block_retain_height(int(req["height"]))
+        return {}
+
+    def get_block_retain_height(self, _req: dict) -> dict:
+        return {
+            "app_retain_height": str(
+                self.pruner.state_store.load_retain_height(
+                    pruner_mod.APP_RETAIN)),
+            "pruning_service_retain_height": str(
+                self.pruner.state_store.load_retain_height(
+                    pruner_mod.COMPANION_RETAIN)),
+        }
+
+    def set_block_results_retain_height(self, req: dict) -> dict:
+        self.pruner.set_abci_res_retain_height(int(req["height"]))
+        return {}
+
+    def get_block_results_retain_height(self, _req: dict) -> dict:
+        return {"pruning_service_retain_height": str(
+            self.pruner.get_abci_res_retain_height())}
+
+    def set_tx_indexer_retain_height(self, req: dict) -> dict:
+        self.pruner.set_tx_indexer_retain_height(int(req["height"]))
+        return {}
+
+    def get_tx_indexer_retain_height(self, _req: dict) -> dict:
+        return {"height": str(self.pruner.get_tx_indexer_retain_height())}
+
+    def set_block_indexer_retain_height(self, req: dict) -> dict:
+        self.pruner.set_block_indexer_retain_height(int(req["height"]))
+        return {}
+
+    def get_block_indexer_retain_height(self, _req: dict) -> dict:
+        return {"height": str(self.pruner.get_block_indexer_retain_height())}
+
+
+class _MultiHandler(grpc.GenericRpcHandler):
+    def __init__(self, servicers):
+        self.servicers = servicers
+
+    def service(self, handler_call_details):
+        for s in self.servicers:
+            h = s.service(handler_call_details)
+            if h is not None:
+                return h
+        return None
+
+
+def serve(servicers, addr: str) -> tuple[grpc.Server, str]:
+    """Start a gRPC server hosting the servicers; returns (server,
+    'host:bound_port')."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+    server.add_generic_rpc_handlers((_MultiHandler(servicers),))
+    host = addr.removeprefix("tcp://")
+    port = server.add_insecure_port(host)
+    if port == 0:
+        raise RuntimeError(f"gRPC bind failed on {addr!r}")
+    server.start()
+    bound = f"{host.rsplit(':', 1)[0]}:{port}"
+    return server, bound
+
+
+# ----------------------------------------------------------------- client
+
+
+class GRPCServicesClient:
+    """Minimal client for the JSON-framed services (tests, operator
+    tooling, the data companion)."""
+
+    def __init__(self, addr: str):
+        self.channel = grpc.aio.insecure_channel(addr.removeprefix("tcp://"))
+
+    async def call(self, service: str, method: str, req: dict | None = None) -> dict:
+        rpc = self.channel.unary_unary(
+            f"/cometbft_tpu.rpc.{service}/{method}",
+            request_serializer=_ident, response_deserializer=_ident)
+        out = await rpc(json.dumps(req or {}).encode())
+        return json.loads(out)
+
+    async def stream(self, service: str, method: str, req: dict | None = None):
+        rpc = self.channel.unary_stream(
+            f"/cometbft_tpu.rpc.{service}/{method}",
+            request_serializer=_ident, response_deserializer=_ident)
+        async for out in rpc(json.dumps(req or {}).encode()):
+            yield json.loads(out)
+
+    async def close(self) -> None:
+        await self.channel.close()
+
+
+async def wait_closed(server: grpc.Server) -> None:
+    await asyncio.to_thread(server.stop(grace=1.0).wait)

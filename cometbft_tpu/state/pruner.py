@@ -23,6 +23,8 @@ from cometbft_tpu.libs.service import BaseService
 APP_RETAIN = "app_block"
 COMPANION_RETAIN = "companion_block"
 ABCI_RES_RETAIN = "abci_results"
+TX_INDEX_RETAIN = "tx_index"
+BLOCK_INDEX_RETAIN = "block_index"
 
 DEFAULT_INTERVAL = 10.0  # config.DefaultPruningInterval
 
@@ -77,11 +79,23 @@ class Pruner(BaseService):
     def set_abci_res_retain_height(self, height: int) -> None:
         self._set_retain(ABCI_RES_RETAIN, height)
 
+    def set_tx_indexer_retain_height(self, height: int) -> None:
+        self._set_retain(TX_INDEX_RETAIN, height)
+
+    def set_block_indexer_retain_height(self, height: int) -> None:
+        self._set_retain(BLOCK_INDEX_RETAIN, height)
+
     def get_block_retain_height(self) -> int:
         return self._effective_block_retain()
 
     def get_abci_res_retain_height(self) -> int:
         return self.state_store.load_retain_height(ABCI_RES_RETAIN)
+
+    def get_tx_indexer_retain_height(self) -> int:
+        return self.state_store.load_retain_height(TX_INDEX_RETAIN)
+
+    def get_block_indexer_retain_height(self) -> int:
+        return self.state_store.load_retain_height(BLOCK_INDEX_RETAIN)
 
     def _effective_block_retain(self) -> int:
         """min(app, companion) when the companion is enabled; the app's
@@ -127,15 +141,17 @@ class Pruner(BaseService):
         if retain > self.block_store.base():
             blocks = self.block_store.prune_blocks(retain)
             self.state_store.prune_states(retain)
-            # index rows for pruned blocks go with them (the reference
-            # exposes separate indexer retain heights via the pruning
-            # service API; here the block retain height drives both)
-            if self.tx_indexer is not None:
-                self.tx_indexer.prune(retain)
-            if self.block_indexer is not None:
-                self.block_indexer.prune(retain)
             if blocks:
                 self.logger.info("pruned blocks", to_height=retain, n=blocks)
+        # index rows follow their own retain heights when the pruning
+        # service set them, else the block retain height — and prune
+        # INDEPENDENTLY of whether block pruning fired this pass
+        tx_retain = self.get_tx_indexer_retain_height() or retain
+        bl_retain = self.get_block_indexer_retain_height() or retain
+        if self.tx_indexer is not None and tx_retain > 0:
+            self.tx_indexer.prune(tx_retain)
+        if self.block_indexer is not None and bl_retain > 0:
+            self.block_indexer.prune(bl_retain)
         res_retain = self.state_store.load_retain_height(ABCI_RES_RETAIN)
         if res_retain == 0 and not self.companion_enabled:
             # no companion and no explicit ABCI-results height: follow the
