@@ -455,12 +455,11 @@ class Node(BaseService):
             self._statesync_task.cancel()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
-        import asyncio as _asyncio
-
         for srv in (self.grpc_server, self.grpc_priv_server):
             if srv is not None:
-                # wait for drain so a restart can rebind the same port
-                await _asyncio.to_thread(srv.stop(grace=0.5).wait)
+                from cometbft_tpu.rpc.grpc_services import wait_closed
+
+                await wait_closed(srv, grace=0.5)
         await self.switch.stop()
         await self.proxy_app.stop()
         if self.pruner.is_running:

@@ -70,9 +70,15 @@ def _const_args() -> tuple[np.ndarray, ...]:
 _N_CONSTS = len(_FIELD_CONST_NAMES) + 4
 
 
-def _verify_block_kernel(*refs, n_windows: int = 0, stages: str = "full"):
+def _verify_block_kernel(*refs, n_windows: int = 0, stages: str = "full",
+                         scheme: str = "ed25519"):
     """consts..., A-coords (20, L) int32, packed R words (8, L) uint32,
     signed digits s/k (51, L) int32, out (1, L) int32 mask.
+
+    scheme selects the decode + cofactor pair: "ed25519" = ZIP-215
+    decompression + [8] coset check; "sr25519" = ristretto255 decode + [4]
+    coset check (ristretto equality). The ladder between them is byte-for-
+    byte the same program.
 
     n_windows/stages are microbench bisection knobs (ops/microbench.py):
     n_windows truncates the ladder, stages="nodecomp" skips the R
@@ -98,6 +104,10 @@ def _verify_block_kernel(*refs, n_windows: int = 0, stages: str = "full"):
         a = curve.Point(ax[:], ay[:], az[:], at[:])
         if stages == "nodecomp":
             ok_r, r = jnp.ones(a.x.shape[1:], dtype=bool), a
+        elif scheme == "sr25519":
+            from cometbft_tpu.ops import sr25519_kernel as SRK
+
+            ok_r, r = SRK.ristretto_decode_device(rw[:])
         else:
             r_words = rw[:]
             y_r = U.words_to_y_limbs(r_words)
@@ -128,7 +138,11 @@ def _verify_block_kernel(*refs, n_windows: int = 0, stages: str = "full"):
             table_b, table_a, out_t=True,
         )
         diff = curve.add(sb_ka, curve.neg(r))
-        valid = curve.is_identity(curve.mul_by_cofactor(diff))
+        if scheme == "sr25519":  # cofactor 4: ristretto equality
+            coset = curve.double(curve.double(diff))
+        else:  # cofactor 8: ZIP-215
+            coset = curve.mul_by_cofactor(diff)
+        valid = curve.is_identity(coset)
         out[0, :] = (valid & ok_r).astype(jnp.int32)
     finally:
         for n, v in saved_f.items():
@@ -138,11 +152,11 @@ def _verify_block_kernel(*refs, n_windows: int = 0, stages: str = "full"):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("interpret", "n_windows", "stages")
+    jax.jit, static_argnames=("interpret", "n_windows", "stages", "scheme")
 )
 def _verify_pallas_bench(
     ax, ay, az, at, r_words, s_words, k_words, interpret=False,
-    n_windows=0, stages="full",
+    n_windows=0, stages="full", scheme="ed25519",
 ):
     """Internal entry with microbench bisection knobs (n_windows/stages,
     see _verify_block_kernel) — non-default knob values produce WRONG
@@ -166,7 +180,8 @@ def _verify_pallas_bench(
     out_spec = pl.BlockSpec((1, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
     mask = pl.pallas_call(
         functools.partial(
-            _verify_block_kernel, n_windows=n_windows, stages=stages
+            _verify_block_kernel, n_windows=n_windows, stages=stages,
+            scheme=scheme,
         ),
         grid=grid,
         in_specs=const_specs + [limb_spec] * 4 + [word_spec] + [dig_spec] * 2,
@@ -179,8 +194,18 @@ def _verify_pallas_bench(
 
 def verify_pallas(ax, ay, az, at, r_words, s_words, k_words, interpret=False):
     """(20, B) int32 A-coords + (8, B) uint32 packed r/s/k words ->
-    (B,) bool mask. B must be a multiple of LANES (callers fall back to
-    the XLA path for smaller buckets)."""
+    (B,) bool mask (ed25519 ZIP-215). B must be a multiple of LANES
+    (callers fall back to the XLA path for smaller buckets)."""
     return _verify_pallas_bench(
         ax, ay, az, at, r_words, s_words, k_words, interpret=interpret
+    )
+
+
+def verify_pallas_sr(ax, ay, az, at, r_words, s_words, k_words,
+                     interpret=False):
+    """sr25519 (schnorrkel/ristretto) variant of verify_pallas: same
+    ladder, ristretto decode, cofactor-4 coset check."""
+    return _verify_pallas_bench(
+        ax, ay, az, at, r_words, s_words, k_words, interpret=interpret,
+        scheme="sr25519",
     )

@@ -33,10 +33,11 @@ from cometbft_tpu.ops import limbs as L
 from cometbft_tpu.ops import unpack as U
 from cometbft_tpu.ops.ed25519_kernel import bucket_size
 
-SQRT_M1_LIMBS = F.SQRT_M1
-
 # the 32-byte encoding of the ristretto identity (all zeros) — padding lanes
 _ID_ENC32 = bytes(32)
+
+# set permanently on a Mosaic lowering failure of the sr Pallas kernel
+_sr_pallas_broken = False
 
 
 def _words_to_full_limbs(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -56,15 +57,17 @@ def _is_canonical_even(limbs: jnp.ndarray, hi_bit: jnp.ndarray) -> jnp.ndarray:
 
 
 def sqrt_ratio_m1(u: jnp.ndarray, v: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Vectorized SQRT_RATIO_M1: (was_square (B,), nonnegative root (20, B))."""
+    """Vectorized SQRT_RATIO_M1: (was_square (B,), nonnegative root (20, B)).
+    Reads F.SQRT_M1 at trace time (NOT a captured module constant) so the
+    Pallas kernel's constant swap applies."""
     v3 = F.mul(F.sq(v), v)
     v7 = F.mul(F.sq(v3), v)
     r = F.mul(F.mul(u, v3), F.pow22523(F.mul(u, v7)))
     check = F.mul(v, F.sq(r))
     correct = F.is_zero(F.sub(check, u))
     flipped = F.is_zero(F.add(check, u))
-    flipped_i = F.is_zero(F.add(check, F.mul(u, SQRT_M1_LIMBS)))
-    r = jnp.where((flipped | flipped_i)[None], F.mul(r, SQRT_M1_LIMBS), r)
+    flipped_i = F.is_zero(F.add(check, F.mul(u, F.SQRT_M1)))
+    r = jnp.where((flipped | flipped_i)[None], F.mul(r, F.SQRT_M1), r)
     was_square = correct | flipped
     # CT_ABS: take the even root
     odd = F.parity(r) == 1
@@ -247,11 +250,25 @@ def verify_batch(
     pre_ok, ok_a, n, a_dev, r_w, s_w, k_w = stage_batch_sr(
         pubs, msgs, sigs, cache=cache
     )
+    from cometbft_tpu.ops import ed25519_kernel as EK
     from cometbft_tpu.ops.dispatch import KERNEL_DISPATCH_LOCK
 
-    # the ed25519 Pallas trace swaps field/curve module constants under
-    # this lock; tracing the sr ladder concurrently would read the swap
+    global _sr_pallas_broken
+    # any curve-kernel trace swaps field/curve module constants under this
+    # lock (ops/dispatch.py); never trace concurrently
     with KERNEL_DISPATCH_LOCK:
-        mask_dev = _verify_kernel(*a_dev, r_w, s_w, k_w)
+        from cometbft_tpu.ops import pallas_verify as PV
+
+        if (not _sr_pallas_broken and EK._pallas_available()
+                and r_w.shape[1] % PV.LANES == 0):
+            try:
+                mask_dev = PV.verify_pallas_sr(*a_dev, r_w, s_w, k_w)
+            except Exception:  # noqa: BLE001 - Mosaic failure: permanent
+                # XLA fallback (like ed25519's _dispatch_verify) — never
+                # re-pay a failing multi-second trace per batch
+                _sr_pallas_broken = True
+                mask_dev = _verify_kernel(*a_dev, r_w, s_w, k_w)
+        else:
+            mask_dev = _verify_kernel(*a_dev, r_w, s_w, k_w)
     mask = np.asarray(mask_dev)[:n] & pre_ok & ok_a
     return bool(mask.all()), mask.tolist()

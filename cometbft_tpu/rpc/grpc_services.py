@@ -42,8 +42,10 @@ def _ident(b: bytes) -> bytes:
 
 # long-lived streams each hold one thread-pool worker; bound them so idle
 # subscribers can never starve the unary RPCs sharing the executor
+import threading
+
 _MAX_STREAMS = 4
-_stream_slots = None  # initialized lazily (threading.BoundedSemaphore)
+_stream_slots = threading.BoundedSemaphore(_MAX_STREAMS)
 
 
 class _JsonServicer:
@@ -82,11 +84,6 @@ class _JsonServicer:
             sfn = getattr(self, "stream_" + snake)
 
             def streaming(request: bytes, context):
-                import threading
-
-                global _stream_slots
-                if _stream_slots is None:
-                    _stream_slots = threading.BoundedSemaphore(_MAX_STREAMS)
                 if not _stream_slots.acquire(blocking=False):
                     context.abort(
                         grpc.StatusCode.RESOURCE_EXHAUSTED,
@@ -142,6 +139,8 @@ class BlockService(_JsonServicer):
         }
 
     def get_by_height(self, req: dict) -> dict:
+        if "height" not in req:
+            raise ValueError("missing height")  # INVALID_ARGUMENT, not 404
         return self._block_payload(int(req["height"]))
 
     def get_latest(self, _req: dict) -> dict:
@@ -151,12 +150,15 @@ class BlockService(_JsonServicer):
         """blockservice/service.go:98 GetLatestHeight: push the head
         height whenever it advances, until the client goes away."""
         last = 0
+        # polling (0.2 s) keeps this free of event-bus plumbing into the
+        # sync worker thread; 5 store reads/s per subscriber, stream count
+        # capped by _MAX_STREAMS
         while context.is_active():
             h = self.block_store.height()
             if h > last:
                 last = h
                 yield {"height": str(h)}
-            time.sleep(0.05)
+            time.sleep(0.2)
 
 
 class BlockResultsService(_JsonServicer):
@@ -289,5 +291,6 @@ class GRPCServicesClient:
         await self.channel.close()
 
 
-async def wait_closed(server: grpc.Server) -> None:
-    await asyncio.to_thread(server.stop(grace=1.0).wait)
+async def wait_closed(server: grpc.Server, grace: float = 1.0) -> None:
+    """Stop the server and wait for the drain, so a restart can rebind."""
+    await asyncio.to_thread(server.stop(grace=grace).wait)

@@ -62,11 +62,15 @@ def test_grpc_services_against_live_node(tmp_path):
             assert br["app_hash"] == resp.app_hash.hex()
 
             # pruning service is ONLY on the privileged listener
+            import grpc
+
+            leaked = None
             try:
-                await client.call("PruningService", "GetBlockRetainHeight")
-                raise AssertionError("pruning service leaked onto public gRPC")
-            except Exception:  # noqa: BLE001 - UNIMPLEMENTED expected
-                pass
+                leaked = await client.call(
+                    "PruningService", "GetBlockRetainHeight")
+            except grpc.aio.AioRpcError as e:
+                assert e.code() == grpc.StatusCode.UNIMPLEMENTED, e
+            assert leaked is None, "pruning service leaked onto public gRPC"
 
             # companion retain heights flow through to the real pruner
             h = node.block_store.height()
