@@ -237,6 +237,48 @@ def cmd_light(args) -> int:
     return 0
 
 
+def cmd_debug(args) -> int:
+    """cmd/cometbft/commands/debug/debug.go:22-80 'debug dump': capture an
+    operator bundle from a RUNNING node — status, consensus round state
+    (own + peers), net info, and the node config — into a tar.gz for
+    offline analysis. (Process stacks: send SIGUSR1 to the node, which
+    registers a faulthandler dump — see cmd_start.)"""
+    import io
+    import tarfile
+    import time as _time
+    import urllib.request
+
+    base = args.rpc_laddr.removeprefix("tcp://")
+    if not base.startswith("http"):
+        base = "http://" + base
+
+    def get(route: str) -> bytes:
+        with urllib.request.urlopen(f"{base}/{route}", timeout=10) as r:
+            return r.read()
+
+    out = args.output or f"cometbft-debug-{int(_time.time())}.tar.gz"
+    with tarfile.open(out, "w:gz") as tar:
+        for name, route in (
+            ("status.json", "status"),
+            ("consensus_state.json", "consensus_state"),
+            ("dump_consensus_state.json", "dump_consensus_state"),
+            ("net_info.json", "net_info"),
+        ):
+            try:
+                data = get(route)
+            except Exception as e:  # noqa: BLE001 - capture what we can
+                data = json.dumps({"error": str(e)}).encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = int(_time.time())
+            tar.addfile(info, io.BytesIO(data))
+        cfg_path = os.path.join(_home(args), "config", "config.toml")
+        if os.path.exists(cfg_path):
+            tar.add(cfg_path, arcname="config.toml")
+    print(f"wrote debug bundle {out}")
+    return 0
+
+
 def cmd_loadtime(args) -> int:
     """test/loadtime analog: 'run' drives stamped-tx load at RPC
     endpoints; 'report' recomputes per-tx latency from committed blocks."""
@@ -326,6 +368,12 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("--laddr", default="tcp://127.0.0.1:8888",
                     help="proxy listen address")
     sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser("debug", help="capture an operator debug bundle")
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr",
+                    default="tcp://127.0.0.1:26657")
+    sp.add_argument("--output", default="", help="output tar.gz path")
+    sp.set_defaults(fn=cmd_debug)
 
     sp = sub.add_parser("loadtime", help="tx load generator + latency report")
     sp.add_argument("mode", choices=["run", "report"])

@@ -110,7 +110,54 @@ _FLAG_M = 1 << 4
 _FLAG_K = 1 << 5
 
 
+def _load_native_strobe():
+    """ctypes handle to native/strobe.c, or None (pure-Python fallback).
+    Byte-equivalence with the Python implementation is asserted by
+    tests/test_sr25519.py."""
+    from cometbft_tpu import native
+
+    return native.load("strobe")
+
+
+_NATIVE = _load_native_strobe()
+
+
+class _NativeStrobe128:
+    """Same surface as Strobe128, state in a packed 203-byte C buffer."""
+
+    __slots__ = ("_buf",)
+
+    def __init__(self, protocol_label: bytes):
+        import ctypes
+
+        self._buf = ctypes.create_string_buffer(203)
+        _NATIVE.strobe_new(self._buf, protocol_label, len(protocol_label))
+
+    def meta_ad(self, data: bytes, more: bool) -> None:
+        _NATIVE.strobe_meta_ad(self._buf, data, len(data), int(more))
+
+    def ad(self, data: bytes, more: bool) -> None:
+        _NATIVE.strobe_ad(self._buf, data, len(data), int(more))
+
+    def prf(self, n: int, more: bool = False) -> bytes:
+        import ctypes
+
+        out = ctypes.create_string_buffer(n)
+        _NATIVE.strobe_prf(self._buf, out, n, int(more))
+        return out.raw
+
+    def key(self, data: bytes, more: bool = False) -> None:
+        _NATIVE.strobe_key(self._buf, data, len(data), int(more))
+
+
 class Strobe128:
+    def __new__(cls, protocol_label: bytes = b""):
+        # default arg keeps copy.deepcopy (Transcript.clone in the pure-
+        # Python fallback) working: deepcopy reconstructs via __new__(cls)
+        if cls is Strobe128 and _NATIVE is not None:
+            return _NativeStrobe128(protocol_label)
+        return super().__new__(cls)
+
     def __init__(self, protocol_label: bytes):
         self.state = bytearray(200)
         seed = b"\x01" + bytes([_STROBE_R + 2]) + b"\x01\x00\x01\x60" + b"STROBEv1.0.2"

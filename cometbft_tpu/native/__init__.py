@@ -1,0 +1,54 @@
+"""Native (C) runtime components, built on demand with the system cc.
+
+The reference leans on native code for its byte-crunching hot paths (Go
+with assembly fast paths in curve25519-voi, merlin in Rust under
+schnorrkel). This package holds the framework's equivalents: small C
+libraries compiled once into the package directory and loaded via ctypes,
+each with a pure-Python fallback so a missing toolchain degrades to slow,
+never to broken.
+
+Currently: strobe.c — the STROBE-128 duplex behind Merlin transcripts
+(sr25519 signing/verification challenges).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_failed: set[str] = set()
+_loaded: dict[str, ctypes.CDLL] = {}
+
+
+def load(name: str) -> ctypes.CDLL | None:
+    """Compile (if stale) and load lib `name` (from {name}.c). Returns None
+    when no working C toolchain is available — callers keep their Python
+    fallback."""
+    if name in _loaded:
+        return _loaded[name]
+    if name in _failed:
+        return None
+    src = os.path.join(_DIR, f"{name}.c")
+    so = os.path.join(_DIR, f"_{name}.so")
+    try:
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+            os.close(fd)
+            try:
+                subprocess.run(
+                    ["cc", "-O2", "-shared", "-fPIC", "-o", tmp, src],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so)  # atomic vs concurrent builders
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        lib = ctypes.CDLL(so)
+    except Exception:  # noqa: BLE001 - no cc / sandboxed fs: fall back
+        _failed.add(name)
+        return None
+    _loaded[name] = lib
+    return lib

@@ -1,0 +1,138 @@
+/* STROBE-128 duplex core (the subset merlin uses: meta-AD, AD, PRF, KEY)
+ * as a tiny C library behind ctypes — the native replacement for the
+ * pure-Python Keccak in crypto/sr25519_math.py, whose ~1.4 ms per Merlin
+ * challenge dominated mixed mega-commit verification wall time. Semantics
+ * mirror the Python Strobe128 class byte-for-byte (cross-checked by
+ * tests/test_sr25519.py transcript vectors).
+ *
+ * State layout (packed, 203 bytes, shared with Python as a raw buffer):
+ *   [0..199]  keccak-f1600 state
+ *   [200]     pos
+ *   [201]     pos_begin
+ *   [202]     cur_flags
+ */
+
+#include <stdint.h>
+#include <string.h>
+
+#define R_RATE 166 /* 1600/8 - 2*128/8 - 2 */
+
+static const uint64_t RC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808aULL,
+    0x8000000080008000ULL, 0x000000000000808bULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008aULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000aULL,
+    0x000000008000808bULL, 0x800000000000008bULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800aULL, 0x800000008000000aULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
+
+static const int ROTC[5][5] = {{0, 36, 3, 41, 18},
+                               {1, 44, 10, 45, 2},
+                               {62, 6, 43, 15, 61},
+                               {28, 55, 25, 21, 56},
+                               {27, 20, 39, 8, 14}};
+
+static inline uint64_t rotl(uint64_t v, int n) {
+  return n ? (v << n) | (v >> (64 - n)) : v;
+}
+
+static void keccakf(uint64_t a[25]) { /* lane i = x + 5*y, little-endian */
+  uint64_t b[25], c[5], d[5];
+  for (int r = 0; r < 24; r++) {
+    for (int x = 0; x < 5; x++)
+      c[x] = a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20];
+    for (int x = 0; x < 5; x++)
+      d[x] = c[(x + 4) % 5] ^ rotl(c[(x + 1) % 5], 1);
+    for (int x = 0; x < 5; x++)
+      for (int y = 0; y < 5; y++) a[x + 5 * y] ^= d[x];
+    for (int x = 0; x < 5; x++)
+      for (int y = 0; y < 5; y++)
+        b[y + 5 * ((2 * x + 3 * y) % 5)] = rotl(a[x + 5 * y], ROTC[x][y]);
+    for (int x = 0; x < 5; x++)
+      for (int y = 0; y < 5; y++)
+        a[x + 5 * y] = b[x + 5 * y] ^ ((~b[(x + 1) % 5 + 5 * y]) &
+                                       b[(x + 2) % 5 + 5 * y]);
+    a[0] ^= RC[r];
+  }
+}
+
+typedef struct {
+  uint8_t st[200];
+  uint8_t pos;
+  uint8_t pos_begin;
+  uint8_t cur_flags;
+} strobe_t;
+
+static void perm(strobe_t *s) {
+  uint64_t lanes[25];
+  memcpy(lanes, s->st, 200);
+  keccakf(lanes);
+  memcpy(s->st, lanes, 200);
+}
+
+static void run_f(strobe_t *s) {
+  s->st[s->pos] ^= s->pos_begin;
+  s->st[s->pos + 1] ^= 0x04;
+  s->st[R_RATE + 1] ^= 0x80;
+  perm(s);
+  s->pos = 0;
+  s->pos_begin = 0;
+}
+
+static void absorb(strobe_t *s, const uint8_t *d, long n) {
+  for (long i = 0; i < n; i++) {
+    s->st[s->pos] ^= d[i];
+    if (++s->pos == R_RATE) run_f(s);
+  }
+}
+
+/* flags: I=1 A=2 C=4 T=8 M=16 K=32 */
+static void begin_op(strobe_t *s, uint8_t flags, int more) {
+  if (more) return; /* caller guarantees same flags (Python asserts) */
+  uint8_t hdr[2];
+  hdr[0] = s->pos_begin;
+  hdr[1] = flags;
+  s->pos_begin = s->pos + 1;
+  s->cur_flags = flags;
+  absorb(s, hdr, 2);
+  if ((flags & 0x24) && s->pos != 0) run_f(s);
+}
+
+void strobe_new(strobe_t *s, const uint8_t *label, long label_len) {
+  static const uint8_t seed[18] = {0x01, R_RATE + 2, 0x01, 0x00, 0x01, 0x60,
+                                   'S',  'T',        'R',  'O',  'B',  'E',
+                                   'v',  '1',        '.',  '0',  '.',  '2'};
+  memset(s, 0, sizeof(*s));
+  memcpy(s->st, seed, sizeof(seed));
+  perm(s);
+  begin_op(s, 0x12 /* M|A */, 0);
+  absorb(s, label, label_len);
+}
+
+void strobe_meta_ad(strobe_t *s, const uint8_t *d, long n, int more) {
+  begin_op(s, 0x12 /* M|A */, more);
+  absorb(s, d, n);
+}
+
+void strobe_ad(strobe_t *s, const uint8_t *d, long n, int more) {
+  begin_op(s, 0x02 /* A */, more);
+  absorb(s, d, n);
+}
+
+void strobe_prf(strobe_t *s, uint8_t *out, long n, int more) {
+  begin_op(s, 0x07 /* I|A|C */, more);
+  for (long i = 0; i < n; i++) {
+    out[i] = s->st[s->pos];
+    s->st[s->pos] = 0;
+    if (++s->pos == R_RATE) run_f(s);
+  }
+}
+
+void strobe_key(strobe_t *s, const uint8_t *d, long n, int more) {
+  begin_op(s, 0x06 /* A|C */, more);
+  for (long i = 0; i < n; i++) {
+    s->st[s->pos] = d[i];
+    if (++s->pos == R_RATE) run_f(s);
+  }
+}

@@ -15,3 +15,25 @@ from __future__ import annotations
 import threading
 
 KERNEL_DISPATCH_LOCK = threading.Lock()
+
+
+class PallasGate:
+    """The one dispatch policy for a Pallas kernel with an XLA fallback:
+    lane-aligned batches go to Pallas while it works; the first Mosaic
+    failure permanently disables it (a failing trace costs seconds — never
+    pay it per batch). Callers hold KERNEL_DISPATCH_LOCK around run()."""
+
+    def __init__(self) -> None:
+        self.broken = False
+
+    def run(self, pallas_fn, xla_fn, args, lane_count: int):
+        from cometbft_tpu.ops import pallas_verify as PV
+        from cometbft_tpu.ops.ed25519_kernel import _pallas_available
+
+        if (not self.broken and _pallas_available()
+                and lane_count % PV.LANES == 0):
+            try:
+                return pallas_fn(*args)
+            except Exception:  # noqa: BLE001 - Mosaic/backend failure
+                self.broken = True
+        return xla_fn(*args)

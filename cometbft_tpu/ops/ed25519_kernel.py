@@ -115,17 +115,18 @@ def _pallas_available() -> bool:
 from cometbft_tpu.ops.dispatch import KERNEL_DISPATCH_LOCK as _dispatch_lock
 
 
+from cometbft_tpu.ops.dispatch import PallasGate
+
+_pallas_gate = PallasGate()
+
+
 def _dispatch_verify(a_dev, r_words, s_words, k_words):
     from cometbft_tpu.ops import pallas_verify as PV
 
-    global _use_pallas
     with _dispatch_lock:
-        if _pallas_available() and r_words.shape[1] % PV.LANES == 0:
-            try:
-                return PV.verify_pallas(*a_dev, r_words, s_words, k_words)
-            except Exception:  # Mosaic/backend failure: fall back permanently
-                _use_pallas = False
-        return _verify_kernel(*a_dev, r_words, s_words, k_words)
+        return _pallas_gate.run(
+            PV.verify_pallas, _verify_kernel,
+            (*a_dev, r_words, s_words, k_words), r_words.shape[1])
 
 
 def decompress_points(enc: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

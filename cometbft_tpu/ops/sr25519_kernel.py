@@ -36,9 +36,6 @@ from cometbft_tpu.ops.ed25519_kernel import bucket_size
 # the 32-byte encoding of the ristretto identity (all zeros) — padding lanes
 _ID_ENC32 = bytes(32)
 
-# set permanently on a Mosaic lowering failure of the sr Pallas kernel
-_sr_pallas_broken = False
-
 
 def _words_to_full_limbs(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(8, B) uint32 -> ((20, B) int32 limbs of the low 255 bits, (B,) bit
@@ -120,6 +117,10 @@ def verify_math_sr(ax, ay, az, at, r_words, s_words, k_words) -> jnp.ndarray:
 
 
 _verify_kernel = jax.jit(verify_math_sr)
+
+from cometbft_tpu.ops.dispatch import PallasGate  # noqa: E402
+
+_pallas_gate = PallasGate()
 
 
 def decompress_points(enc: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -250,25 +251,15 @@ def verify_batch(
     pre_ok, ok_a, n, a_dev, r_w, s_w, k_w = stage_batch_sr(
         pubs, msgs, sigs, cache=cache
     )
-    from cometbft_tpu.ops import ed25519_kernel as EK
     from cometbft_tpu.ops.dispatch import KERNEL_DISPATCH_LOCK
 
-    global _sr_pallas_broken
     # any curve-kernel trace swaps field/curve module constants under this
     # lock (ops/dispatch.py); never trace concurrently
     with KERNEL_DISPATCH_LOCK:
         from cometbft_tpu.ops import pallas_verify as PV
 
-        if (not _sr_pallas_broken and EK._pallas_available()
-                and r_w.shape[1] % PV.LANES == 0):
-            try:
-                mask_dev = PV.verify_pallas_sr(*a_dev, r_w, s_w, k_w)
-            except Exception:  # noqa: BLE001 - Mosaic failure: permanent
-                # XLA fallback (like ed25519's _dispatch_verify) — never
-                # re-pay a failing multi-second trace per batch
-                _sr_pallas_broken = True
-                mask_dev = _verify_kernel(*a_dev, r_w, s_w, k_w)
-        else:
-            mask_dev = _verify_kernel(*a_dev, r_w, s_w, k_w)
+        mask_dev = _pallas_gate.run(
+            PV.verify_pallas_sr, _verify_kernel,
+            (*a_dev, r_w, s_w, k_w), r_w.shape[1])
     mask = np.asarray(mask_dev)[:n] & pre_ok & ok_a
     return bool(mask.all()), mask.tolist()

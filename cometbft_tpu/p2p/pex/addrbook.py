@@ -2,10 +2,20 @@
 
 Known peer addresses bucketed NEW (heard about) vs OLD (connected
 successfully), with attempt/success bookkeeping, biased random selection,
-ban marking, and JSON persistence. The reference's 256/64 hashed bucket
-scheme exists to bound a multi-million-address book under eclipse
-attempts; the same new/old split and selection bias are kept over flat
-dicts — the eclipse-resistant hashing belongs with a DHT-scale book.
+ban marking, and JSON persistence.
+
+THREAT-MODEL DELTA vs the reference (addrbook.go:70-140): the reference
+hashes addresses into 256 NEW / 64 OLD buckets keyed by a random book
+nonce and the source's /16 group, capping how much of the book any one
+gossip source can occupy — its defense against address poisoning /
+eclipse precursors at internet scale. This book keeps the NEW/OLD split,
+per-source attribution, ban marking, and selection bias over flat dicts,
+plus a total-size cap with bias-aware eviction — sufficient against a
+single misbehaving peer at testnet/consortium scale, but an attacker
+controlling many source identities can claim a larger fraction of the NEW
+set than the hashed-bucket geometry would allow. Deployments on open
+internets should front the book with the hashed geometry before relying
+on it for eclipse resistance.
 """
 
 from __future__ import annotations

@@ -292,37 +292,42 @@ class StagedCommitVerification:
         self.device_thunk = device_thunk
         self._cpu_rows = cpu_rows
         self._mask = None
+        self._passed = False
 
     def finish(self, mask=None) -> None:
         """Materialize the mask (or use the window-resolved one) and apply
-        the reference error semantics: first invalid signature raises."""
+        the reference error semantics: first invalid signature raises.
+        Idempotent once passed (a caller may finish early for ordering and
+        again after a window prefetch)."""
+        if self._passed:
+            return
         if mask is None:
             mask = self._mask
         if mask is None:
             if self.device_thunk is not None:
                 mask = self.device_thunk()
             else:
+                # non-ed25519 / non-TPU rows: still batched per scheme (the
+                # mixed verifier reaches the sr25519 device kernel on the
+                # TPU backend) rather than serial per-signature host calls
                 pubs, msgs, sigs = self._cpu_rows
-                mask = [p.verify_signature(m, s) for p, m, s in zip(pubs, msgs, sigs)]
+                bv = crypto_batch.create_mixed_batch_verifier()
+                try:
+                    for p, m, s in zip(pubs, msgs, sigs):
+                        bv.add(p, m, s)
+                    _, mask = bv.verify()
+                except Exception:  # noqa: BLE001 - unbatchable key type
+                    mask = [p.verify_signature(m, s)
+                            for p, m, s in zip(pubs, msgs, sigs)]
         _raise_first_bad(self.commit, self.sig_idxs, mask)
+        self._passed = True
 
 
-def stage_verify_commit(
-    chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
-) -> StagedCommitVerification:
-    """verify_commit (full semantics: every non-absent signature checked,
-    COMMIT flags tallied, types/validation.go:26-57) staged asynchronously.
-    Structural checks + the voting-power threshold run here, synchronously;
-    signature validity is deferred to .finish()."""
-    _verify_basic(vals, commit, height, block_id)
-    needed = vals.total_voting_power() * 2 // 3
-    pubs, msgs, sigs, idxs = _commit_rows(
-        chain_id, vals, commit, needed,
-        ignore_sig=lambda c: c.block_id_flag == BlockIDFlag.ABSENT,
-        count_sig=lambda c: c.block_id_flag == BlockIDFlag.COMMIT,
-        count_all_signatures=True,
-        lookup_by_index=True,
-    )
+def _stage_rows(commit: Commit, rows) -> StagedCommitVerification:
+    """Dispatch prepared commit rows asynchronously on the device when
+    every key is ed25519 on the TPU backend; else defer to serial host
+    verification at finish()."""
+    pubs, msgs, sigs, idxs = rows
     if crypto_batch.resolve_backend() == "tpu" and all(
         p.type_() == "ed25519" for p in pubs
     ):
@@ -335,11 +340,73 @@ def stage_verify_commit(
     return StagedCommitVerification(commit, idxs, cpu_rows=(pubs, msgs, sigs))
 
 
+def stage_verify_commit(
+    chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
+) -> StagedCommitVerification:
+    """verify_commit (full semantics: every non-absent signature checked,
+    COMMIT flags tallied, types/validation.go:26-57) staged asynchronously.
+    Structural checks + the voting-power threshold run here, synchronously;
+    signature validity is deferred to .finish()."""
+    _verify_basic(vals, commit, height, block_id)
+    needed = vals.total_voting_power() * 2 // 3
+    rows = _commit_rows(
+        chain_id, vals, commit, needed,
+        ignore_sig=lambda c: c.block_id_flag == BlockIDFlag.ABSENT,
+        count_sig=lambda c: c.block_id_flag == BlockIDFlag.COMMIT,
+        count_all_signatures=True,
+        lookup_by_index=True,
+    )
+    return _stage_rows(commit, rows)
+
+
+def stage_verify_commit_light(
+    chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
+) -> StagedCommitVerification:
+    """verify_commit_light staged: the light client's +2/3-of-new-set check
+    (types/validation.go:60-92), deferred so a bisection hop's two checks
+    resolve with ONE device fetch."""
+    _verify_basic(vals, commit, height, block_id)
+    needed = vals.total_voting_power() * 2 // 3
+    rows = _commit_rows(
+        chain_id, vals, commit, needed,
+        ignore_sig=lambda c: c.block_id_flag != BlockIDFlag.COMMIT,
+        count_sig=lambda c: True,
+        count_all_signatures=False,
+        lookup_by_index=True,
+    )
+    return _stage_rows(commit, rows)
+
+
+def stage_verify_commit_light_trusting(
+    chain_id: str, vals: ValidatorSet, commit: Commit, trust_level: Fraction
+) -> StagedCommitVerification:
+    """verify_commit_light_trusting staged (types/validation.go:95-131).
+    The voting-power threshold (raising ErrNotEnoughVotingPowerSigned)
+    runs here synchronously; signature validity at finish()."""
+    if vals is None:
+        raise ValueError("nil validator set")
+    if trust_level.denominator == 0:
+        raise ValueError("trustLevel has zero Denominator")
+    if commit is None:
+        raise ValueError("nil commit")
+    needed = vals.total_voting_power() * trust_level.numerator // trust_level.denominator
+    rows = _commit_rows(
+        chain_id, vals, commit, needed,
+        ignore_sig=lambda c: c.block_id_flag != BlockIDFlag.COMMIT,
+        count_sig=lambda c: True,
+        count_all_signatures=False,
+        lookup_by_index=False,
+    )
+    return _stage_rows(commit, rows)
+
+
 def prefetch_staged(staged: list[StagedCommitVerification]) -> None:
     """Fetch every device mask in the window with ONE device->host transfer
     and attach each to its staging record; subsequent finish() calls are
     pure host work (per-commit error isolation stays with the caller)."""
-    device = [s for s in staged if s.device_thunk is not None and s._mask is None]
+    device = [s for s in staged
+              if s.device_thunk is not None and s._mask is None
+              and not s._passed]
     if not device:
         return
     from cometbft_tpu.ops import ed25519_kernel

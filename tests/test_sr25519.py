@@ -174,3 +174,31 @@ class TestMixedCommit:
         )
         with pytest.raises(tv.ErrInvalidCommitSignature, match=rf"#{sr_idx}"):
             tv.verify_commit("mixed-chain", vs, bid, 3, commit)
+
+
+def test_native_strobe_matches_pure_python():
+    """native/strobe.c must be byte-equivalent to the pure-Python STROBE
+    (the Merlin transcript is consensus-critical: a divergence would sign/
+    verify different challenges than schnorrkel)."""
+    from cometbft_tpu.crypto import sr25519_math as srm
+
+    class PurePy(srm.Strobe128):  # subclass bypasses the native __new__
+        pass
+
+    def drive(s):
+        out = b""
+        s.meta_ad(b"label-a", False)
+        s.ad(b"payload" * 53, False)   # crosses the 166-byte rate
+        s.ad(b"tail", True)
+        out += s.prf(64)
+        s.key(b"K" * 40)
+        s.meta_ad(b"m" * 166, False)   # exactly one rate block
+        s.ad(b"", False)               # empty op
+        out += s.prf(200)              # squeeze across run_f
+        return out
+
+    if srm._NATIVE is None:
+        import pytest
+
+        pytest.skip("no C toolchain: pure-Python STROBE only")
+    assert drive(srm.Strobe128(b"test-proto")) == drive(PurePy(b"test-proto"))
