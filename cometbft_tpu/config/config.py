@@ -186,10 +186,14 @@ class GRPCConfig:
 class TxIndexConfig:
     """config.go:1279-1302."""
 
-    indexer: str = "kv"  # "kv" | "null"
+    # "kv": query-language search via RPC; "sql": write-only relational
+    # sink (the psql-sink analog — SQL consumers query the DB directly,
+    # tx_search/block_search disabled, as with the reference's psql sink);
+    # "null": no indexing
+    indexer: str = "kv"
 
     def validate_basic(self) -> None:
-        if self.indexer not in ("kv", "null"):
+        if self.indexer not in ("kv", "null", "sql"):
             raise ValueError(f"unknown indexer {self.indexer!r}")
 
 

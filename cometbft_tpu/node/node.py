@@ -158,10 +158,21 @@ class Node(BaseService):
         self.event_bus = EventBus()
 
         # ---- indexers (node.go:311-320 createAndStartIndexerService)
+        self._sql_sink = None
         if config.tx_index.indexer == "kv":
             self._indexer_db = open_db(backend, config.db_path("tx_index"))
             self.tx_indexer = TxIndexer(self._indexer_db)
             self.block_indexer = BlockIndexer(self._indexer_db)
+        elif config.tx_index.indexer == "sql":
+            # psql-sink analog on sqlite: write-only relational sink, no
+            # RPC search (state/indexer/sink/psql contract)
+            from cometbft_tpu.state.indexer_sql import SQLEventSink
+
+            self._indexer_db = None
+            self.tx_indexer = NullTxIndexer()
+            self.block_indexer = None
+            self._sql_sink = SQLEventSink(
+                config.db_path("tx_events"), self.genesis_doc.chain_id)
         else:
             self._indexer_db = None
             self.tx_indexer = NullTxIndexer()
@@ -169,7 +180,8 @@ class Node(BaseService):
         self.indexer_service = IndexerService(
             self.tx_indexer, self.block_indexer, self.event_bus,
             logger=self.logger.with_fields(module="txindex"),
-        ) if self._indexer_db is not None else None
+            sql_sink=self._sql_sink,
+        ) if (self._indexer_db is not None or self._sql_sink is not None) else None
 
         # ---- execution + consensus (node.go:391-425)
         # ---- metrics (node.go:300 DefaultMetricsProvider; per-node registry
@@ -466,6 +478,11 @@ class Node(BaseService):
             await self.pruner.stop()
         if self.indexer_service is not None and self.indexer_service.is_running:
             await self.indexer_service.stop()
+        if self._sql_sink is not None:
+            try:
+                self._sql_sink.close()
+            except Exception:  # noqa: BLE001
+                pass
         for db in (self.block_store.db, self.state_store.db, self._evidence_db,
                    self._indexer_db):
             try:

@@ -11,8 +11,10 @@ height, but its keys decompress once):
 verify computes, per lane:  [8]([s]B - [k]A - R) == O   (cofactored,
 ZIP-215), via a signed 5-bit windowed double-scalar ladder (curve.py), one
 add of -R, three doublings, and a projective identity test. The mask
-pinpoints bad signatures directly — the reference's fallback-to-serial
-re-verify (types/validation.go:266) has no analog here.
+pinpoints bad signatures directly; the few lanes it rejects are
+double-checked against the host oracle before being reported (see
+_recheck_failed_lanes — the narrow analog of the reference's
+fallback-to-serial re-verify, types/validation.go:266).
 
 Wire layout (the perf-critical design point): R / s / k cross the host link
 as packed (8, B) uint32 words — 96 B per signature — and are unpacked to
@@ -286,6 +288,47 @@ def verify_batch(
     return bool(mask.all()), mask.tolist()
 
 
+# Failed lanes are re-verified on host with the exact ZIP-215 oracle before
+# being reported invalid (bounded count — a batch with many failures is
+# genuinely bad). The reference batch verifier falls back to serial
+# re-verify on failure too (types/validation.go:266); here the motivation
+# is also defensive: the dev tunnel transport has produced isolated
+# single-lane corruption under load, and an honest signature must never be
+# condemned by a flipped transfer bit.
+_RECHECK_MAX = 32
+
+
+def recheck_failed_lanes(mask, eligible, pubs, msgs, sigs,
+                         verify_fn, scheme: str):
+    """eligible: lanes that passed the host-side structural checks — a
+    pre-failed lane carries a placeholder encoding (the identity, which
+    being small-order validly signs ANYTHING under ZIP-215) and must never
+    be flipped back to valid. Shared by the ed25519 and sr25519 paths;
+    verify_fn is the scheme's exact host oracle."""
+    import numpy as _np
+
+    bad = _np.flatnonzero(~mask & eligible)
+    if len(bad) == 0 or len(bad) > _RECHECK_MAX:
+        return mask
+    flipped = []
+    for i in bad:
+        if verify_fn(pubs[i], msgs[i], sigs[i]):
+            mask[i] = True
+            flipped.append(int(i))
+    if flipped:
+        from cometbft_tpu.libs import log as _log
+
+        _log.default().error(
+            "device verify mask disagreed with host oracle; honoring host",
+            scheme=scheme, lanes=str(flipped))
+    return mask
+
+
+def _recheck_failed_lanes(mask, eligible, pubs, msgs, sigs):
+    return recheck_failed_lanes(
+        mask, eligible, pubs, msgs, sigs, oracle.verify_zip215, "ed25519")
+
+
 def verify_batch_async(
     pubs: list[bytes],
     msgs: list[bytes],
@@ -300,7 +343,8 @@ def verify_batch_async(
     assert len(pubs) == n and len(msgs) == n
     if n == 0:
         empty = lambda: np.zeros(0, dtype=bool)  # noqa: E731
-        empty.device_parts = lambda: (None, 0, np.zeros(0, bool), np.zeros(0, bool))
+        empty.device_parts = lambda: (
+            None, 0, np.zeros(0, bool), np.zeros(0, bool), ([], [], []))
         return empty
     cache = cache or _default_cache
 
@@ -319,11 +363,14 @@ def verify_batch_async(
     # and parallel puts multiplex the tunnel.
     fut = _xfer_pool().submit(_transfer_and_dispatch)
 
+    rows = (safe_pubs, list(msgs), list(sigs))
+
     def result() -> np.ndarray:
         mask_dev = fut.result()
-        return np.asarray(mask_dev)[:n] & pre_ok & ok_a
+        mask = np.asarray(mask_dev)[:n] & pre_ok & ok_a
+        return _recheck_failed_lanes(mask, pre_ok & ok_a, *rows)
 
-    result.device_parts = lambda: (fut.result(), n, pre_ok, ok_a)
+    result.device_parts = lambda: (fut.result(), n, pre_ok, ok_a, rows)
     return result
 
 
@@ -337,12 +384,13 @@ def resolve_batches(thunks) -> list[np.ndarray]:
     flat = np.asarray(jnp.concatenate(nonempty)) if nonempty else np.zeros(0, bool)
     out = []
     off = 0
-    for mask_dev, n, pre_ok, ok_a in parts:
+    for mask_dev, n, pre_ok, ok_a, rows in parts:
         if mask_dev is None:
             out.append(np.zeros(0, dtype=bool))
             continue
         b = mask_dev.shape[0]
-        out.append(flat[off : off + n] & pre_ok & ok_a)
+        mask = flat[off : off + n] & pre_ok & ok_a
+        out.append(_recheck_failed_lanes(mask, pre_ok & ok_a, *rows))
         off += b
     return out
 

@@ -291,6 +291,72 @@ def probe_stacked() -> None:
     print(f"  4-stacked conv                   {per:9.3f} us/iter  (= {per/4:.3f} us per conv)  t1={t1*1e3:.1f}ms t2={t2*1e3:.1f}ms")
 
 
+def _select17_int16(table16, digit):
+    """Experimental: where-tree over int16 tables, upcast after select."""
+    neg_mask = (digit < 0)[None, :]
+    mag = jnp.abs(digit).astype(jnp.int16)
+    coords = [c[:16] for c in table16]
+    for level in (3, 2, 1, 0):
+        bit = ((mag >> level) & 1)[None, None, :] == 1
+        half = coords[0].shape[0] // 2
+        coords = [jnp.where(bit, c[half:], c[:half]) for c in coords]
+    is16 = (mag == 16)[None, :]
+    out = [jnp.where(is16, t[16], c[0]).astype(jnp.int32)
+           for t, c in zip(table16, coords)]
+    x, y, z, t = out
+    x = jnp.where(neg_mask, F.neg(x), x)
+    t = jnp.where(neg_mask, F.neg(t), t)
+    return curve.Point(x, y, z, t)
+
+
+def probe_select16() -> None:
+    print("select int16 experiment (per 128-lane block):")
+    probe_loop(
+        "select17 int32 (current)",
+        lambda s: (
+            curve._select17_signed(curve._BASE_TABLE17, s[0][0]).x,
+            s[0], s[1], s[2],
+        ),
+        4, 200_000,
+    )
+
+    table16 = tuple(
+        jnp.broadcast_to(c, (curve.TABLE17, F.NLIMBS, LANES)).astype(jnp.int16)
+        for c in curve._BASE_TABLE17
+    )
+
+    def probe16(s):
+        p = _select17_int16(table16, s[0][0])
+        return (p.x, s[0], s[1], s[2])
+
+    # note: table16 closes over device constants — run via XLA-level loop
+    # instead of the pallas harness for a comparable slope
+    import functools
+
+    arrs = [jnp.asarray(np.random.default_rng(0).integers(
+        -16, 16, size=(F.NLIMBS, LANES)), dtype=jnp.int32) for _ in range(4)]
+
+    @functools.partial(jax.jit, static_argnums=(4,))
+    def loop16(a, b, c, d, iters):
+        def body(_, s):
+            return probe16(s)
+
+        return jax.lax.fori_loop(0, iters, body, (a, b, c, d))
+
+    @functools.partial(jax.jit, static_argnums=(4,))
+    def loop32(a, b, c, d, iters):
+        def body(_, s):
+            return (curve._select17_signed(curve._BASE_TABLE17, s[0][0]).x,
+                    s[0], s[1], s[2])
+
+        return jax.lax.fori_loop(0, iters, body, (a, b, c, d))
+
+    for name, fn in (("xla select int16", loop16), ("xla select int32", loop32)):
+        t1 = _time(fn, *arrs, 100_000)
+        t2 = _time(fn, *arrs, 200_000)
+        print(f"  {name:<32} {(t2-t1)/100_000*1e6:9.3f} us/iter")
+
+
 def probe_variants2() -> None:
     print("variants2 (per 128-lane block):")
     probe_loop("split-conv mul", lambda s: (_mul_split(s[0], s[1]), s[0]), 2, 300_000)
@@ -354,6 +420,9 @@ def main(argv: list[str]) -> None:
 
     if probes & {"all", "stacked"}:
         probe_stacked()
+
+    if probes & {"select16"}:
+        probe_select16()
 
     if probes & {"all", "window"}:
         print("ladder window (per 128-lane block):")

@@ -262,4 +262,10 @@ def verify_batch(
             PV.verify_pallas_sr, _verify_kernel,
             (*a_dev, r_w, s_w, k_w), r_w.shape[1])
     mask = np.asarray(mask_dev)[:n] & pre_ok & ok_a
+    # host-oracle double-check of rejected lanes (shared policy with the
+    # ed25519 path — see ed25519_kernel.recheck_failed_lanes)
+    from cometbft_tpu.ops.ed25519_kernel import recheck_failed_lanes
+
+    mask = recheck_failed_lanes(
+        mask, pre_ok & ok_a, pubs, msgs, sigs, srm.verify, "sr25519")
     return bool(mask.all()), mask.tolist()

@@ -72,16 +72,26 @@ def words_to_digits5_signed(w: jnp.ndarray) -> jnp.ndarray:
 
     import jax
 
-    def body(carry, d):
-        d = d + carry
-        hi = (d >= 16).astype(jnp.int32)
-        return hi, d - 32 * hi
+    # The carry ripple c_{j+1} = (v_j + c_j >= 16) is a generate/propagate
+    # chain (generate: v_j >= 16; propagate the incoming carry: v_j == 15),
+    # exactly an adder carry-lookahead — solved with a log-depth
+    # associative scan (6 levels for 51 digits) instead of a 51-step
+    # sequential lax.scan.
+    g = (digits >= 16)
+    p = (digits == 15)
 
-    carry_out, signed = jax.lax.scan(
-        body, jnp.zeros_like(digits[0]), digits
-    )
-    # carry_out is provably zero for scalars < 2^253 (see the NDIGITS5
-    # comment: digit 50's post-carry value is <= 8 < 16, so the recoding
-    # never adjusts it); callers enforce s, k < L < 2^253 host-side
-    # (ed25519_kernel.stage_batch rejects s >= L, k is reduced mod L).
+    def op(a, b):
+        ga, pa = a
+        gb, pb = b
+        return ga & pb | gb, pa & pb
+
+    gacc, _ = jax.lax.associative_scan(op, (g, p), axis=0)
+    carry_in = jnp.concatenate(
+        [jnp.zeros_like(gacc[:1]), gacc[:-1]], axis=0).astype(jnp.int32)
+    d = digits + carry_in
+    signed = d - 32 * (d >= 16).astype(jnp.int32)
+    # the carry out of the top digit is provably zero for scalars < 2^253
+    # (see the NDIGITS5 comment: digit 50's post-carry value is <= 8 < 16);
+    # callers enforce s, k < L < 2^253 host-side (ed25519_kernel.stage_batch
+    # rejects s >= L, k is reduced mod L).
     return signed

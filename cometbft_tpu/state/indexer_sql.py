@@ -1,0 +1,155 @@
+"""SQL event sink: the psql-sink schema over sqlite.
+
+Reference: state/indexer/sink/psql (psql.go:40-120 + schema.sql) — a
+relational event sink for operators who query events with SQL instead of
+the KV indexer's query language. Same four tables + joined views
+(blocks / tx_results / events / attributes, event_attributes /
+block_events / tx_events); the engine is sqlite (in this image there is
+no PostgreSQL server — the schema and write paths are engine-portable,
+so pointing it at psql is a connection-string change).
+
+Like the reference's psql sink it is WRITE-ONLY from the node's
+perspective: tx_search/block_search stay on the KV indexer; SQL consumers
+query the database directly (sink/psql/psql.go:33-38 documents the same
+contract).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import time
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS blocks (
+  rowid      INTEGER PRIMARY KEY AUTOINCREMENT,
+  height     INTEGER NOT NULL,
+  chain_id   TEXT NOT NULL,
+  created_at TEXT NOT NULL,
+  UNIQUE (height, chain_id)
+);
+CREATE INDEX IF NOT EXISTS idx_blocks_height_chain ON blocks(height, chain_id);
+
+CREATE TABLE IF NOT EXISTS tx_results (
+  rowid      INTEGER PRIMARY KEY AUTOINCREMENT,
+  block_id   INTEGER NOT NULL REFERENCES blocks(rowid),
+  "index"    INTEGER NOT NULL,
+  created_at TEXT NOT NULL,
+  tx_hash    TEXT NOT NULL,
+  tx_result  BLOB NOT NULL,
+  UNIQUE (block_id, "index")
+);
+
+CREATE TABLE IF NOT EXISTS events (
+  rowid    INTEGER PRIMARY KEY AUTOINCREMENT,
+  block_id INTEGER NOT NULL REFERENCES blocks(rowid),
+  tx_id    INTEGER NULL REFERENCES tx_results(rowid),
+  type     TEXT NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS attributes (
+  event_id      INTEGER NOT NULL REFERENCES events(rowid),
+  key           TEXT NOT NULL,
+  composite_key TEXT NOT NULL,
+  value         TEXT NULL,
+  UNIQUE (event_id, key)
+);
+
+CREATE VIEW IF NOT EXISTS event_attributes AS
+  SELECT block_id, tx_id, type, key, composite_key, value
+  FROM events LEFT JOIN attributes ON (events.rowid = attributes.event_id);
+
+CREATE VIEW IF NOT EXISTS block_events AS
+  SELECT blocks.rowid as block_id, height, chain_id, type, key,
+         composite_key, value
+  FROM blocks JOIN event_attributes ON (blocks.rowid = event_attributes.block_id)
+  WHERE event_attributes.tx_id IS NULL;
+
+CREATE VIEW IF NOT EXISTS tx_events AS
+  SELECT height, "index", chain_id, type, key, composite_key, value,
+         tx_results.created_at
+  FROM blocks JOIN tx_results ON (blocks.rowid = tx_results.block_id)
+  JOIN event_attributes ON (tx_results.rowid = event_attributes.tx_id);
+"""
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class SQLEventSink:
+    """psql.go EventSink: IndexBlockEvents + IndexTxEvents."""
+
+    def __init__(self, path: str, chain_id: str):
+        self.chain_id = chain_id
+        self._db = sqlite3.connect(path)
+        self._db.executescript(_SCHEMA)
+        self._db.commit()
+
+    # --------------------------------------------------------------- write
+
+    def _block_rowid(self, cur, height: int) -> int:
+        cur.execute(
+            "INSERT INTO blocks (height, chain_id, created_at) VALUES (?,?,?) "
+            "ON CONFLICT (height, chain_id) DO UPDATE SET created_at = created_at "
+            "RETURNING rowid",
+            (height, self.chain_id, _now()))
+        return cur.fetchone()[0]
+
+    def _insert_events(self, cur, block_rowid: int, tx_rowid, events) -> None:
+        for ev in events or []:
+            if not ev.type_:
+                continue
+            cur.execute(
+                "INSERT INTO events (block_id, tx_id, type) VALUES (?,?,?)",
+                (block_rowid, tx_rowid, ev.type_))
+            event_id = cur.lastrowid
+            for attr in ev.attributes:
+                if not attr.key:
+                    continue
+                cur.execute(
+                    "INSERT OR IGNORE INTO attributes "
+                    "(event_id, key, composite_key, value) VALUES (?,?,?,?)",
+                    (event_id, attr.key, f"{ev.type_}.{attr.key}", attr.value))
+
+    def index_block_events(self, height: int, events) -> None:
+        """psql.go IndexBlockEvents. Idempotent under re-delivery (indexer
+        re-feed after a crash): prior block-level events for the height are
+        replaced, not duplicated."""
+        cur = self._db.cursor()
+        rowid = self._block_rowid(cur, height)
+        cur.execute(
+            "DELETE FROM attributes WHERE event_id IN "
+            "(SELECT rowid FROM events WHERE block_id = ? AND tx_id IS NULL)",
+            (rowid,))
+        cur.execute(
+            "DELETE FROM events WHERE block_id = ? AND tx_id IS NULL",
+            (rowid,))
+        self._insert_events(cur, rowid, None, events)
+        self._db.commit()
+
+    def index_tx_events(self, tx_results) -> None:
+        """psql.go IndexTxEvents: tx_results carry (height, index, tx,
+        result) — the state.txindex.TxResult shape."""
+        from cometbft_tpu.abci import codec as abci_codec
+        from cometbft_tpu.types.block import tx_hash
+
+        import json as _json
+
+        cur = self._db.cursor()
+        for res in tx_results:
+            rowid = self._block_rowid(cur, res.height)
+            cur.execute(
+                "INSERT OR IGNORE INTO tx_results "
+                "(block_id, \"index\", created_at, tx_hash, tx_result) "
+                "VALUES (?,?,?,?,?)",
+                (rowid, res.index, _now(), tx_hash(res.tx).hex().upper(),
+                 _json.dumps(abci_codec._to_jsonable(res.result)).encode()))
+            if cur.rowcount == 0:
+                continue  # re-delivered tx: events already recorded
+            tx_rowid = cur.lastrowid
+            self._insert_events(
+                cur, rowid, tx_rowid, getattr(res.result, "events", []))
+        self._db.commit()
+
+    def close(self) -> None:
+        self._db.close()

@@ -259,11 +259,13 @@ class IndexerService(BaseService):
     """state/txindex/indexer_service.go: subscribes to the event bus and
     feeds both indexers."""
 
-    def __init__(self, tx_indexer, block_indexer, event_bus, logger=None):
+    def __init__(self, tx_indexer, block_indexer, event_bus, logger=None,
+                 sql_sink=None):
         super().__init__("IndexerService", logger)
         self.tx_indexer = tx_indexer
         self.block_indexer = block_indexer
         self.event_bus = event_bus
+        self.sql_sink = sql_sink  # state.indexer_sql.SQLEventSink | None
         self._tasks = TaskRunner("indexer")
 
     async def on_start(self) -> None:
@@ -288,9 +290,11 @@ class IndexerService(BaseService):
                 if msg is None:
                     return
                 d = msg.data
-                self.block_indexer.index(
-                    d.block.header.height,
-                    getattr(d.result_finalize_block, "events", []))
+                events = getattr(d.result_finalize_block, "events", [])
+                if self.block_indexer is not None:
+                    self.block_indexer.index(d.block.header.height, events)
+                if self.sql_sink is not None:
+                    self.sql_sink.index_block_events(d.block.header.height, events)
 
         async def pump_txs():
             while True:
@@ -298,6 +302,9 @@ class IndexerService(BaseService):
                 if msg is None:
                     return
                 d = msg.data
-                self.tx_indexer.index(TxResult(d.height, d.index, d.tx, d.result))
+                res = TxResult(d.height, d.index, d.tx, d.result)
+                self.tx_indexer.index(res)
+                if self.sql_sink is not None:
+                    self.sql_sink.index_tx_events([res])
 
         await asyncio.gather(pump_blocks(), pump_txs())

@@ -1,0 +1,79 @@
+"""SQL event sink (VERDICT r3 missing item 9; reference
+state/indexer/sink/psql): the psql-sink schema over sqlite, fed by the
+indexer service on a live node, queryable with plain SQL through the
+schema's joined views.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import sqlite3
+
+from cometbft_tpu.node import Node, init_files
+from cometbft_tpu.state.indexer_sql import SQLEventSink
+from cometbft_tpu.state.txindex import TxResult
+from cometbft_tpu.abci.types import Event, EventAttribute, ExecTxResult
+
+from tests.test_node import _node_config, _rpc_call
+
+
+def test_sink_schema_and_views(tmp_path):
+    path = str(tmp_path / "events.sqlite")
+    sink = SQLEventSink(path, "sql-chain")
+    sink.index_block_events(1, [
+        Event(type_="begin", attributes=[
+            EventAttribute(key="k", value="v", index=True)])])
+    res = ExecTxResult(code=0, events=[
+        Event(type_="app", attributes=[
+            EventAttribute(key="who", value="alice", index=True)])])
+    sink.index_tx_events([TxResult(height=1, index=0, tx=b"t=1", result=res)])
+    sink.close()
+
+    db = sqlite3.connect(path)
+    # block dedup: one blocks row serves both block and tx events
+    assert db.execute("SELECT COUNT(*) FROM blocks").fetchone()[0] == 1
+    rows = db.execute(
+        "SELECT type, key, value FROM block_events WHERE height = 1").fetchall()
+    assert ("begin", "k", "v") in rows
+    rows = db.execute(
+        "SELECT type, composite_key, value FROM tx_events "
+        "WHERE height = 1 AND \"index\" = 0").fetchall()
+    assert ("app", "app.who", "alice") in rows
+    db.close()
+
+
+def test_sql_sink_on_live_node(tmp_path):
+    home = str(tmp_path / "home")
+    init_files(home, chain_id="sqlsink-chain", moniker="sq0")
+
+    async def main():
+        cfg = _node_config(home)
+        cfg.tx_index.indexer = "sql"
+        node = Node(cfg)
+        await node.start()
+        try:
+            addr = node.rpc_server.bound_addr
+            tx = b"sqlkey=sqlval"
+            resp = await asyncio.wait_for(_rpc_call(
+                addr, "broadcast_tx_commit",
+                {"tx": base64.b64encode(tx).decode()}), 15)
+            h = int(resp["result"]["height"])
+            await asyncio.sleep(0.3)  # let the indexer pump drain
+        finally:
+            await node.stop()
+
+        db = sqlite3.connect(cfg.db_path("tx_events"))
+        got = db.execute(
+            "SELECT tx_hash FROM tx_results JOIN blocks "
+            "ON blocks.rowid = tx_results.block_id WHERE height = ?",
+            (h,)).fetchall()
+        assert len(got) == 1
+        # tx event attributes are queryable relationally
+        rows = db.execute(
+            "SELECT value FROM tx_events WHERE composite_key = 'app.key'"
+        ).fetchall()
+        assert ("sqlkey",) in rows
+        db.close()
+
+    asyncio.run(main())
