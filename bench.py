@@ -81,22 +81,23 @@ def _mk_sigs(n, n_keys):
     return privs, pubs, msgs, sigs
 
 
-def bench_device_compute(K, a_dev, rwd, swd, kwd, rep_pair=(2, 8)) -> float:
+def bench_device_compute(verify_fn, a_dev, rwd, swd, kwd,
+                         rep_pair=(2, 8)) -> float:
     """Kernel-only ms per batch via rep-differencing through the tunnel.
     rep_pair must put enough device work between the two points to clear
-    the tunnel noise — small batches need a wide pair like (8, 64)."""
+    the tunnel noise — small batches need a wide pair like (8, 64).
+    verify_fn: the per-chip verify program (Pallas or XLA path)."""
     import functools
 
     import jax
     import jax.numpy as jnp
 
-    from cometbft_tpu.ops import pallas_verify as PV
-
     @functools.partial(jax.jit, static_argnames=("reps",))
     def run_n(ax, ay, az, at, rw, sw, kw, reps=1):
         acc = jnp.zeros((), jnp.int32)
         for i in range(reps):
-            acc = acc + PV.verify_pallas(ax, ay, az, at, rw, sw + jnp.uint32(i), kw).sum()
+            acc = acc + verify_fn(
+                ax, ay, az, at, rw, sw + jnp.uint32(i), kw).sum()
         return acc
 
     lo, hi = rep_pair
@@ -104,12 +105,49 @@ def bench_device_compute(K, a_dev, rwd, swd, kwd, rep_pair=(2, 8)) -> float:
     for reps in rep_pair:
         run_n(*a_dev, rwd, swd, kwd, reps=reps).block_until_ready()
         ts = []
-        for _ in range(6):
+        for _ in range(4):
             t0 = time.perf_counter()
             run_n(*a_dev, rwd, swd, kwd, reps=reps).block_until_ready()
             ts.append(time.perf_counter() - t0)
         out[reps] = min(ts)
     return (out[hi] - out[lo]) / (hi - lo) * 1e3
+
+
+def measure_device_compute(verify_fn, a_dev, rwd, swd, kwd, rep_pair=(2, 8),
+                           tol_pct=10.0, max_tries=6):
+    """Defensible device-compute time: rep-difference repeatedly until the
+    two SMALLEST runs agree within tol_pct (dev-box contention only ever
+    inflates a slope, so the two quietest runs bracket the true kernel
+    time), refusing non-positive slopes (a too-narrow pair under tunnel
+    noise). Returns (best_ms, runs_ms, repeatability_pct); repeatability is
+    None when only ONE positive run was obtained (never a fabricated 0.0),
+    and a value > tol_pct means the runs did not converge — both cases are
+    recorded as-is so the artifact is honest about its own quality. Raises
+    only if no positive slope was ever measured."""
+    runs: list[float] = []
+    pair = rep_pair
+    for _ in range(max_tries):
+        ms = bench_device_compute(verify_fn, a_dev, rwd, swd, kwd, pair)
+        if ms <= 0:
+            # widen: more device work between the two points (capped — a
+            # runaway widening loop under heavy box contention must not
+            # stall the whole bench; each retry also consumes a try)
+            pair = (pair[0], min(pair[1] * 2, 64))
+            continue
+        runs.append(ms)
+        if len(runs) >= 2:
+            lo2 = sorted(runs)[:2]
+            rep = (lo2[1] - lo2[0]) / lo2[0] * 100
+            if rep <= tol_pct:
+                return lo2[0], [round(r, 2) for r in runs], round(rep, 1)
+    if not runs:
+        raise RuntimeError(
+            f"no positive slope after {max_tries} tries (pair widened to {pair})")
+    if len(runs) == 1:
+        return runs[0], [round(runs[0], 2)], None
+    lo2 = sorted(runs)[:2]
+    return lo2[0], [round(r, 2) for r in runs], round(
+        (lo2[1] - lo2[0]) / lo2[0] * 100, 1)
 
 
 def bench_blocksync(detail: dict) -> None:
@@ -175,14 +213,9 @@ def bench_blocksync(detail: dict) -> None:
 def bench_mixed_megacommit(detail: dict) -> None:
     """BASELINE config 5: a mixed ed25519+sr25519 10k-validator mega-commit
     through MixedBatchVerifier — half the rows each scheme, one device batch
-    per scheme. Reports wall latency (tunnel-inclusive) plus the first
-    recorded device-compute number for the sr25519 kernel
-    (rep-differenced, XLA ladder — no Pallas path for sr25519 yet)."""
-    import functools
-
-    import jax
-    import jax.numpy as jnp
-
+    per scheme, both dispatched async and resolved with one fetch. Reports
+    wall latency (tunnel-inclusive), a host-staging/device/tunnel
+    decomposition, and the sr25519 kernel's rep-differenced device time."""
     from cometbft_tpu.crypto import batch as crypto_batch
     from cometbft_tpu.crypto import ed25519, sr25519
 
@@ -222,58 +255,77 @@ def bench_mixed_megacommit(detail: dict) -> None:
         return dt
 
     run()  # warm both kernels' compiles
-    detail["mixed_megacommit_ms"] = round(min(run() for _ in range(2)) * 1e3, 2)
+    detail["mixed_megacommit_ms"] = round(min(run() for _ in range(3)) * 1e3, 2)
     detail["mixed_megacommit_shape"] = f"{n_half} ed25519 + {n_half} sr25519"
-    # decomposition: the wall number is dominated by the per-row Merlin
-    # transcript (pure-Python STROBE, ~1.4 ms/row) — host staging, not
-    # device; the device share is the two kernel dispatches
-    t0 = time.perf_counter()
+    # decomposition: host staging (pure host work, measured directly) vs
+    # device compute (rep-differenced below) vs the ~89 ms tunnel RTT the
+    # synchronous mask fetch pays on this dev box. staging+device is the
+    # co-located estimate — what the commit-verify costs with the chip
+    # attached to the host (BASELINE's <5 ms north star assumes that).
     from cometbft_tpu.crypto import sr25519_math as srm
-
-    probe = rows[n_half]
-    parsed = srm.parse_signature(probe[2])
-    for _ in range(8):
-        srm.compute_challenge(probe[0].bytes_(), parsed[0], probe[1])
-    detail["mixed_host_challenge_ms_per_row"] = round(
-        (time.perf_counter() - t0) / 8 * 1e3, 2)
-
-    # sr25519 device compute, rep-differenced on the staged sub-batch via
-    # the production Pallas path (falls back to the XLA ladder only if the
-    # Pallas trace fails)
     from cometbft_tpu.ops import ed25519_kernel as EK
     from cometbft_tpu.ops import pallas_verify as PVsr
     from cometbft_tpu.ops import sr25519_kernel as SRK
 
-    pubs = [pk.bytes_() for pk, _, _ in rows[n_half:]]
-    msgs = [m for _, m, _ in rows[n_half:]]
-    sigs = [s for _, _, s in rows[n_half:]]
+    ed_rows = rows[:n_half]
+    sr_rows = rows[n_half:]
+    t0 = time.perf_counter()
+    eb = EK.bucket_size(n_half)
+    EK.stage_batch([p.bytes_() for p, _, _ in ed_rows],
+                   [m for _, m, _ in ed_rows],
+                   [s for _, _, s in ed_rows], eb)
+    t_ed_stage = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    pubs = [pk.bytes_() for pk, _, _ in sr_rows]
+    msgs = [m for _, m, _ in sr_rows]
+    sigs = [s for _, _, s in sr_rows]
     _, _, _, a_dev, rw, sw, kw = SRK.stage_batch_sr(pubs, msgs, sigs)
+    t_sr_stage = time.perf_counter() - t0
+    # rep-differencing must not re-transfer per call: pin the word arrays
+    # on device once
+    import jax.numpy as jnp
 
+    rw, sw, kw = jnp.asarray(rw), jnp.asarray(sw), jnp.asarray(kw)
+    detail["mixed_host_staging_ms"] = round((t_ed_stage + t_sr_stage) * 1e3, 1)
+    detail["mixed_host_staging_split_ms"] = {
+        "ed25519": round(t_ed_stage * 1e3, 1),
+        "sr25519": round(t_sr_stage * 1e3, 1),
+    }
+    # per-row Merlin challenge cost (native batch path), for comparison
+    # with r4's 0.03 ms/row ctypes-per-op number
+    t0 = time.perf_counter()
+    srm.batch_compute_challenges(
+        pubs[:1024], [s[:32] for s in sigs[:1024]], msgs[:1024])
+    detail["mixed_host_challenge_us_per_row"] = round(
+        (time.perf_counter() - t0) / 1024 * 1e6, 2)
+
+    # sr25519 device compute, rep-differenced on the staged sub-batch via
+    # the production Pallas path (falls back to the XLA ladder only if the
+    # Pallas trace fails). Pair (2, 8) puts ~60 ms of device work between
+    # the two timing points (r4's (1, 4) was swamped by tunnel noise and
+    # recorded a negative slope); measure_device_compute refuses
+    # non-positive slopes and loops until two quiet runs agree.
     use_pallas = (EK._pallas_available()
                   and rw.shape[1] % PVsr.LANES == 0
                   and not SRK._pallas_gate.broken)
     sr_fn = PVsr.verify_pallas_sr if use_pallas else SRK.verify_math_sr
     detail["sr25519_device_path"] = "pallas" if use_pallas else "xla"
-
-    @functools.partial(jax.jit, static_argnames=("reps",))
-    def run_n(ax, ay, az, at, rw_, sw_, kw_, reps=1):
-        acc = jnp.zeros((), jnp.int32)
-        for i in range(reps):
-            acc = acc + sr_fn(
-                ax, ay, az, at, rw_, sw_ + jnp.uint32(i), kw_).sum()
-        return acc
-
-    out = {}
-    for reps in (1, 4):
-        run_n(*a_dev, rw, sw, kw, reps=reps).block_until_ready()
-        ts = []
-        for _ in range(5):
-            t0 = time.perf_counter()
-            run_n(*a_dev, rw, sw, kw, reps=reps).block_until_ready()
-            ts.append(time.perf_counter() - t0)
-        out[reps] = min(ts)
-    detail["sr25519_device_compute_ms"] = round((out[4] - out[1]) / 3 * 1e3, 2)
+    sr_best, sr_runs, sr_rep = measure_device_compute(
+        sr_fn, a_dev, rw, sw, kw, rep_pair=(2, 8))
+    detail["sr25519_device_compute_ms"] = round(sr_best, 2)
+    detail["sr25519_device_runs_ms"] = sr_runs
+    detail["sr25519_device_repeatability_pct"] = sr_rep
     detail["sr25519_device_batch"] = rw.shape[1]
+    ed_ms = detail.get("device_compute_ms_per_batch")
+    if isinstance(ed_ms, (int, float)):
+        # scale the 10240-lane ed number to this bench's ed sub-batch
+        ed_share = ed_ms * EK.bucket_size(n_half) / EK.bucket_size(BATCH)
+        detail["mixed_colocated_estimate_ms"] = round(
+            detail["mixed_host_staging_ms"] + ed_share + sr_best, 1)
+        detail["mixed_colocated_note"] = (
+            "host staging + both schemes' rep-differenced device compute; "
+            "the wall number above additionally pays the dev-box tunnel "
+            "(~89 ms RTT on the mask fetch + ~45 ms/MB transfers)")
 
 
 def bench_light_client(detail: dict) -> None:
@@ -307,6 +359,8 @@ def bench_light_client(detail: dict) -> None:
         def __init__(self):
             self._valsets: dict[int, tuple] = {}
             self._blocks: dict[int, LightBlock] = {}
+            self.gen_s = 0.0  # harness block-generation time (Python
+            # signing of LC_VALS votes/block — NOT client work)
 
         def _valset(self, h):
             ver = h // CHURN_EVERY
@@ -326,6 +380,12 @@ def bench_light_client(detail: dict) -> None:
             lb = self._blocks.get(h)
             if lb is not None:
                 return lb
+            _t0 = time.perf_counter()
+            lb = self._gen_block(h)
+            self.gen_s += time.perf_counter() - _t0
+            return lb
+
+        def _gen_block(self, h):
             vs, privs = self._valset(h)
             nvs, _ = self._valset(h + 1)
             header = Header(
@@ -371,14 +431,45 @@ def bench_light_client(detail: dict) -> None:
             provider, [LazyChain()], LightStore(MemDB()),
         )
         await client.initialize()
-        t0 = time.perf_counter()
-        await client.verify_light_block_at_height(LC_HEIGHT)
-        wall = time.perf_counter() - t0
-        return wall, client.store.size()
+        # decompose the hop: harness generation (provider.gen_s), device
+        # prefetch (wrapped), remainder = client host work
+        from cometbft_tpu.types import validation as _val
 
-    wall, hops = asyncio.run(run())
+        fetch = {"s": 0.0}
+        orig = _val.prefetch_staged
+
+        def timed_prefetch(staged):
+            t0 = time.perf_counter()
+            try:
+                return orig(staged)
+            finally:
+                fetch["s"] += time.perf_counter() - t0
+
+        _val.prefetch_staged = timed_prefetch
+        # the verifier imported the symbol directly — patch there too
+        from cometbft_tpu.light import verifier as _verif
+
+        _verif.prefetch_staged = timed_prefetch
+        gen0 = provider.gen_s
+        try:
+            t0 = time.perf_counter()
+            await client.verify_light_block_at_height(LC_HEIGHT)
+            wall = time.perf_counter() - t0
+        finally:
+            _val.prefetch_staged = orig
+            _verif.prefetch_staged = orig
+        return wall, client.store.size(), provider.gen_s - gen0, fetch["s"]
+
+    wall, hops, gen_s, fetch_s = asyncio.run(run())
     detail["lc_bisection_s"] = round(wall, 2)
     detail["lc_bisection_hops"] = hops
+    detail["lc_client_s"] = round(wall - gen_s, 2)
+    detail["lc_hop_breakdown_ms"] = {
+        "harness_block_generation": round(gen_s / max(hops, 1) * 1e3, 1),
+        "device_prefetch": round(fetch_s / max(hops, 1) * 1e3, 1),
+        "client_host_other": round(
+            (wall - gen_s - fetch_s) / max(hops, 1) * 1e3, 1),
+    }
     detail["lc_shape"] = f"height {LC_HEIGHT}, {LC_VALS} validators, churn every {CHURN_EVERY}"
 
 
@@ -486,14 +577,14 @@ def main() -> None:
     device_sigs_per_s = None
     _progress("device compute rep-differencing")
     try:
+        from cometbft_tpu.ops import pallas_verify as PV
+
+        ed_fn = PV.verify_pallas if K._pallas_available() else K.verify_math
         args = (jnp.asarray(rw), jnp.asarray(sw), jnp.asarray(kw))
-        dc1 = bench_device_compute(K, a_dev, *args)
-        dc2 = bench_device_compute(K, a_dev, *args)
-        best = min(dc1, dc2)
+        best, runs, rep = measure_device_compute(ed_fn, a_dev, *args)
         detail["device_compute_ms_per_batch"] = round(best, 2)
-        detail["device_compute_runs_ms"] = [round(dc1, 2), round(dc2, 2)]
-        detail["device_repeatability_pct"] = round(
-            abs(dc1 - dc2) / best * 100, 1)
+        detail["device_compute_runs_ms"] = runs
+        detail["device_repeatability_pct"] = rep
         device_sigs_per_s = BATCH / (best / 1e3)
         detail["device_sigs_per_s"] = round(device_sigs_per_s, 1)
     except Exception as e:  # noqa: BLE001 - CPU backend has no pallas path
@@ -504,12 +595,16 @@ def main() -> None:
     # rep-differenced device time for one flush-sized batch — the
     # non-tunnel cost of a vote-path flush
     try:
+        from cometbft_tpu.ops import pallas_verify as PV
+
+        ed_fn = PV.verify_pallas if K._pallas_available() else K.verify_math
         fb = K.bucket_size(128)
         _, fp, frw, fsw, fkw = K.stage_batch(pubs[:128], msgs[:128], sigs[:128], fb)
         _, fa_dev = cache.stage(fp, fb)
-        detail["vote_flush_device_ms"] = round(bench_device_compute(
-            K, fa_dev, jnp.asarray(frw), jnp.asarray(fsw), jnp.asarray(fkw),
-            rep_pair=(8, 64)), 3)
+        fl_best, _, _ = measure_device_compute(
+            ed_fn, fa_dev, jnp.asarray(frw), jnp.asarray(fsw),
+            jnp.asarray(fkw), rep_pair=(8, 64))
+        detail["vote_flush_device_ms"] = round(fl_best, 3)
     except Exception as e:  # noqa: BLE001
         detail["vote_flush_device_ms"] = f"skipped: {e}"
 

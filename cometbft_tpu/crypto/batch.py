@@ -103,8 +103,21 @@ class MixedBatchVerifier(crypto.BatchVerifier):
         self._route.append((kt, sub.count() - 1))
 
     def verify(self) -> tuple[bool, list[bool]]:
-        masks = {kt: sub.verify()[1] for kt, sub in self._subs.items()}
-        out = [masks[kt][i] for kt, i in self._route]
+        if len(self._subs) > 1 and all(
+            hasattr(sub, "verify_async") for sub in self._subs.values()
+        ):
+            # device backends: dispatch every scheme's sub-batch without
+            # blocking, then resolve ALL masks with one device->host fetch
+            # (over a high-RTT link the serial per-scheme sync path paid
+            # one full round trip per scheme)
+            from cometbft_tpu.ops import ed25519_kernel
+
+            thunks = {kt: sub.verify_async() for kt, sub in self._subs.items()}
+            resolved = ed25519_kernel.resolve_batches(list(thunks.values()))
+            masks = {kt: m for kt, m in zip(thunks, resolved)}
+        else:
+            masks = {kt: sub.verify()[1] for kt, sub in self._subs.items()}
+        out = [bool(masks[kt][i]) for kt, i in self._route]
         return all(out), out
 
     def count(self) -> int:

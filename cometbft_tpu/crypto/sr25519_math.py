@@ -451,6 +451,36 @@ def compute_challenge(pub: bytes, r_bytes: bytes, msg: bytes) -> int:
     return t.challenge_scalar(b"sign:c")
 
 
+def batch_compute_challenges(
+    pubs: list[bytes], r_list: list[bytes], msgs: list[bytes]
+) -> list[int]:
+    """All N verification challenges in one native call (strobe.c
+    sr25519_batch_challenge): the whole Merlin transcript per row runs in C,
+    so the per-row cost is keccak-bound, not ctypes-bound. Equivalence with
+    compute_challenge is asserted by tests/test_sr25519.py. Falls back to
+    the per-row path without the native library."""
+    n = len(pubs)
+    if n == 0:
+        return []
+    if _NATIVE is None or not hasattr(_NATIVE, "sr25519_batch_challenge"):
+        return [compute_challenge(p, r, m)
+                for p, r, m in zip(pubs, r_list, msgs)]
+    import ctypes
+
+    import numpy as np
+
+    msg_buf = b"".join(msgs)
+    offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(m) for m in msgs], out=offs[1:])
+    out = ctypes.create_string_buffer(64 * n)
+    _NATIVE.sr25519_batch_challenge(
+        b"".join(pubs), b"".join(r_list), msg_buf,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, out)
+    raw = out.raw
+    return [int.from_bytes(raw[64 * i: 64 * i + 64], "little") % L
+            for i in range(n)]
+
+
 def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
     parsed = parse_signature(sig)
     if parsed is None:

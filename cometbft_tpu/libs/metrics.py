@@ -247,6 +247,17 @@ class CryptoMetrics:
             "crypto", "device_lanes", "Signature lanes dispatched", labels=("kind",))
         self.device_seconds = reg.counter(
             "crypto", "device_seconds", "Estimated device-busy seconds")
+        # transfer-integrity plane: a tunnel-attached device must EARN the
+        # in-process-memory trust the reference assumes (validation.go:235)
+        self.transfer_checksum_mismatch = reg.counter(
+            "crypto", "transfer_checksum_mismatch",
+            "Host->device staging checksum failures detected on device")
+        self.mask_echo_mismatch = reg.counter(
+            "crypto", "mask_echo_mismatch",
+            "Device->host mask fetches whose redundant echo disagreed")
+        self.mask_oracle_disagreement = reg.counter(
+            "crypto", "mask_oracle_disagreement",
+            "Device-rejected lanes the host oracle re-accepted")
 
 
 _global: Optional[Registry] = None

@@ -36,6 +36,14 @@ class TPUBatchVerifier(crypto.BatchVerifier):
             self._pubs, self._msgs, self._sigs, cache=self._cache
         )
 
+    def verify_async(self):
+        """Dispatch without blocking; resolve via
+        ed25519_kernel.resolve_batches (MixedBatchVerifier coalesces the
+        fetch across schemes)."""
+        return ed25519_kernel.verify_batch_async(
+            self._pubs, self._msgs, self._sigs, cache=self._cache
+        )
+
     def count(self) -> int:
         return len(self._sigs)
 
@@ -63,6 +71,12 @@ class SrTPUBatchVerifier(crypto.BatchVerifier):
         from cometbft_tpu.ops import sr25519_kernel
 
         return sr25519_kernel.verify_batch(self._pubs, self._msgs, self._sigs)
+
+    def verify_async(self):
+        from cometbft_tpu.ops import sr25519_kernel
+
+        return sr25519_kernel.verify_batch_async(
+            self._pubs, self._msgs, self._sigs)
 
     def count(self) -> int:
         return len(self._sigs)

@@ -116,6 +116,119 @@ def _pallas_available() -> bool:
 # this mandatory.
 from cometbft_tpu.ops.dispatch import KERNEL_DISPATCH_LOCK as _dispatch_lock
 
+# ---------------------------------------------------------------------------
+# Transfer integrity. The axon tunnel has produced isolated single-lane
+# corruption under load (observed twice across ~10 bench runs); the
+# reference trusts in-process memory (types/validation.go:235) — a
+# tunnel-attached device must earn that trust explicitly:
+#   host->device: a position-weighted checksum of the staged r/s/k words is
+#     recomputed ON DEVICE and compared to the host's value; the verdict
+#     rides back inside the verify payload (no extra round trip).
+#   device->host: the mask travels twice (mask + bitwise complement); an
+#     echo mismatch flags fetch-path corruption.
+# A failed check is counted, logged, retried once with a fresh transfer,
+# and — if still failing — the batch falls back to the exact host oracle,
+# so corruption is *detected and contained*, never silently tolerated.
+# ---------------------------------------------------------------------------
+
+_CHK_MULT = np.uint64(2654435761)  # Knuth multiplicative-hash odd constant
+
+
+def _host_checksum(*arrs: np.ndarray) -> int:
+    """Position-weighted sum mod 2^32 over the arrays' uint32 views, in
+    ravel order — bit-identical to _device_checksum."""
+    acc = 0
+    off = 0
+    for a in arrs:
+        flat = np.ascontiguousarray(a).view(np.uint32).ravel().astype(np.uint64)
+        idx = np.arange(off, off + flat.size, dtype=np.uint64)
+        w = (idx * _CHK_MULT + 1) & 0xFFFFFFFF
+        acc = (acc + int(((flat * w) & 0xFFFFFFFF).sum() & 0xFFFFFFFF)) & 0xFFFFFFFF
+        off += flat.size
+    return acc
+
+
+def _device_checksum_expr(arrs) -> jnp.ndarray:
+    """The device-side mirror of _host_checksum (traced inside the payload
+    jit)."""
+    acc = jnp.uint32(0)
+    off = 0
+    for a in arrs:
+        if a.dtype == jnp.int32:
+            flat = jax.lax.bitcast_convert_type(a, jnp.uint32).ravel()
+        else:
+            flat = a.astype(jnp.uint32).ravel()
+        idx = jax.lax.iota(jnp.uint32, flat.size) + jnp.uint32(off)
+        w = idx * jnp.uint32(2654435761) + jnp.uint32(1)
+        acc = acc + (flat * w).sum(dtype=jnp.uint32)
+        off += flat.size
+    return acc
+
+
+_device_checksum = jax.jit(_device_checksum_expr)
+
+
+@jax.jit
+def _integrity_payload(mask, rw, sw, kw, expected):
+    """(2B+1,) bool payload: [mask, ~mask (echo), staging-checksum ok]."""
+    chk = _device_checksum_expr((rw, sw, kw))
+    ok = (chk == expected.astype(jnp.uint32))
+    return jnp.concatenate([mask, ~mask, ok[None]])
+
+
+def decode_payload(payload: np.ndarray, n, pre_ok, ok_a, rows, info,
+                   redo=None) -> np.ndarray:
+    """Validate the integrity payload and produce the final (N,) mask.
+    On checksum/echo failure: count, log, retry once with a fresh transfer
+    (redo), then fall back to the exact host oracle for the whole batch."""
+    b = (payload.shape[0] - 1) // 2
+    mask = payload[:b].copy()
+    echo = payload[b:2 * b]
+    chk_ok = bool(payload[2 * b])
+    echo_ok = bool((mask != echo).all())  # echo is the complement
+    if not (chk_ok and echo_ok):
+        from cometbft_tpu.libs import log as _log
+
+        _count_integrity(
+            "transfer_checksum_mismatch" if not chk_ok else "mask_echo_mismatch")
+        _log.default().error(
+            "device transfer integrity check failed",
+            scheme=info[1], staging_checksum_ok=str(chk_ok),
+            mask_echo_ok=str(echo_ok),
+            action="retry" if redo is not None else "host-oracle fallback")
+        if redo is not None:
+            return decode_payload(
+                np.asarray(redo()), n, pre_ok, ok_a, rows, info, redo=None)
+        verify_fn = info[0]
+        pubs, msgs, sigs = rows
+        host = np.fromiter(
+            (verify_fn(p, m, s) for p, m, s in zip(pubs, msgs, sigs)),
+            dtype=bool, count=n)
+        return host & pre_ok & ok_a
+    mask = mask[:n] & pre_ok & ok_a
+    return apply_recheck(mask, pre_ok & ok_a, rows, info)
+
+
+_crypto_metrics = None
+_crypto_metrics_lock = __import__("threading").Lock()
+
+
+def _count_integrity(kind: str, n: int = 1) -> None:
+    global _crypto_metrics
+    try:
+        if _crypto_metrics is None:
+            # racing inits would register duplicate counter series in the
+            # global registry (Registry._register appends without dedup)
+            with _crypto_metrics_lock:
+                if _crypto_metrics is None:
+                    from cometbft_tpu.libs import metrics as _metrics
+
+                    _crypto_metrics = _metrics.CryptoMetrics(
+                        _metrics.global_registry())
+        getattr(_crypto_metrics, kind).inc(n)
+    except Exception:  # noqa: BLE001 - metrics must never break verification
+        pass
+
 
 from cometbft_tpu.ops.dispatch import PallasGate
 
@@ -162,6 +275,10 @@ class PubKeyCache:
     the A-coordinate upload (3.3 MB at 10k lanes) drops to zero.
     """
 
+    # subclasses (sr25519) swap in their scheme's device decompressor;
+    # staticmethod so instances share one slot
+    _decompress = staticmethod(lambda enc: decompress_points(enc))
+
     def __init__(self, capacity: int = 65536, device_slots: int = 8):
         self.capacity = capacity
         self.device_slots = device_slots
@@ -173,7 +290,7 @@ class PubKeyCache:
         missing = [p for p in dict.fromkeys(pubs) if p not in self._map]
         if missing:
             enc = np.frombuffer(b"".join(missing), dtype=np.uint8).reshape(-1, 32)
-            ok, coords = decompress_points(enc)
+            ok, coords = self._decompress(enc)
             evict = min(len(self._map), len(self._map) + len(missing) - self.capacity)
             for _ in range(max(0, evict)):
                 self._map.pop(next(iter(self._map)))
@@ -207,11 +324,62 @@ class PubKeyCache:
             id_coords[:, 2, 0] = 1  # Z = 1
             coords = np.concatenate([coords, id_coords])
         put = put or jax.device_put
-        dev = tuple(put(np.ascontiguousarray(coords[:, i].T)) for i in range(4))
+        host_arrs = tuple(np.ascontiguousarray(coords[:, i].T) for i in range(4))
+        expected = _host_checksum(*host_arrs)
+        dev = None
+        for attempt in (1, 2):
+            dev = tuple(put(a) for a in host_arrs)
+            # upload-time integrity check: a corrupted coordinate table
+            # would poison EVERY batch against this valset until eviction,
+            # so the one extra round trip per cache miss is paid here
+            got = int(np.asarray(_device_checksum(dev)))
+            if got == expected:
+                break
+            _count_integrity("transfer_checksum_mismatch")
+            from cometbft_tpu.libs import log as _log
+
+            _log.default().error(
+                "pubkey coordinate upload failed integrity check",
+                attempt=str(attempt))
+            if attempt == 2:
+                raise RuntimeError(
+                    "pubkey coordinate upload corrupted twice; refusing to "
+                    "cache a poisoned table")
         if len(self._dev) >= self.device_slots:
             self._dev.pop(next(iter(self._dev)))
         self._dev[digest] = (ok_a, dev)
         return ok_a, dev
+
+
+@jax.jit
+def _gather_coords(dev_u, idx):
+    """Device-side gather: unique-pubkey coordinate table (20, U) -> per-lane
+    A-coordinates (20, B). Runs as a plain XLA op enqueued before the verify
+    kernel — no host round trip."""
+    return tuple(jnp.take(c, idx, axis=1) for c in dev_u)
+
+
+def _stage_gather(cache: "PubKeyCache", pubs: list[bytes], bucket: int,
+                  put_key: str = "") -> tuple[np.ndarray, tuple]:
+    """(ok_a (N,), (ax, ay, az, at) device arrays (20, bucket)) via a
+    device-side gather from the UNIQUE pubkey table. A batch that repeats a
+    validator set W times (the coalesced blocksync window) uploads ONE copy
+    of the coordinates (digest-cached across windows, since the unique set
+    is stable even when window composition changes) plus a 4-byte/lane index
+    vector — not W copies keyed on the exact concatenation."""
+    uniq = list(dict.fromkeys(pubs))
+    # an identity pad slot is needed only when padding lanes exist; when the
+    # batch fills its bucket exactly (n == bucket == cap is legal) the +1
+    # would overflow the lane cap
+    need_pad = bucket > len(pubs)
+    bu = bucket_size(len(uniq) + 1 if need_pad else len(uniq))
+    ok_u, dev_u = cache.stage(uniq, bu, put_key=put_key)
+    pos = {p: i for i, p in enumerate(uniq)}
+    idx = np.full(bucket, len(uniq), dtype=np.int32)  # padding -> identity
+    idx[: len(pubs)] = [pos[p] for p in pubs]
+    ok_a = np.asarray(ok_u)[idx[: len(pubs)]]
+    idx_dev = jax.device_put(idx)
+    return ok_a, _gather_coords(dev_u, idx_dev)
 
 
 _default_cache = PubKeyCache()
@@ -318,6 +486,7 @@ def recheck_failed_lanes(mask, eligible, pubs, msgs, sigs,
     if flipped:
         from cometbft_tpu.libs import log as _log
 
+        _count_integrity("mask_oracle_disagreement", len(flipped))
         _log.default().error(
             "device verify mask disagreed with host oracle; honoring host",
             scheme=scheme, lanes=str(flipped))
@@ -329,33 +498,57 @@ def _recheck_failed_lanes(mask, eligible, pubs, msgs, sigs):
         mask, eligible, pubs, msgs, sigs, oracle.verify_zip215, "ed25519")
 
 
+def apply_recheck(mask, eligible, rows, info):
+    """Host-oracle recheck with optional per-group budgets: info is
+    (verify_fn, scheme, groups). A coalesced window passes its per-commit
+    row boundaries as groups so each commit keeps its own _RECHECK_MAX
+    budget — one genuinely-bad commit must not suppress the
+    transfer-corruption recheck for its window-mates."""
+    verify_fn, scheme, groups = info
+    pubs, msgs, sigs = rows
+    if not groups:
+        return recheck_failed_lanes(
+            mask, eligible, pubs, msgs, sigs, verify_fn, scheme)
+    for a, b in groups:
+        mask[a:b] = recheck_failed_lanes(
+            mask[a:b], eligible[a:b], pubs[a:b], msgs[a:b], sigs[a:b],
+            verify_fn, scheme)
+    return mask
+
+
 def verify_batch_async(
     pubs: list[bytes],
     msgs: list[bytes],
     sigs: list[bytes],
     cache: PubKeyCache | None = None,
+    recheck_groups: list[tuple[int, int]] | None = None,
 ):
     """Stage + dispatch without blocking on the device: returns a thunk that
     materializes the (N,) bool mask. Lets callers (blocksync streaming,
     VoteSet flush) overlap host staging of batch N+1 with device compute of
-    batch N."""
+    batch N. recheck_groups: per-commit row boundaries of a coalesced
+    window (see apply_recheck)."""
     n = len(sigs)
     assert len(pubs) == n and len(msgs) == n
     if n == 0:
         empty = lambda: np.zeros(0, dtype=bool)  # noqa: E731
         empty.device_parts = lambda: (
-            None, 0, np.zeros(0, bool), np.zeros(0, bool), ([], [], []))
+            None, 0, np.zeros(0, bool), np.zeros(0, bool), ([], [], []),
+            (oracle.verify_zip215, "ed25519", None), None)
         return empty
     cache = cache or _default_cache
 
     b = bucket_size(n)
     pre_ok, safe_pubs, r_words, s_words, k_words = stage_batch(pubs, msgs, sigs, b)
-    ok_a, a_dev = cache.stage(safe_pubs, b)
+    ok_a, a_dev = _stage_gather(cache, safe_pubs, b)
+    expected = np.uint32(_host_checksum(r_words, s_words, k_words))
 
     def _transfer_and_dispatch():
-        return _dispatch_verify(
-            a_dev, jnp.asarray(r_words), jnp.asarray(s_words), jnp.asarray(k_words)
-        )
+        rw = jnp.asarray(r_words)
+        sw = jnp.asarray(s_words)
+        kw = jnp.asarray(k_words)
+        mask = _dispatch_verify(a_dev, rw, sw, kw)
+        return _integrity_payload(mask, rw, sw, kw, expected)
 
     # The host->device copy blocks the calling thread for the wire time
     # (~45 ms/MB through the axon tunnel), so it runs on a small pool:
@@ -364,13 +557,15 @@ def verify_batch_async(
     fut = _xfer_pool().submit(_transfer_and_dispatch)
 
     rows = (safe_pubs, list(msgs), list(sigs))
+    info = (oracle.verify_zip215, "ed25519", recheck_groups)
 
     def result() -> np.ndarray:
-        mask_dev = fut.result()
-        mask = np.asarray(mask_dev)[:n] & pre_ok & ok_a
-        return _recheck_failed_lanes(mask, pre_ok & ok_a, *rows)
+        return decode_payload(
+            np.asarray(fut.result()), n, pre_ok, ok_a, rows, info,
+            redo=_transfer_and_dispatch)
 
-    result.device_parts = lambda: (fut.result(), n, pre_ok, ok_a, rows)
+    result.device_parts = lambda: (
+        fut.result(), n, pre_ok, ok_a, rows, info, _transfer_and_dispatch)
     return result
 
 
@@ -378,19 +573,21 @@ def resolve_batches(thunks) -> list[np.ndarray]:
     """Materialize many verify_batch_async results with ONE device->host
     fetch (device-side concat): over the axon tunnel every fetch pays an
     ~89 ms round trip, so streaming callers (blocksync, bench) resolve a
-    window of batches at once."""
+    window of batches at once. Thunks may mix schemes (the mixed
+    mega-commit resolves its ed25519 and sr25519 sub-batches together) —
+    each carries its own host re-check oracle."""
     parts = [t.device_parts() for t in thunks]
     nonempty = [p[0] for p in parts if p[0] is not None]
     flat = np.asarray(jnp.concatenate(nonempty)) if nonempty else np.zeros(0, bool)
     out = []
     off = 0
-    for mask_dev, n, pre_ok, ok_a, rows in parts:
-        if mask_dev is None:
+    for payload_dev, n, pre_ok, ok_a, rows, info, redo in parts:
+        if payload_dev is None:
             out.append(np.zeros(0, dtype=bool))
             continue
-        b = mask_dev.shape[0]
-        mask = flat[off : off + n] & pre_ok & ok_a
-        out.append(_recheck_failed_lanes(mask, pre_ok & ok_a, *rows))
+        b = payload_dev.shape[0]
+        out.append(decode_payload(
+            flat[off : off + b], n, pre_ok, ok_a, rows, info, redo=redo))
         off += b
     return out
 

@@ -140,32 +140,16 @@ def decompress_points(enc: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return np.asarray(ok)[:n], coords[:n]
 
 
-class SrPubKeyCache:
-    """Ristretto-decoded pubkey cache (host level only; the device-level
-    digest cache from ed25519 applies once sr25519 valsets stabilize —
-    reuse the same class with this module's decompressor)."""
+from cometbft_tpu.ops.ed25519_kernel import PubKeyCache  # noqa: E402
 
-    def __init__(self, capacity: int = 65536):
-        self.capacity = capacity
-        self._map: dict[bytes, tuple[bool, np.ndarray]] = {}
 
-    def lookup_or_decompress(self, pubs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
-        missing = [p for p in dict.fromkeys(pubs) if p not in self._map]
-        if missing:
-            enc = np.frombuffer(b"".join(missing), dtype=np.uint8).reshape(-1, 32)
-            ok, coords = decompress_points(enc)
-            evict = len(self._map) + len(missing) - self.capacity
-            for _ in range(max(0, evict)):
-                self._map.pop(next(iter(self._map)))
-            for i, p in enumerate(missing):
-                self._map[p] = (bool(ok[i]), coords[i])
-        oks = np.empty(len(pubs), dtype=bool)
-        coords = np.empty((len(pubs), 4, L.NLIMBS), dtype=np.int32)
-        for i, p in enumerate(pubs):
-            o, c = self._map[p]
-            oks[i] = o
-            coords[i] = c
-        return oks, coords
+class SrPubKeyCache(PubKeyCache):
+    """Two-level ristretto-decoded pubkey cache: the ed25519 cache with this
+    module's decompressor — the device-level digest cache means a repeating
+    sr25519 valset's A-coordinates (2 MB at 5k lanes) upload once, not once
+    per commit."""
+
+    _decompress = staticmethod(lambda enc: decompress_points(enc))
 
 
 _default_cache = SrPubKeyCache()
@@ -201,15 +185,19 @@ def stage_batch_sr(
         r_encs[i], s_vals[i] = parsed
     safe_pubs = [p if pre_ok[i] else _ID_ENC32 for i, p in enumerate(pubs)]
     safe_rs = [r if pre_ok[i] else _ID_ENC32 for i, r in enumerate(r_encs)]
-    ks = [
-        srm.compute_challenge(safe_pubs[i], safe_rs[i], msgs[i]) if pre_ok[i] else 0
-        for i in range(n)
-    ]
+    ks = srm.batch_compute_challenges(safe_pubs, safe_rs, list(msgs))
+    for i in range(n):
+        if not pre_ok[i]:
+            ks[i] = 0
     s_safe = [s if pre_ok[i] else 0 for i, s in enumerate(s_vals)]
 
-    ok_a, coords = cache.lookup_or_decompress(safe_pubs)
-
     b = bucket_size(n)
+    # device-resident A-coordinate staging: digest cache over the UNIQUE
+    # key set + device-side gather (a stable sr25519 valset uploads its
+    # decoded coords once; repeated/tiled keys cost 4 bytes/lane)
+    from cometbft_tpu.ops.ed25519_kernel import _stage_gather
+
+    ok_a, a_dev = _stage_gather(cache, safe_pubs, b, put_key="sr")
     pad = b - n
     r_enc_arr = np.frombuffer(b"".join(safe_rs), dtype=np.uint8).reshape(n, 32)
     r_words = L.bytes_to_words(r_enc_arr)
@@ -220,23 +208,72 @@ def stage_batch_sr(
         r_words = np.concatenate([r_words, zw])
         s_words = np.concatenate([s_words, zw])
         k_words = np.concatenate([k_words, zw])
-        id_coords = np.zeros((pad, 4, L.NLIMBS), dtype=np.int32)
-        id_coords[:, 1, 0] = 1
-        id_coords[:, 2, 0] = 1
-        coords = np.concatenate([coords, id_coords])
-
-    a_dev = tuple(
-        jnp.asarray(np.ascontiguousarray(coords[:, i].T)) for i in range(4)
-    )
+    # r/s/k stay HOST arrays (batch-minor (8, B)): the dispatcher checksums
+    # them before the transfer and re-transfers on an integrity retry
     return (
         pre_ok,
         ok_a,
         n,
         a_dev,
-        jnp.asarray(np.ascontiguousarray(r_words.T)),
-        jnp.asarray(np.ascontiguousarray(s_words.T)),
-        jnp.asarray(np.ascontiguousarray(k_words.T)),
+        np.ascontiguousarray(r_words.T),
+        np.ascontiguousarray(s_words.T),
+        np.ascontiguousarray(k_words.T),
     )
+
+
+def verify_batch_async(
+    pubs: list[bytes],
+    msgs: list[bytes],
+    sigs: list[bytes],
+    cache: SrPubKeyCache | None = None,
+):
+    """Stage + dispatch without blocking on the device (mirror of
+    ed25519_kernel.verify_batch_async): returns a thunk materializing the
+    (N,) bool mask, with .device_parts for the shared single-fetch resolver
+    (ed25519_kernel.resolve_batches) — the mixed mega-commit dispatches both
+    schemes' sub-batches and pays ONE device round trip."""
+    n = len(sigs)
+    assert len(pubs) == n and len(msgs) == n
+    if n == 0:
+        empty = lambda: np.zeros(0, dtype=bool)  # noqa: E731
+        empty.device_parts = lambda: (
+            None, 0, np.zeros(0, bool), np.zeros(0, bool), ([], [], []),
+            (srm.verify, "sr25519", None), None)
+        return empty
+    pre_ok, ok_a, n, a_dev, r_np, s_np, k_np = stage_batch_sr(
+        pubs, msgs, sigs, cache=cache
+    )
+    from cometbft_tpu.ops import ed25519_kernel as EK
+    from cometbft_tpu.ops.dispatch import KERNEL_DISPATCH_LOCK
+
+    expected = np.uint32(EK._host_checksum(r_np, s_np, k_np))
+
+    def _dispatch():
+        # any curve-kernel trace swaps field/curve module constants under
+        # this lock (ops/dispatch.py); never trace concurrently
+        r_w = jnp.asarray(r_np)
+        s_w = jnp.asarray(s_np)
+        k_w = jnp.asarray(k_np)
+        with KERNEL_DISPATCH_LOCK:
+            from cometbft_tpu.ops import pallas_verify as PV
+
+            mask = _pallas_gate.run(
+                PV.verify_pallas_sr, _verify_kernel,
+                (*a_dev, r_w, s_w, k_w), r_w.shape[1])
+        return EK._integrity_payload(mask, r_w, s_w, k_w, expected)
+
+    fut = EK._xfer_pool().submit(_dispatch)
+    rows = (list(pubs), list(msgs), list(sigs))
+    info = (srm.verify, "sr25519", None)
+
+    def result() -> np.ndarray:
+        return EK.decode_payload(
+            np.asarray(fut.result()), n, pre_ok, ok_a, rows, info,
+            redo=_dispatch)
+
+    result.device_parts = lambda: (
+        fut.result(), n, pre_ok, ok_a, rows, info, _dispatch)
+    return result
 
 
 def verify_batch(
@@ -248,24 +285,5 @@ def verify_batch(
     """Schnorrkel batch verification with a per-signature mask."""
     if len(sigs) == 0:
         return True, []
-    pre_ok, ok_a, n, a_dev, r_w, s_w, k_w = stage_batch_sr(
-        pubs, msgs, sigs, cache=cache
-    )
-    from cometbft_tpu.ops.dispatch import KERNEL_DISPATCH_LOCK
-
-    # any curve-kernel trace swaps field/curve module constants under this
-    # lock (ops/dispatch.py); never trace concurrently
-    with KERNEL_DISPATCH_LOCK:
-        from cometbft_tpu.ops import pallas_verify as PV
-
-        mask_dev = _pallas_gate.run(
-            PV.verify_pallas_sr, _verify_kernel,
-            (*a_dev, r_w, s_w, k_w), r_w.shape[1])
-    mask = np.asarray(mask_dev)[:n] & pre_ok & ok_a
-    # host-oracle double-check of rejected lanes (shared policy with the
-    # ed25519 path — see ed25519_kernel.recheck_failed_lanes)
-    from cometbft_tpu.ops.ed25519_kernel import recheck_failed_lanes
-
-    mask = recheck_failed_lanes(
-        mask, pre_ok & ok_a, pubs, msgs, sigs, srm.verify, "sr25519")
+    mask = verify_batch_async(pubs, msgs, sigs, cache=cache)()
     return bool(mask.all()), mask.tolist()

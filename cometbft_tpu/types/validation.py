@@ -91,6 +91,7 @@ def _commit_rows(
     sigs: list[bytes] = []
     idxs: list[int] = []
     tallied = 0
+    sign_rows = commit.vote_sign_bytes_all(chain_id)
     for idx, cs in enumerate(commit.signatures):
         if ignore_sig(cs):
             continue
@@ -106,7 +107,7 @@ def _commit_rows(
                 )
             seen_vals[val_idx] = idx
         pubs.append(val.pub_key)
-        msgs.append(commit.vote_sign_bytes(chain_id, idx))
+        msgs.append(sign_rows[idx])
         sigs.append(cs.signature)
         idxs.append(idx)
         if count_sig(cs):
@@ -281,16 +282,21 @@ def verify_commit_light_trusting(
 
 
 class StagedCommitVerification:
-    """A dispatched-but-unresolved verify_commit: finish() raises exactly
-    what the sync path would. device_thunk is set on the TPU backend so a
-    window of staged commits resolves with one device->host fetch."""
+    """A staged-but-unresolved verify_commit: finish() raises exactly what
+    the sync path would. On the TPU backend the prepared rows (ed_rows) are
+    NOT dispatched at staging time — prefetch_staged coalesces every staged
+    commit in a window into ONE device batch (one transfer, one kernel
+    dispatch, one device->host fetch), which is what makes the blocksync
+    window pipeline device-bound instead of dispatch-overhead-bound.
+    device_thunk remains supported for callers that pre-dispatched."""
 
     def __init__(self, commit: Commit, sig_idxs: list[int], device_thunk=None,
-                 cpu_rows=None):
+                 cpu_rows=None, ed_rows=None):
         self.commit = commit
         self.sig_idxs = sig_idxs
         self.device_thunk = device_thunk
         self._cpu_rows = cpu_rows
+        self._ed_rows = ed_rows  # (pub_bytes, msgs, sigs) all-ed25519 rows
         self._mask = None
         self._passed = False
 
@@ -306,6 +312,12 @@ class StagedCommitVerification:
         if mask is None:
             if self.device_thunk is not None:
                 mask = self.device_thunk()
+            elif self._ed_rows is not None:
+                # solo finish without a window prefetch: dispatch this
+                # commit's rows as their own device batch
+                from cometbft_tpu.ops import ed25519_kernel
+
+                mask = ed25519_kernel.verify_batch_async(*self._ed_rows)()
             else:
                 # non-ed25519 / non-TPU rows: still batched per scheme (the
                 # mixed verifier reaches the sr25519 device kernel on the
@@ -324,19 +336,15 @@ class StagedCommitVerification:
 
 
 def _stage_rows(commit: Commit, rows) -> StagedCommitVerification:
-    """Dispatch prepared commit rows asynchronously on the device when
-    every key is ed25519 on the TPU backend; else defer to serial host
-    verification at finish()."""
+    """Prepare commit rows for the device batch when every key is ed25519
+    on the TPU backend (dispatch deferred to prefetch_staged / finish);
+    else defer to per-scheme host batching at finish()."""
     pubs, msgs, sigs, idxs = rows
     if crypto_batch.resolve_backend() == "tpu" and all(
         p.type_() == "ed25519" for p in pubs
     ):
-        from cometbft_tpu.ops import ed25519_kernel
-
-        thunk = ed25519_kernel.verify_batch_async(
-            [p.bytes_() for p in pubs], msgs, sigs
-        )
-        return StagedCommitVerification(commit, idxs, device_thunk=thunk)
+        return StagedCommitVerification(
+            commit, idxs, ed_rows=([p.bytes_() for p in pubs], msgs, sigs))
     return StagedCommitVerification(commit, idxs, cpu_rows=(pubs, msgs, sigs))
 
 
@@ -401,18 +409,60 @@ def stage_verify_commit_light_trusting(
 
 
 def prefetch_staged(staged: list[StagedCommitVerification]) -> None:
-    """Fetch every device mask in the window with ONE device->host transfer
-    and attach each to its staging record; subsequent finish() calls are
-    pure host work (per-commit error isolation stays with the caller)."""
-    device = [s for s in staged
-              if s.device_thunk is not None and s._mask is None
-              and not s._passed]
-    if not device:
-        return
+    """Resolve every staged commit in the window with ONE device batch:
+    the window's rows concatenate into a single transfer + kernel dispatch +
+    device->host fetch, then the combined mask is sliced back per commit.
+    Subsequent finish() calls are pure host work (per-commit error isolation
+    stays with the caller). Pre-dispatched device_thunk items are resolved
+    alongside with the same single fetch."""
     from cometbft_tpu.ops import ed25519_kernel
 
-    resolved = ed25519_kernel.resolve_batches([s.device_thunk for s in device])
-    for s, m in zip(device, resolved):
+    rows = [s for s in staged
+            if s._ed_rows is not None and s._mask is None and not s._passed]
+    pre = [s for s in staged
+           if s.device_thunk is not None and s._mask is None
+           and not s._passed]
+    thunks = [s.device_thunk for s in pre]
+    # chunk the combined batch below the kernel's lane cap (chunks aligned
+    # to commit boundaries; a single commit is bounded by the 10k-validator
+    # cap). All chunks still resolve with the one fetch below.
+    chunk_cap = 1 << (ed25519_kernel.MAX_BUCKET_LOG2 - 1)
+    chunks: list[list[StagedCommitVerification]] = []
+    cur: list[StagedCommitVerification] = []
+    cur_n = 0
+    for s in rows:
+        n = len(s._ed_rows[2])
+        if cur and cur_n + n > chunk_cap:
+            chunks.append(cur)
+            cur, cur_n = [], 0
+        cur.append(s)
+        cur_n += n
+    if cur:
+        chunks.append(cur)
+    n_pre = len(thunks)
+    for chunk in chunks:
+        pubs: list[bytes] = []
+        msgs: list[bytes] = []
+        sigs: list[bytes] = []
+        groups: list[tuple[int, int]] = []
+        for s in chunk:
+            p, m, g = s._ed_rows
+            groups.append((len(sigs), len(sigs) + len(g)))
+            pubs.extend(p)
+            msgs.extend(m)
+            sigs.extend(g)
+        thunks.append(ed25519_kernel.verify_batch_async(
+            pubs, msgs, sigs, recheck_groups=groups))
+    if not thunks:
+        return
+    resolved = ed25519_kernel.resolve_batches(thunks)
+    for chunk, combined in zip(chunks, resolved[n_pre:]):
+        off = 0
+        for s in chunk:
+            n = len(s._ed_rows[2])
+            s._mask = combined[off:off + n]
+            off += n
+    for s, m in zip(pre, resolved[:n_pre]):
         s._mask = m
 
 

@@ -33,6 +33,20 @@ from cometbft_tpu.crypto import batch as crypto_batch  # noqa: E402
 
 crypto_batch.set_backend("cpu")
 
+# Node boot calls set_backend(config.crypto.backend) — "auto" in test
+# configs — which would resolve to the REAL tunnel-attached TPU (the axon
+# plugin ignores JAX_PLATFORMS) and pay multi-second kernel compiles inside
+# RPC timeouts. Pin "auto" to "cpu" for the whole test session; an explicit
+# "tpu" request (nothing in tests/ makes one) still passes through.
+_orig_set_backend = crypto_batch.set_backend
+
+
+def _pinned_set_backend(backend: str) -> None:
+    _orig_set_backend("cpu" if backend == "auto" else backend)
+
+
+crypto_batch.set_backend = _pinned_set_backend
+
 
 @pytest.fixture(scope="session")
 def jax_cpu_devices():

@@ -192,6 +192,57 @@ def test_stage_verify_commit_pinpoints_bad_signature():
         validation.stage_verify_commit(chain_id, vals2, bid2, 2, starved)
 
 
+def test_prefetch_window_chunks_below_lane_cap(monkeypatch):
+    """A coalesced window larger than the kernel lane cap must split into
+    multiple device batches (resolved by the same single fetch), not raise
+    from bucket_size."""
+    from cometbft_tpu.ops import ed25519_kernel as EK
+
+    async def main():
+        return await build_chain(6, n_vals=4)
+
+    _, state, state_store, block_store = asyncio.run(main())
+    chain_id = state.chain_id
+    vals2 = state_store.load_validators(2)
+    staged = []
+    for h in range(2, 6):
+        blk = block_store.load_block(h)
+        nxt = block_store.load_block(h + 1)
+        ps = blk.make_part_set(65536)
+        bid = BlockID(hash=blk.hash(), part_set_header=ps.header())
+        staged.append(validation.stage_verify_commit(
+            chain_id, vals2, bid, h, nxt.last_commit))
+    # cap of 8 lanes -> each 4-sig commit chunk holds at most 2 commits
+    monkeypatch.setattr(EK, "MAX_BUCKET_LOG2", 4)
+    validation.resolve_staged(staged)
+    assert all(s._passed for s in staged)
+
+
+def test_apply_recheck_isolates_per_commit_budgets():
+    """One commit with > _RECHECK_MAX bad lanes must not suppress the
+    corruption recheck of its window-mates (group budgets are per commit)."""
+    import numpy as np
+
+    from cometbft_tpu.ops import ed25519_kernel as EK
+
+    n_bad = EK._RECHECK_MAX + 4
+    # group A: n_bad genuinely-bad lanes; group B: 1 honest lane the device
+    # wrongly rejected (oracle says valid)
+    mask = np.zeros(n_bad + 1, dtype=bool)
+    eligible = np.ones(n_bad + 1, dtype=bool)
+    rows = (["pk"] * (n_bad + 1), ["m"] * (n_bad + 1), ["sig"] * (n_bad + 1))
+    groups = [(0, n_bad), (n_bad, n_bad + 1)]
+    out = EK.apply_recheck(
+        mask.copy(), eligible, rows,
+        (lambda p, m, s: True, "test", groups))
+    assert not out[:n_bad].any()  # over-budget group: left as rejected
+    assert out[n_bad]  # window-mate's recheck still ran and flipped it
+    # ungrouped: the shared budget suppresses every recheck (old behavior)
+    out2 = EK.apply_recheck(
+        mask.copy(), eligible, rows, (lambda p, m, s: True, "test", None))
+    assert not out2.any()
+
+
 # -------------------------------------------------------- TCP catch-up
 
 

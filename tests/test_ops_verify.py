@@ -80,3 +80,78 @@ def test_pubkey_cache_reuse():
     # same validators verified again (next height): cache must not grow
     ok2, _ = K.verify_batch(pubs, msgs, sigs, cache=cache)
     assert ok2 and len(cache._map) == n_cached
+
+
+# ------------------------------------------------ transfer integrity
+
+
+def test_checksum_host_device_agree():
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    a = rng.integers(0, 1 << 32, size=(8, 16), dtype=np.uint32)
+    b = rng.integers(-(1 << 31), 1 << 31, size=(20, 16), dtype=np.int32)
+    import jax.numpy as jnp
+
+    host = K._host_checksum(a, b)
+    dev = int(np.asarray(K._device_checksum((jnp.asarray(a), jnp.asarray(b)))))
+    assert host == dev
+    # order and position sensitivity
+    assert K._host_checksum(b, a) != host
+    a2 = a.copy()
+    a2[3, 5] ^= 1
+    assert K._host_checksum(a2, b) != host
+
+
+def test_injected_mask_echo_corruption_detected():
+    """A flipped bit on the device->host mask fetch must be detected by the
+    redundant echo and resolved by the host oracle, not silently accepted."""
+    import numpy as np
+
+    from cometbft_tpu.libs import metrics
+
+    items = _sign_n(5)
+    pubs, msgs, sigs = map(list, zip(*items))
+    thunk = K.verify_batch_async(pubs, msgs, sigs)
+    payload, n, pre_ok, ok_a, rows, info, _redo = thunk.device_parts()
+    payload = np.asarray(payload).copy()
+    payload[2] = not payload[2]  # corrupt one mask lane; echo now disagrees
+    mask = K.decode_payload(payload, n, pre_ok, ok_a, rows, info, redo=None)
+    assert mask.tolist() == [True] * 5  # host oracle restored the truth
+    reg_out = metrics.global_registry().render()
+    assert "mask_echo_mismatch 1" in reg_out or "mask_echo_mismatch 2" in reg_out
+
+
+def test_injected_staging_corruption_retries_then_recovers():
+    """A staging-checksum failure retries with a fresh transfer (redo)."""
+    import numpy as np
+
+    items = _sign_n(4)
+    pubs, msgs, sigs = map(list, zip(*items))
+    thunk = K.verify_batch_async(pubs, msgs, sigs)
+    payload, n, pre_ok, ok_a, rows, info, redo = thunk.device_parts()
+    bad = np.asarray(payload).copy()
+    bad[-1] = False  # device says the staged bytes didn't checksum
+    calls = {"n": 0}
+
+    def counting_redo():
+        calls["n"] += 1
+        return redo()
+
+    mask = K.decode_payload(bad, n, pre_ok, ok_a, rows, info, redo=counting_redo)
+    assert calls["n"] == 1  # one fresh transfer+dispatch
+    assert mask.tolist() == [True] * 4
+
+
+def test_corrupted_coordinate_upload_refused(monkeypatch):
+    """A pubkey-table upload that fails its checksum twice must raise, not
+    poison the device cache."""
+    import pytest
+
+    items = _sign_n(3)
+    pubs = [p for p, _, _ in items]
+    cache = K.PubKeyCache()
+    monkeypatch.setattr(K, "_device_checksum", lambda dev: __import__("numpy").uint32(1))
+    with pytest.raises(RuntimeError, match="corrupted twice"):
+        cache.stage(pubs, K.bucket_size(len(pubs)))
+    assert not cache._dev  # nothing cached

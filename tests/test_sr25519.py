@@ -83,6 +83,25 @@ class TestSchnorrkel:
         assert pub.type_() == "sr25519"
         assert len(pub.address()) == 20
 
+    def test_batch_challenges_match_per_row(self):
+        # the native batch transcript (strobe.c sr25519_batch_challenge)
+        # must agree with the per-row Python path on varied message sizes
+        # (incl. empty and rate-crossing)
+        privs = [sr25519.gen_priv_key() for _ in range(4)]
+        pubs, rs, msgs = [], [], []
+        for i, mlen in enumerate([0, 1, 165, 166, 167, 500]):
+            p = privs[i % 4]
+            m = secrets.token_bytes(mlen)
+            sig = p.sign(m)
+            pubs.append(p.pub_key().bytes_())
+            rs.append(sig[:32])
+            msgs.append(m)
+        got = srm.batch_compute_challenges(pubs, rs, msgs)
+        want = [srm.compute_challenge(p, r, m)
+                for p, r, m in zip(pubs, rs, msgs)]
+        assert got == want
+        assert srm.batch_compute_challenges([], [], []) == []
+
     def test_transcript_determinism(self):
         t1 = srm.make_signing_transcript(b"msg")
         t2 = srm.make_signing_transcript(b"msg")

@@ -248,6 +248,25 @@ class TestVoteSetAndCommit:
         with pytest.raises(tv.ErrNotEnoughVotingPowerSigned):
             verify_commit("test-chain", vs, bid, 7, commit)
 
+    def test_vote_sign_bytes_all_matches_per_index(self):
+        # the bulk row builder must be byte-identical to the per-index path
+        # across COMMIT / NIL / ABSENT flags and for a different chain_id
+        from cometbft_tpu.types.basic import BlockIDFlag
+
+        vs, privs = _make_valset(7)
+        bid = _block_id()
+        vote_set = VoteSet("test-chain", 7, 0, SignedMsgType.PRECOMMIT, vs)
+        for i, p in enumerate(privs):
+            vote_set.add_vote(_signed_vote(p, i, 7, 0, SignedMsgType.PRECOMMIT, bid))
+        commit = vote_set.make_commit()
+        commit.signatures[2] = CommitSig.absent()
+        commit.signatures[4].block_id_flag = BlockIDFlag.NIL
+        for chain_id in ("test-chain", "other-chain"):
+            rows = commit.vote_sign_bytes_all(chain_id)
+            assert rows is commit.vote_sign_bytes_all(chain_id)  # memoized
+            for i in range(len(commit.signatures)):
+                assert rows[i] == commit.vote_sign_bytes(chain_id, i), i
+
 
 class TestBlockAndParts:
     def _block(self, vs, privs):
