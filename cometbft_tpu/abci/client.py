@@ -81,10 +81,21 @@ class LocalClient(Client):
 class SocketClient(Client):
     """Request/response over a unix or TCP socket. One in-flight call per
     connection (asyncio.Lock); the engine's 4 logical connections provide
-    cross-subsystem concurrency, as in the reference."""
+    cross-subsystem concurrency, as in the reference.
 
-    def __init__(self, addr: str):
+    wire="proto" speaks the reference's varint-delimited
+    tendermint.abci.Request/Response protobuf (abci/proto_codec.py), so this
+    client drives any existing ABCI app, including the reference's own
+    kvstore; wire="json" is the framework-native frame."""
+
+    def __init__(self, addr: str, wire: str = "proto"):
+        from cometbft_tpu.abci import proto_codec
+
         self.addr = addr
+        if wire not in ("proto", "json"):
+            raise ValueError(f"unknown ABCI wire format {wire!r}")
+        self._codec = proto_codec if wire == "proto" else codec
+        self.wire = wire
         self._lock = asyncio.Lock()
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
@@ -99,14 +110,24 @@ class SocketClient(Client):
             self._reader, self._writer = await asyncio.wait_for(
                 asyncio.open_connection(host, int(port)), timeout
             )
+        if self.wire == "json":
+            # the server's wire autodetector keys on the connection's FIRST
+            # byte (0x00 = JSON 4-byte length header). A first frame >= 16 MB
+            # would start nonzero and be misread as proto, so lock the mode
+            # in with a tiny echo before any real (possibly huge) request.
+            self._writer.write(self._codec.encode_request(
+                "echo", abci.RequestEcho(message="")))
+            await self._writer.drain()
+            await asyncio.wait_for(
+                self._codec.decode_response_async(self._reader), timeout)
 
     async def _call(self, name: str, req):
         if self._writer is None:
             await self.connect()
         async with self._lock:
-            self._writer.write(codec.encode_request(name, req))
+            self._writer.write(self._codec.encode_request(name, req))
             await self._writer.drain()
-            resp_name, resp = await codec.decode_response_async(self._reader)
+            resp_name, resp = await self._codec.decode_response_async(self._reader)
         if resp_name == "exception":
             raise ClientError(resp)
         if resp_name != name:

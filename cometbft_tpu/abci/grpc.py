@@ -2,17 +2,20 @@
 abci/server/grpc_server.go).
 
 The reference exposes one unary RPC per ABCI method on a
-protoc-generated `tendermint.abci.ABCI` service. Same topology here —
-one unary-unary method per ABCI verb under the `cometbft_tpu.abci.ABCI`
-service — built on grpc's generic handler/stub API with this framework's
-codec as the message encoding (the JSON-framed bodies every other
-transport here speaks), so no generated stubs are required and the wire
-stays consistent across local/socket/grpc transports.
+protoc-generated `tendermint.abci.ABCI` service. The server here hosts
+BOTH encodings on one port, keyed by service name:
+
+  /tendermint.abci.ABCI/<CamelMethod>   — raw proto request/response
+      bodies (abci/proto_codec.py), wire-compatible with the reference's
+      generated stubs: any existing gRPC ABCI client connects unmodified.
+  /cometbft_tpu.abci.ABCI/<method>      — the framework-native JSON
+      frames (legacy transport, kept for in-framework callers).
 
 Server: serve_grpc(app, addr) -> started grpc.Server (thread-pool; the
 Application interface is synchronous).
 Client: GRPCClient over grpc.aio — one in-flight request per method call,
-matching the Client contract used by the proxy connections.
+matching the Client contract used by the proxy connections; wire="proto"
+(default) speaks the tendermint.abci.ABCI service.
 """
 
 from __future__ import annotations
@@ -26,12 +29,16 @@ import grpc
 import grpc.aio
 
 from cometbft_tpu.abci import codec
+from cometbft_tpu.abci import proto_codec
 from cometbft_tpu.abci import types as abci
 from cometbft_tpu.abci.client import Client, ClientError
 
 SERVICE = "cometbft_tpu.abci.ABCI"
+PROTO_SERVICE = "tendermint.abci.ABCI"
 
 _METHODS = sorted(codec._REQUEST_TYPES)
+_CAMEL = {m: "".join(p.capitalize() for p in m.split("_")) for m in _METHODS}
+_BY_CAMEL = {v: k for k, v in _CAMEL.items()}
 
 
 def _ident(b: bytes) -> bytes:
@@ -60,22 +67,35 @@ class _AppHandler(grpc.GenericRpcHandler):
             service, method = path.lstrip("/").split("/", 1)
         except ValueError:
             return None
+        if service == PROTO_SERVICE and method in _BY_CAMEL:
+            m = _BY_CAMEL[method]
+
+            def proto_handler(request_bytes: bytes, context) -> bytes:
+                req = proto_codec._REQ_DECODERS[m](request_bytes)
+                resp = self._run(m, req)
+                return proto_codec._RESP_ENCODERS[m](resp)
+
+            return grpc.unary_unary_rpc_method_handler(
+                proto_handler, request_deserializer=_ident,
+                response_serializer=_ident)
         if service != SERVICE or method not in codec._REQUEST_TYPES:
             return None
 
         def handler(request_bytes: bytes, context) -> bytes:
             m, req = codec._decode_request_body(_strip_frame(request_bytes))
-            with self._lock:
-                if m == "echo":
-                    resp = abci.ResponseEcho(message=req.message)
-                elif m == "flush":
-                    resp = abci.ResponseFlush()
-                else:
-                    resp = getattr(self.app, m)(req)
+            resp = self._run(m, req)
             return codec.encode_response(m, resp)
 
         return grpc.unary_unary_rpc_method_handler(
             handler, request_deserializer=_ident, response_serializer=_ident)
+
+    def _run(self, m: str, req):
+        with self._lock:
+            if m == "echo":
+                return abci.ResponseEcho(message=req.message)
+            if m == "flush":
+                return abci.ResponseFlush()
+            return getattr(self.app, m)(req)
 
 
 def serve_grpc(app: abci.Application, addr: str) -> tuple[grpc.Server, str]:
@@ -90,10 +110,15 @@ def serve_grpc(app: abci.Application, addr: str) -> tuple[grpc.Server, str]:
 
 
 class GRPCClient(Client):
-    """grpc_client.go over grpc.aio — satisfies the proxy Client contract."""
+    """grpc_client.go over grpc.aio — satisfies the proxy Client contract.
+    wire="proto" (default) calls the reference-compatible
+    tendermint.abci.ABCI service; wire="json" the legacy framework one."""
 
-    def __init__(self, addr: str):
+    def __init__(self, addr: str, wire: str = "proto"):
         self.addr = addr.removeprefix("grpc://").removeprefix("tcp://")
+        if wire not in ("proto", "json"):
+            raise ValueError(f"unknown ABCI wire format {wire!r}")
+        self.wire = wire
         self._channel: grpc.aio.Channel | None = None
         self._stubs: dict[str, object] = {}
 
@@ -101,14 +126,24 @@ class GRPCClient(Client):
         if self._channel is None:
             self._channel = grpc.aio.insecure_channel(self.addr)
             for m in _METHODS:
+                path = (f"/{PROTO_SERVICE}/{_CAMEL[m]}" if self.wire == "proto"
+                        else f"/{SERVICE}/{m}")
                 self._stubs[m] = self._channel.unary_unary(
-                    f"/{SERVICE}/{m}",
+                    path,
                     request_serializer=_ident,
                     response_deserializer=_ident,
                 )
 
     async def _call(self, name: str, req) -> object:
         await self._ensure()
+        if self.wire == "proto":
+            try:
+                raw = await self._stubs[name](
+                    proto_codec._REQ_ENCODERS[name](req))
+            except grpc.aio.AioRpcError as e:
+                raise ClientError(
+                    f"grpc abci call {name} failed: {e.details()}") from e
+            return proto_codec._RESP_DECODERS[name](raw)
         try:
             raw = await self._stubs[name](codec.encode_request(name, req))
         except grpc.aio.AioRpcError as e:

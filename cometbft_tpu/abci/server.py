@@ -3,6 +3,12 @@
 Reference: abci/server/socket_server.go. Each connection is served by its
 own task; app calls are executed on worker threads under one app-wide lock
 (the app is a single non-reentrant state machine).
+
+Wire format is detected per connection from the first byte: the reference's
+varint-delimited proto Request stream starts with a nonzero length prefix,
+while the framework-native JSON frame starts with a 4-byte big-endian
+length whose first byte is zero for any sane frame (<16 MB). A reference
+node or abci-cli therefore connects with no configuration.
 """
 
 from __future__ import annotations
@@ -12,6 +18,7 @@ import os
 import threading
 
 from cometbft_tpu.abci import codec
+from cometbft_tpu.abci import proto_codec
 from cometbft_tpu.abci import types as abci
 from cometbft_tpu.libs.service import BaseService, TaskRunner
 
@@ -24,6 +31,7 @@ class ABCIServer(BaseService):
         self.app_lock = threading.Lock()
         self._server: asyncio.AbstractServer | None = None
         self._tasks = TaskRunner("abci-server")
+        self._conns: set[asyncio.StreamWriter] = set()
 
     async def on_start(self) -> None:
         if self.addr.startswith("unix://"):
@@ -49,25 +57,66 @@ class ABCIServer(BaseService):
         return f"tcp://{host}:{port}"
 
     async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        self._conns.add(writer)
         try:
+            try:
+                first = await reader.readexactly(1)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return
+            if first == b"\x00":
+                wire = codec
+                read_req = self._json_reader(reader, first)
+            else:
+                wire = proto_codec
+                read_req = self._proto_reader(reader, first)
             while self.is_running:
                 try:
-                    method, req = await codec.decode_request_async(reader)
+                    method, req = await read_req()
                 except (EOFError, asyncio.IncompleteReadError, ConnectionError):
                     return
                 if method == "echo":
-                    writer.write(codec.encode_response("echo", abci.ResponseEcho(message=req.message)))
+                    writer.write(wire.encode_response("echo", abci.ResponseEcho(message=req.message)))
                 elif method == "flush":
-                    writer.write(codec.encode_response("flush", abci.ResponseFlush()))
+                    writer.write(wire.encode_response("flush", abci.ResponseFlush()))
                 else:
                     try:
                         resp = await self._dispatch(method, req)
-                        writer.write(codec.encode_response(method, resp))
+                        writer.write(wire.encode_response(method, resp))
                     except Exception as e:  # noqa: BLE001 - report to client
-                        writer.write(codec.encode_exception(f"{type(e).__name__}: {e}"))
+                        writer.write(wire.encode_exception(f"{type(e).__name__}: {e}"))
                 await writer.drain()
         finally:
+            self._conns.discard(writer)
             writer.close()
+
+    @staticmethod
+    def _json_reader(reader, first: bytes):
+        state = {"first": first}
+
+        async def read():
+            if state["first"] is not None:
+                import json as _json
+                import struct as _struct
+
+                hdr = state["first"] + await reader.readexactly(3)
+                state["first"] = None
+                (n,) = _struct.unpack(">I", hdr)
+                raw = await reader.readexactly(n)
+                return codec._decode_request_body(_json.loads(raw))
+            return await codec.decode_request_async(reader)
+
+        return read
+
+    @staticmethod
+    def _proto_reader(reader, first: bytes):
+        state = {"first": first}
+
+        async def read():
+            pre, state["first"] = state["first"] or b"", None
+            return proto_codec.decode_request_bytes(
+                await proto_codec.read_delimited_async(reader, first_byte=pre))
+
+        return read
 
     async def _dispatch(self, method: str, req):
         def run():
@@ -79,5 +128,10 @@ class ABCIServer(BaseService):
     async def on_stop(self) -> None:
         if self._server is not None:
             self._server.close()
+            # Python 3.12 wait_closed() also waits for per-connection
+            # handlers; close live client connections so an app-side stop
+            # never hangs on an idle client
+            for w in list(self._conns):
+                w.close()
             await self._server.wait_closed()
         await self._tasks.cancel_all()

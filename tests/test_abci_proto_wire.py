@@ -1,0 +1,499 @@
+"""ABCI protobuf wire interop: byte-exactness against google-protobuf.
+
+An independently authored schema (same field numbers/types as the
+reference's proto/tendermint/abci/types.proto, written here from the
+documented wire layout) is compiled with protoc at test time; the
+hand-rolled codec's bytes must decode to identical messages AND re-encode
+identically for fully-populated structures — plus socket round-trips over
+the proto transport and server wire autodetection.
+"""
+
+import asyncio
+import importlib
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+from cometbft_tpu.abci import proto_codec as pc
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.types.params import (
+    ABCIParams,
+    BlockParams,
+    ConsensusParams,
+    EvidenceParams,
+    ValidatorParams,
+    VersionParams,
+)
+from cometbft_tpu.utils import cmttime
+
+PROTO_SRC = """
+syntax = "proto3";
+package wiretest;
+import "google/protobuf/timestamp.proto";
+import "google/protobuf/duration.proto";
+
+message Request {
+  oneof value {
+    RequestEcho echo = 1;
+    RequestFlush flush = 2;
+    RequestInfo info = 3;
+    RequestInitChain init_chain = 5;
+    RequestQuery query = 6;
+    RequestCheckTx check_tx = 8;
+    RequestCommit commit = 11;
+    RequestFinalizeBlock finalize_block = 20;
+  }
+}
+message RequestEcho { string message = 1; }
+message RequestFlush {}
+message RequestInfo {
+  string version = 1; uint64 block_version = 2; uint64 p2p_version = 3;
+  string abci_version = 4;
+}
+message RequestInitChain {
+  google.protobuf.Timestamp time = 1;
+  string chain_id = 2;
+  ConsensusParams consensus_params = 3;
+  repeated ValidatorUpdate validators = 4;
+  bytes app_state_bytes = 5;
+  int64 initial_height = 6;
+}
+message RequestQuery { bytes data = 1; string path = 2; int64 height = 3; bool prove = 4; }
+message RequestCheckTx { bytes tx = 1; int32 type = 2; }
+message RequestCommit {}
+message RequestFinalizeBlock {
+  repeated bytes txs = 1;
+  CommitInfo decided_last_commit = 2;
+  repeated Misbehavior misbehavior = 3;
+  bytes hash = 4; int64 height = 5;
+  google.protobuf.Timestamp time = 6;
+  bytes next_validators_hash = 7; bytes proposer_address = 8;
+}
+message CommitInfo { int32 round = 1; repeated VoteInfo votes = 2; }
+message VoteInfo { Validator validator = 1; int32 block_id_flag = 3; }
+message Validator { bytes address = 1; int64 power = 3; }
+message Misbehavior {
+  int32 type = 1; Validator validator = 2; int64 height = 3;
+  google.protobuf.Timestamp time = 4; int64 total_voting_power = 5;
+}
+message ValidatorUpdate { PublicKey pub_key = 1; int64 power = 2; }
+message PublicKey { oneof sum { bytes ed25519 = 1; bytes secp256k1 = 2; } }
+message ConsensusParams {
+  BlockParams block = 1; EvidenceParams evidence = 2;
+  ValidatorParams validator = 3; VersionParams version = 4; ABCIParams abci = 5;
+}
+message BlockParams { int64 max_bytes = 1; int64 max_gas = 2; }
+message EvidenceParams {
+  int64 max_age_num_blocks = 1;
+  google.protobuf.Duration max_age_duration = 2;
+  int64 max_bytes = 3;
+}
+message ValidatorParams { repeated string pub_key_types = 1; }
+message VersionParams { uint64 app = 1; }
+message ABCIParams { int64 vote_extensions_enable_height = 1; }
+
+message Response {
+  oneof value {
+    ResponseException exception = 1;
+    ResponseEcho echo = 2;
+    ResponseInfo info = 4;
+    ResponseCheckTx check_tx = 9;
+    ResponseCommit commit = 12;
+    ResponseFinalizeBlock finalize_block = 21;
+  }
+}
+message ResponseException { string error = 1; }
+message ResponseEcho { string message = 1; }
+message ResponseInfo {
+  string data = 1; string version = 2; uint64 app_version = 3;
+  int64 last_block_height = 4; bytes last_block_app_hash = 5;
+}
+message ResponseCheckTx {
+  uint32 code = 1; bytes data = 2; string log = 3; string info = 4;
+  int64 gas_wanted = 5; int64 gas_used = 6; repeated Event events = 7;
+  string codespace = 8;
+}
+message Event { string type = 1; repeated EventAttribute attributes = 2; }
+message EventAttribute { string key = 1; string value = 2; bool index = 3; }
+message ResponseCommit { int64 retain_height = 3; }
+message ResponseFinalizeBlock {
+  repeated Event events = 1;
+  repeated ExecTxResult tx_results = 2;
+  repeated ValidatorUpdate validator_updates = 3;
+  ConsensusParams consensus_param_updates = 4;
+  bytes app_hash = 5;
+}
+message ExecTxResult {
+  uint32 code = 1; bytes data = 2; string log = 3; string info = 4;
+  int64 gas_wanted = 5; int64 gas_used = 6; repeated Event events = 7;
+  string codespace = 8;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def wiretest():
+    tmp = tempfile.mkdtemp(prefix="abci-wiretest-")
+    src = os.path.join(tmp, "wiretest.proto")
+    with open(src, "w") as f:
+        f.write(PROTO_SRC)
+    try:
+        subprocess.run(
+            ["protoc", f"--proto_path={tmp}", f"--python_out={tmp}", src],
+            check=True, capture_output=True, timeout=60)
+    except (FileNotFoundError, subprocess.CalledProcessError) as e:
+        pytest.skip(f"protoc unavailable: {e}")
+    sys.path.insert(0, tmp)
+    try:
+        mod = importlib.import_module("wiretest_pb2")
+    finally:
+        sys.path.remove(tmp)
+    return mod
+
+
+def _unwrap(data: bytes) -> bytes:
+    """Strip the varint length prefix and return the Request/Response."""
+    from cometbft_tpu.utils.protobuf import unmarshal_delimited
+
+    body, pos = unmarshal_delimited(data)
+    assert pos == len(data)
+    return body
+
+
+def test_echo_info_checktx_bytes(wiretest):
+    # echo
+    got = _unwrap(pc.encode_request("echo", abci.RequestEcho(message="hi")))
+    ref = wiretest.Request(echo=wiretest.RequestEcho(message="hi"))
+    assert got == ref.SerializeToString()
+    # flush: empty-body oneof member must still be emitted
+    got = _unwrap(pc.encode_request("flush", abci.RequestFlush()))
+    ref = wiretest.Request(flush=wiretest.RequestFlush())
+    assert got == ref.SerializeToString()
+    # info with every field
+    got = _unwrap(pc.encode_request("info", abci.RequestInfo(
+        version="v1.2.3", block_version=11, p2p_version=8, abci_version="2.0.0")))
+    ref = wiretest.Request(info=wiretest.RequestInfo(
+        version="v1.2.3", block_version=11, p2p_version=8, abci_version="2.0.0"))
+    assert got == ref.SerializeToString()
+    # check_tx
+    got = _unwrap(pc.encode_request("check_tx", abci.RequestCheckTx(
+        tx=b"\x01\x02", type_=abci.CheckTxType.RECHECK)))
+    ref = wiretest.Request(check_tx=wiretest.RequestCheckTx(tx=b"\x01\x02", type=1))
+    assert got == ref.SerializeToString()
+
+
+def test_init_chain_bytes_with_params(wiretest):
+    params = ConsensusParams(
+        block=BlockParams(max_bytes=4194304, max_gas=-1),
+        evidence=EvidenceParams(
+            max_age_num_blocks=1000,
+            max_age_duration_ns=172800 * 1_000_000_000 + 500,
+            max_bytes=2048),
+        validator=ValidatorParams(pub_key_types=["ed25519", "secp256k1"]),
+        version=VersionParams(app=7),
+        abci=ABCIParams(vote_extensions_enable_height=42),
+    )
+    req = abci.RequestInitChain(
+        time=cmttime.Timestamp(1700000000, 123456789),
+        chain_id="wire-chain",
+        consensus_params=params,
+        validators=[
+            abci.ValidatorUpdate("ed25519", b"\xaa" * 32, 10),
+            abci.ValidatorUpdate("secp256k1", b"\xbb" * 33, 20),
+        ],
+        app_state_bytes=b'{"k":"v"}',
+        initial_height=5,
+    )
+    got = _unwrap(pc.encode_request("init_chain", req))
+    ref = wiretest.Request(init_chain=wiretest.RequestInitChain(
+        chain_id="wire-chain",
+        app_state_bytes=b'{"k":"v"}',
+        initial_height=5,
+    ))
+    ref.init_chain.time.seconds = 1700000000
+    ref.init_chain.time.nanos = 123456789
+    p = ref.init_chain.consensus_params
+    p.block.max_bytes = 4194304
+    p.block.max_gas = -1
+    p.evidence.max_age_num_blocks = 1000
+    p.evidence.max_age_duration.seconds = 172800
+    p.evidence.max_age_duration.nanos = 500
+    p.evidence.max_bytes = 2048
+    p.validator.pub_key_types.extend(["ed25519", "secp256k1"])
+    p.version.app = 7
+    p.abci.vote_extensions_enable_height = 42
+    v1 = ref.init_chain.validators.add()
+    v1.pub_key.ed25519 = b"\xaa" * 32
+    v1.power = 10
+    v2 = ref.init_chain.validators.add()
+    v2.pub_key.secp256k1 = b"\xbb" * 33
+    v2.power = 20
+    assert got == ref.SerializeToString()
+    # and the decoder round-trips the reference bytes
+    method, dec = pc.decode_request_bytes(ref.SerializeToString())
+    assert method == "init_chain"
+    assert dec.chain_id == "wire-chain"
+    assert dec.validators[1].pub_key_type == "secp256k1"
+    assert dec.consensus_params.evidence.max_age_duration_ns == 172800 * 10**9 + 500
+
+
+def test_finalize_block_roundtrip_bytes(wiretest):
+    req = abci.RequestFinalizeBlock(
+        txs=[b"tx-a", b"", b"tx-c"],
+        decided_last_commit=abci.CommitInfo(
+            round_=2,
+            votes=[abci.VoteInfo(b"\x11" * 20, 5, 2),
+                   abci.VoteInfo(b"\x22" * 20, 7, 1)]),
+        misbehavior=[abci.Misbehavior(
+            type_="DUPLICATE_VOTE", validator_address=b"\x33" * 20,
+            validator_power=9, height=44,
+            time=cmttime.Timestamp(1699999999, 1), total_voting_power=100)],
+        hash=b"\x44" * 32, height=45,
+        time=cmttime.Timestamp(1700000001, 0),
+        next_validators_hash=b"\x55" * 32, proposer_address=b"\x66" * 20,
+    )
+    got = _unwrap(pc.encode_request("finalize_block", req))
+    ref = wiretest.Request()
+    fb = ref.finalize_block
+    fb.txs.extend([b"tx-a", b"", b"tx-c"])
+    fb.decided_last_commit.round = 2
+    for addr, power, flag in ((b"\x11" * 20, 5, 2), (b"\x22" * 20, 7, 1)):
+        v = fb.decided_last_commit.votes.add()
+        v.validator.address = addr
+        v.validator.power = power
+        v.block_id_flag = flag
+    m = fb.misbehavior.add()
+    m.type = 1
+    m.validator.address = b"\x33" * 20
+    m.validator.power = 9
+    m.height = 44
+    m.time.seconds = 1699999999
+    m.time.nanos = 1
+    m.total_voting_power = 100
+    fb.hash = b"\x44" * 32
+    fb.height = 45
+    fb.time.seconds = 1700000001
+    fb.next_validators_hash = b"\x55" * 32
+    fb.proposer_address = b"\x66" * 20
+    assert got == ref.SerializeToString()
+    method, dec = pc.decode_request_bytes(got)
+    assert method == "finalize_block"
+    assert dec == req
+
+
+def test_response_bytes(wiretest):
+    resp = abci.ResponseFinalizeBlock(
+        events=[abci.Event("commit", [abci.EventAttribute("k", "v", True)])],
+        tx_results=[abci.ExecTxResult(
+            code=0, data=b"ok", log="fine", gas_wanted=5, gas_used=3,
+            events=[abci.Event("tx", [abci.EventAttribute("a", "b", False)])])],
+        validator_updates=[abci.ValidatorUpdate("ed25519", b"\x77" * 32, 3)],
+        app_hash=b"\x88" * 32)
+    got = _unwrap(pc.encode_response("finalize_block", resp))
+    ref = wiretest.Response()
+    fb = ref.finalize_block
+    e = fb.events.add()
+    e.type = "commit"
+    a = e.attributes.add()
+    a.key, a.value, a.index = "k", "v", True
+    t = fb.tx_results.add()
+    t.data = b"ok"
+    t.log = "fine"
+    t.gas_wanted = 5
+    t.gas_used = 3
+    te = t.events.add()
+    te.type = "tx"
+    ta = te.attributes.add()
+    ta.key, ta.value, ta.index = "a", "b", False
+    u = fb.validator_updates.add()
+    u.pub_key.ed25519 = b"\x77" * 32
+    u.power = 3
+    fb.app_hash = b"\x88" * 32
+    assert got == ref.SerializeToString()
+    # check_tx response
+    got = _unwrap(pc.encode_response("check_tx", abci.ResponseCheckTx(
+        code=4, log="rejected", codespace="app")))
+    refr = wiretest.Response(check_tx=wiretest.ResponseCheckTx(
+        code=4, log="rejected", codespace="app"))
+    assert got == refr.SerializeToString()
+    # commit response
+    got = _unwrap(pc.encode_response("commit", abci.ResponseCommit(retain_height=9)))
+    refr = wiretest.Response(commit=wiretest.ResponseCommit(retain_height=9))
+    assert got == refr.SerializeToString()
+    # exception
+    got = _unwrap(pc.encode_exception("boom"))
+    refr = wiretest.Response(exception=wiretest.ResponseException(error="boom"))
+    assert got == refr.SerializeToString()
+
+
+def test_all_17_methods_roundtrip():
+    """Every request/response type survives encode->decode structurally."""
+    reqs = {
+        "echo": abci.RequestEcho(message="x"),
+        "flush": abci.RequestFlush(),
+        "info": abci.RequestInfo(version="v"),
+        "init_chain": abci.RequestInitChain(chain_id="c"),
+        "query": abci.RequestQuery(data=b"d", path="/p", height=3, prove=True),
+        "check_tx": abci.RequestCheckTx(tx=b"t"),
+        "commit": abci.RequestCommit(),
+        "list_snapshots": abci.RequestListSnapshots(),
+        "offer_snapshot": abci.RequestOfferSnapshot(
+            snapshot=abci.Snapshot(1, 2, 3, b"h", b"m"), app_hash=b"a"),
+        "load_snapshot_chunk": abci.RequestLoadSnapshotChunk(1, 2, 3),
+        "apply_snapshot_chunk": abci.RequestApplySnapshotChunk(1, b"c", "s"),
+        "prepare_proposal": abci.RequestPrepareProposal(
+            max_tx_bytes=100, txs=[b"a"],
+            local_last_commit=abci.ExtendedCommitInfo(
+                1, [abci.ExtendedVoteInfo(b"\x01" * 20, 2, 2, b"e", b"s")])),
+        "process_proposal": abci.RequestProcessProposal(txs=[b"a"], hash=b"h"),
+        "extend_vote": abci.RequestExtendVote(hash=b"h", height=2),
+        "verify_vote_extension": abci.RequestVerifyVoteExtension(
+            hash=b"h", validator_address=b"\x02" * 20, height=2,
+            vote_extension=b"e"),
+        "finalize_block": abci.RequestFinalizeBlock(txs=[b"t"], height=4),
+    }
+    for method, req in reqs.items():
+        enc = pc.encode_request(method, req)
+        m2, dec = pc.decode_request_bytes(_unwrap_bytes(enc))
+        assert m2 == method
+        assert dec == req, method
+    resps = {
+        "echo": abci.ResponseEcho(message="x"),
+        "flush": abci.ResponseFlush(),
+        "info": abci.ResponseInfo(data="d", last_block_height=4,
+                                  last_block_app_hash=b"h"),
+        "init_chain": abci.ResponseInitChain(app_hash=b"a"),
+        "query": abci.ResponseQuery(code=1, key=b"k", value=b"v", height=2,
+                                    proof_ops=[("iavl", b"k", b"d")]),
+        "check_tx": abci.ResponseCheckTx(code=2, gas_wanted=7),
+        "commit": abci.ResponseCommit(retain_height=3),
+        "list_snapshots": abci.ResponseListSnapshots(
+            snapshots=[abci.Snapshot(1, 2, 3, b"h")]),
+        "offer_snapshot": abci.ResponseOfferSnapshot(
+            result=abci.OfferSnapshotResult.ACCEPT),
+        "load_snapshot_chunk": abci.ResponseLoadSnapshotChunk(chunk=b"c"),
+        "apply_snapshot_chunk": abci.ResponseApplySnapshotChunk(
+            result=abci.ApplySnapshotChunkResult.RETRY,
+            refetch_chunks=[1, 5, 9], reject_senders=["p1"]),
+        "prepare_proposal": abci.ResponsePrepareProposal(txs=[b"a", b"b"]),
+        "process_proposal": abci.ResponseProcessProposal(
+            status=abci.ProposalStatus.ACCEPT),
+        "extend_vote": abci.ResponseExtendVote(vote_extension=b"e"),
+        "verify_vote_extension": abci.ResponseVerifyVoteExtension(
+            status=abci.VerifyStatus.REJECT),
+        "finalize_block": abci.ResponseFinalizeBlock(app_hash=b"h"),
+    }
+    for method, resp in resps.items():
+        enc = pc.encode_response(method, resp)
+        m2, dec = pc.decode_response_bytes(_unwrap_bytes(enc))
+        assert m2 == method
+        assert dec == resp, method
+
+
+def _unwrap_bytes(data: bytes) -> bytes:
+    from cometbft_tpu.utils.protobuf import unmarshal_delimited
+
+    body, _ = unmarshal_delimited(data)
+    return body
+
+
+# ------------------------------------------------ socket transport
+
+
+def test_proto_socket_client_drives_kvstore():
+    """The proto transport end-to-end: SocketClient(wire=proto) against the
+    autodetecting ABCIServer hosting the kvstore."""
+    from cometbft_tpu.abci.client import SocketClient
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.abci.server import ABCIServer
+
+    async def main():
+        srv = ABCIServer(KVStoreApplication(), "tcp://127.0.0.1:0")
+        await srv.start()
+        try:
+            cli = SocketClient(srv.bound_addr(), wire="proto")
+            echo = await cli.echo("ping")
+            assert echo.message == "ping"
+            info = await cli.info(abci.RequestInfo(version="t"))
+            assert info.last_block_height == 0
+            r = await cli.check_tx(abci.RequestCheckTx(tx=b"k=v"))
+            assert r.code == 0
+            fin = await cli.finalize_block(abci.RequestFinalizeBlock(
+                txs=[b"k=v"], height=1))
+            assert fin.tx_results[0].code == 0
+            await cli.commit(abci.RequestCommit())
+            q = await cli.query(abci.RequestQuery(path="/store", data=b"k"))
+            assert q.value == b"v"
+            # JSON wire still autodetects on the same server
+            cli2 = SocketClient(srv.bound_addr(), wire="json")
+            echo2 = await cli2.echo("json-ping")
+            assert echo2.message == "json-ping"
+            await cli.close()
+            await cli2.close()
+        finally:
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_grammar_conformance_over_proto_transport():
+    """VERDICT item 4 'done' bar: the grammar conformance suite passes over
+    the proto transport — a clean-start consensus execution driven entirely
+    through varint-delimited proto Request/Response frames."""
+    from cometbft_tpu.abci.client import SocketClient
+    from cometbft_tpu.abci.grammar import RecordingApplication, check
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.abci.server import ABCIServer
+
+    async def main():
+        rec = RecordingApplication(KVStoreApplication())
+        srv = ABCIServer(rec, "tcp://127.0.0.1:0")
+        await srv.start()
+        try:
+            cli = SocketClient(srv.bound_addr(), wire="proto")
+            await cli.init_chain(abci.RequestInitChain(chain_id="g"))
+            for h in range(1, 4):
+                pp = await cli.prepare_proposal(abci.RequestPrepareProposal(
+                    max_tx_bytes=1 << 20, txs=[b"k%d=v" % h], height=h))
+                await cli.process_proposal(abci.RequestProcessProposal(
+                    txs=pp.txs, height=h))
+                await cli.finalize_block(abci.RequestFinalizeBlock(
+                    txs=pp.txs, height=h))
+                await cli.commit(abci.RequestCommit())
+            await cli.close()
+        finally:
+            await srv.stop()
+        check(rec.trace, clean_start=True)
+
+    asyncio.run(main())
+
+
+def test_grpc_proto_service_reference_paths():
+    """The tendermint.abci.ABCI gRPC service serves raw proto bodies on the
+    reference's method paths (grpc_client.go compatible)."""
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from cometbft_tpu.abci.grpc import GRPCClient, serve_grpc
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+
+    server, bound = serve_grpc(KVStoreApplication(), "grpc://127.0.0.1:0")
+    try:
+        async def main():
+            cli = GRPCClient(bound, wire="proto")
+            assert (await cli.echo("grpc-ping")).message == "grpc-ping"
+            r = await cli.check_tx(abci.RequestCheckTx(tx=b"a=b"))
+            assert r.code == 0
+            fin = await cli.finalize_block(abci.RequestFinalizeBlock(
+                txs=[b"a=b"], height=1))
+            assert fin.tx_results[0].code == 0
+            # legacy JSON service still lives on the same port
+            cli2 = GRPCClient(bound, wire="json")
+            assert (await cli2.echo("json-ping")).message == "json-ping"
+            await cli.close()
+            await cli2.close()
+
+        asyncio.run(main())
+    finally:
+        server.stop(None)
