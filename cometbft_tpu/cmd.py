@@ -162,6 +162,143 @@ def cmd_show_validator(args) -> int:
     return 0
 
 
+def _reset_file_pv(key_file: str, state_file: str) -> None:
+    """Reference resetFilePV (commands/reset.go:100-118): if the key file
+    exists, zero the sign-state only (the key survives); otherwise generate
+    a fresh validator."""
+    from cometbft_tpu.privval.file_pv import FilePV, _LastSignState
+
+    os.makedirs(os.path.dirname(state_file) or ".", exist_ok=True)
+    if os.path.exists(key_file):
+        pv = FilePV.load(key_file, "")
+        pv.state_file = state_file
+        pv.last_sign_state = _LastSignState()
+        pv._save_state()
+        print(f"Reset private validator file to genesis state: {state_file}")
+    else:
+        os.makedirs(os.path.dirname(key_file) or ".", exist_ok=True)
+        pv = FilePV.generate(key_file, state_file)
+        pv._save_state()
+        print(f"Generated private validator file: {key_file}")
+
+
+def _reset_state(cfg) -> None:
+    """Remove databases + WAL (commands/reset.go resetState)."""
+    import shutil
+
+    db_dir = cfg._abs(cfg.base.db_dir)
+    for name in ("blockstore", "state", "tx_index", "evidence", "light"):
+        p = cfg.db_path(name)
+        # sqlite runs journal_mode=WAL (store/db.py): a stale -wal/-shm
+        # sidecar next to a freshly created empty db corrupts it on replay,
+        # so the sidecars must go with the main file
+        for f in (p, p + "-wal", p + "-shm"):
+            if os.path.exists(f):
+                os.remove(f)
+                print(f"Removed {f}")
+    wal = cfg.wal_path()
+    if os.path.isdir(wal):
+        shutil.rmtree(wal, ignore_errors=True)
+        print(f"Removed WAL {wal}")
+    os.makedirs(db_dir, exist_ok=True)
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """commands/reset.go:20-40 — remove all data, reset privval state,
+    drop the address book (unless --keep-addr-book)."""
+    from cometbft_tpu.config import Config
+
+    cfg = Config.load(_home(args))
+    _reset_state(cfg)
+    if not args.keep_addr_book:
+        ab = cfg._abs(cfg.p2p.addr_book_file)
+        if os.path.exists(ab):
+            os.remove(ab)
+            print(f"Removed address book {ab}")
+    else:
+        print("The address book remains intact")
+    _reset_file_pv(cfg.priv_validator_key_path(),
+                   cfg.priv_validator_state_path())
+    return 0
+
+
+def cmd_reset_state(args) -> int:
+    from cometbft_tpu.config import Config
+
+    _reset_state(Config.load(_home(args)))
+    return 0
+
+
+def cmd_reset_priv_validator(args) -> int:
+    from cometbft_tpu.config import Config
+
+    cfg = Config.load(_home(args))
+    _reset_file_pv(cfg.priv_validator_key_path(),
+                   cfg.priv_validator_state_path())
+    return 0
+
+
+def cmd_gen_validator(_args) -> int:
+    """commands/gen_validator.go — print a fresh validator key doc."""
+    import base64
+
+    from cometbft_tpu.privval.file_pv import FilePV
+
+    pv = FilePV.generate()
+    pub = pv.priv_key.pub_key()
+    print(json.dumps({
+        "address": pub.address().hex().upper(),
+        "pub_key": {"type": "tendermint/PubKeyEd25519",
+                    "value": base64.b64encode(pub.bytes_()).decode()},
+        "priv_key": {"type": "tendermint/PrivKeyEd25519",
+                     "value": base64.b64encode(pv.priv_key.bytes_()).decode()},
+    }, indent=2))
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    """commands/gen_node_key.go — write node_key.json (if absent) and print
+    the node ID."""
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.p2p.key import NodeKey
+
+    cfg = Config.load(_home(args))
+    path = cfg.node_key_path()
+    if os.path.exists(path):
+        print(f"node key already exists at {path}", file=sys.stderr)
+        return 1
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    print(NodeKey.load_or_gen(path).id())
+    return 0
+
+
+def cmd_compact_db(args) -> int:
+    """commands/compact.go analog: force-compact the sqlite stores of a
+    STOPPED node (VACUUM reclaims pruned heights' pages)."""
+    import sqlite3
+
+    from cometbft_tpu.config import Config
+
+    cfg = Config.load(_home(args))
+    if cfg.base.db_backend not in ("sqlite", "goleveldb", ""):
+        print(f"compaction not supported for backend {cfg.base.db_backend}",
+              file=sys.stderr)
+        return 1
+    for name in ("blockstore", "state", "tx_index", "evidence", "light"):
+        p = cfg.db_path(name)
+        if not os.path.exists(p):
+            continue
+        before = os.path.getsize(p)
+        conn = sqlite3.connect(p)
+        try:
+            conn.execute("VACUUM")
+            conn.commit()
+        finally:
+            conn.close()
+        print(f"compacted {name}: {before} -> {os.path.getsize(p)} bytes")
+    return 0
+
+
 def cmd_rollback(args) -> int:
     """cmd/cometbft/commands/rollback.go: revert state (and optionally the
     block) by one height so the app can re-run the last block."""
@@ -275,6 +412,29 @@ def cmd_debug(args) -> int:
         cfg_path = os.path.join(_home(args), "config", "config.toml")
         if os.path.exists(cfg_path):
             tar.add(cfg_path, arcname="config.toml")
+        # live CPU profile + thread stacks via the node's pprof plane
+        # (rpc.pprof_laddr; node/pprof.py) — skipped when not enabled
+        if args.pprof_laddr:
+            pbase = args.pprof_laddr.removeprefix("tcp://")
+            if not pbase.startswith("http"):
+                pbase = "http://" + pbase
+            for name, route in (
+                ("profile.txt",
+                 f"debug/pprof/profile?seconds={args.profile_seconds}"
+                 "&format=text"),
+                ("stacks.txt", "debug/pprof/stacks"),
+            ):
+                try:
+                    with urllib.request.urlopen(
+                            f"{pbase}/{route}",
+                            timeout=args.profile_seconds + 10) as r:
+                        data = r.read()
+                except Exception as e:  # noqa: BLE001 - capture what we can
+                    data = f"pprof fetch failed: {e}\n".encode()
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                info.mtime = int(_time.time())
+                tar.addfile(info, io.BytesIO(data))
     print(f"wrote debug bundle {out}")
     return 0
 
@@ -319,6 +479,11 @@ def cmd_version(_args) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="cometbft_tpu",
                                 description="TPU-native BFT consensus engine")
     p.add_argument("--home", default=None, help="node home directory")
@@ -372,6 +537,10 @@ def main(argv: list[str] | None = None) -> int:
     sp = sub.add_parser("debug", help="capture an operator debug bundle")
     sp.add_argument("--rpc.laddr", dest="rpc_laddr",
                     default="tcp://127.0.0.1:26657")
+    sp.add_argument("--pprof.laddr", dest="pprof_laddr", default="",
+                    help="node's rpc.pprof_laddr; adds a live CPU profile "
+                         "+ thread stacks to the bundle")
+    sp.add_argument("--profile-seconds", type=int, default=5)
     sp.add_argument("--output", default="", help="output tar.gz path")
     sp.set_defaults(fn=cmd_debug)
 
@@ -387,15 +556,40 @@ def main(argv: list[str] | None = None) -> int:
                     choices=["broadcast_tx_async", "broadcast_tx_sync"])
     sp.set_defaults(fn=cmd_loadtime)
 
+    sp = sub.add_parser(
+        "unsafe-reset-all",
+        help="(unsafe) remove all data, reset privval state, drop addrbook")
+    sp.add_argument("--keep-addr-book", action="store_true",
+                    help="keep the address book intact")
+    sp.set_defaults(fn=cmd_unsafe_reset_all)
+
+    sp = sub.add_parser("reset-state", help="remove all the data and WAL")
+    sp.set_defaults(fn=cmd_reset_state)
+
+    sp = sub.add_parser(
+        "unsafe-reset-priv-validator",
+        help="(unsafe) reset this node's validator to genesis state")
+    sp.set_defaults(fn=cmd_reset_priv_validator)
+
+    sp = sub.add_parser("gen-validator",
+                        help="generate and print a fresh validator keypair")
+    sp.set_defaults(fn=cmd_gen_validator)
+
+    sp = sub.add_parser("gen-node-key",
+                        help="generate node_key.json and print the node ID")
+    sp.set_defaults(fn=cmd_gen_node_key)
+
+    sp = sub.add_parser("compact-db",
+                        help="force-compact a stopped node's sqlite stores")
+    sp.set_defaults(fn=cmd_compact_db)
+
     sp = sub.add_parser("show-node-id")
     sp.set_defaults(fn=cmd_show_node_id)
     sp = sub.add_parser("show-validator")
     sp.set_defaults(fn=cmd_show_validator)
     sp = sub.add_parser("version")
     sp.set_defaults(fn=cmd_version)
-
-    args = p.parse_args(argv)
-    return args.fn(args)
+    return p
 
 
 if __name__ == "__main__":

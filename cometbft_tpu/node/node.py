@@ -353,6 +353,7 @@ class Node(BaseService):
             self.switch.add_reactor("PEX", self.pex_reactor)
 
         self.rpc_server = None  # attached on start when rpc.laddr set
+        self.pprof_server = None
         self.grpc_server = None
         self.grpc_priv_server = None
 
@@ -419,6 +420,13 @@ class Node(BaseService):
             self.rpc_server = RPCServer(self, self.config.rpc)
             await self.rpc_server.start()
 
+        # live profiler plane (node.go:868-882 pprof mux analog)
+        if self.config.rpc.pprof_laddr:
+            from cometbft_tpu.node.pprof import PprofServer
+
+            self.pprof_server = PprofServer(self.config.rpc.pprof_laddr)
+            await self.pprof_server.start()
+
         # gRPC service surface (node.go:527 + rpc/grpc/server; disabled
         # unless configured)
         if self.config.grpc.laddr:
@@ -467,6 +475,8 @@ class Node(BaseService):
             self._statesync_task.cancel()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
+        if self.pprof_server is not None:
+            await self.pprof_server.stop()
         for srv in (self.grpc_server, self.grpc_priv_server):
             if srv is not None:
                 from cometbft_tpu.rpc.grpc_services import wait_closed

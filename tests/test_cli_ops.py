@@ -1,0 +1,173 @@
+"""Operator CLI: reset family, gen-validator, gen-node-key, compact-db,
+and the standalone abci-cli console (reference: cmd/cometbft/commands/
+reset.go, gen_validator.go, gen_node_key.go, compact.go;
+abci/cmd/abci-cli/abci-cli.go)."""
+
+import asyncio
+import base64
+import json
+import os
+
+from cometbft_tpu import cmd as cli
+
+
+def _run(argv):
+    parser = cli.build_parser()
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+def _init(tmp_path):
+    home = str(tmp_path / "home")
+    assert _run(["--home", home, "init"]) == 0
+    return home
+
+
+def test_unsafe_reset_all(tmp_path, capsys):
+    home = _init(tmp_path)
+    db = os.path.join(home, "data", "blockstore.db")
+    with open(db, "w") as f:
+        f.write("x")
+    ab = os.path.join(home, "config", "addrbook.json")
+    with open(ab, "w") as f:
+        f.write("{}")
+    key_before = open(os.path.join(home, "config", "priv_validator_key.json")).read()
+    state_path = os.path.join(home, "data", "priv_validator_state.json")
+    with open(state_path, "w") as f:
+        json.dump({"height": 42, "round": 1, "step": 3,
+                   "signature": "", "signbytes": ""}, f)
+    assert _run(["--home", home, "unsafe-reset-all"]) == 0
+    assert not os.path.exists(db)
+    assert not os.path.exists(ab)
+    # the validator KEY survives; the sign state is zeroed
+    assert open(os.path.join(home, "config", "priv_validator_key.json")).read() == key_before
+    st = json.load(open(state_path))
+    assert st["height"] == 0
+    # --keep-addr-book preserves it
+    with open(ab, "w") as f:
+        f.write("{}")
+    assert _run(["--home", home, "unsafe-reset-all", "--keep-addr-book"]) == 0
+    assert os.path.exists(ab)
+
+
+def test_reset_state_keeps_privval_and_addrbook(tmp_path):
+    home = _init(tmp_path)
+    db = os.path.join(home, "data", "state.db")
+    with open(db, "w") as f:
+        f.write("x")
+    key = os.path.join(home, "config", "priv_validator_key.json")
+    before = open(key).read()
+    assert _run(["--home", home, "reset-state"]) == 0
+    assert not os.path.exists(db)
+    assert open(key).read() == before
+
+
+def test_reset_priv_validator_generates_when_missing(tmp_path):
+    home = _init(tmp_path)
+    key = os.path.join(home, "config", "priv_validator_key.json")
+    os.remove(key)
+    assert _run(["--home", home, "unsafe-reset-priv-validator"]) == 0
+    assert os.path.exists(key)
+
+
+def test_gen_validator_prints_keypair(capsys):
+    assert _run(["gen-validator"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert len(base64.b64decode(doc["pub_key"]["value"])) == 32
+    assert len(doc["address"]) == 40
+
+
+def test_gen_node_key(tmp_path, capsys):
+    home = str(tmp_path / "nk")
+    os.makedirs(os.path.join(home, "config"))
+    assert _run(["--home", home, "gen-node-key"]) == 0
+    node_id = capsys.readouterr().out.strip()
+    assert len(node_id) == 40
+    assert os.path.exists(os.path.join(home, "config", "node_key.json"))
+    # refuses to overwrite
+    assert _run(["--home", home, "gen-node-key"]) == 1
+
+
+def test_compact_db(tmp_path, capsys):
+    import sqlite3
+
+    home = _init(tmp_path)
+    db = os.path.join(home, "data", "blockstore.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE kv (k BLOB PRIMARY KEY, v BLOB)")
+    conn.executemany("INSERT INTO kv VALUES (?, ?)",
+                     [(i.to_bytes(4, "big"), b"x" * 4096) for i in range(500)])
+    conn.commit()
+    conn.execute("DELETE FROM kv")
+    conn.commit()
+    conn.close()
+    before = os.path.getsize(db)
+    assert _run(["--home", home, "compact-db"]) == 0
+    assert os.path.getsize(db) < before
+
+
+def test_abci_cli_console_drives_kvstore(capsys):
+    from cometbft_tpu.abci import cli as abci_cli
+    from cometbft_tpu.abci.client import SocketClient
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.abci.server import ABCIServer
+
+    async def main():
+        srv = ABCIServer(KVStoreApplication(), "tcp://127.0.0.1:0")
+        await srv.start()
+        try:
+            cli_sock = SocketClient(srv.bound_addr(), wire="proto")
+            for line in ("echo hello",
+                         "check_tx k=v",
+                         "finalize_block k=v 0x6b323d7632",
+                         "commit",
+                         "query --path /store k",
+                         "info"):
+                parts = line.split()
+                await abci_cli._run_command(cli_sock, parts[0], parts[1:])
+            await cli_sock.close()
+        finally:
+            await srv.stop()
+
+    asyncio.run(main())
+    out = capsys.readouterr().out
+    assert "hello" in out
+    assert '"763D"' in out or '"str": "v"' in out.replace("\n", "")
+
+
+def test_abci_cli_main_against_server():
+    import threading
+
+    from cometbft_tpu.abci import cli as abci_cli
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.abci.server import ABCIServer
+
+    # the server needs its own RUNNING loop while abci_cli.main runs one
+    # in this thread
+    ready = threading.Event()
+    stop = threading.Event()
+    addr_box = {}
+
+    def server_thread():
+        async def run():
+            srv = ABCIServer(KVStoreApplication(), "tcp://127.0.0.1:0")
+            await srv.start()
+            addr_box["addr"] = srv.bound_addr()
+            ready.set()
+            while not stop.is_set():
+                await asyncio.sleep(0.02)
+            await srv.stop()
+
+        asyncio.run(run())
+
+    t = threading.Thread(target=server_thread, daemon=True)
+    t.start()
+    assert ready.wait(10)
+    try:
+        addr = addr_box["addr"]
+        assert abci_cli.main(["--address", addr, "echo", "cli-ping"]) == 0
+        assert abci_cli.main(["--address", addr, "--wire", "json",
+                              "echo", "json-ping"]) == 0
+    finally:
+        stop.set()
+        t.join(5)

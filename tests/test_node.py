@@ -185,3 +185,45 @@ def test_node_boot_commit_rpc_restart(tmp_path):
     meta1 = node2.block_store.load_block_meta(h1)
     assert blk.header.last_block_id.hash == meta1.block_id.hash
     assert app_hash_1 == node2.block_store.load_block(h1 + 1).header.app_hash
+
+
+def test_pprof_endpoint(tmp_path):
+    """rpc.pprof_laddr serves live CPU profile, heap, and stacks
+    (node/node.go:868-882 analog)."""
+    import urllib.request
+
+    from cometbft_tpu.node import init_files, Node
+
+    async def main():
+        cfg = init_files(str(tmp_path / "pprof"), chain_id="pprof-chain")
+        cfg.consensus.timeout_commit = 0.05
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.pprof_laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        node = Node(cfg)
+        await node.start()
+        try:
+            base = f"http://{node.pprof_server.bound_addr}"
+
+            def get(route):
+                with urllib.request.urlopen(f"{base}{route}", timeout=15) as r:
+                    return r.read()
+
+            prof = await asyncio.to_thread(
+                get, "/debug/pprof/profile?seconds=1&format=text")
+            assert b"cumulative" in prof  # a pstats table
+            # binary form loads with pstats
+            raw = await asyncio.to_thread(get, "/debug/pprof/profile?seconds=1")
+            import marshal as _marshal
+
+            assert isinstance(_marshal.loads(raw), dict)
+            stacks = await asyncio.to_thread(get, "/debug/pprof/stacks")
+            assert b"--- thread" in stacks
+            first = await asyncio.to_thread(get, "/debug/pprof/heap")
+            assert b"tracemalloc started" in first
+            second = await asyncio.to_thread(get, "/debug/pprof/heap")
+            assert b"heap:" in second
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
