@@ -22,15 +22,22 @@ divergence handling with attack evidence, light/client.py:298-380); the
 proxy surfaces the error instead of the forged data.
 
 Serving plumbing reuses rpc/server.RPCServer with this module's route
-table (no node behind it — websocket subscriptions are not proxied; the
-reference proxies events, a documented delta).
+table (no node behind it). Websocket subscriptions are RELAYED to the
+primary's /websocket endpoint (reference: light/proxy/proxy.go wires the
+node's event routes through light/rpc.Client): subscribe/unsubscribe and
+the resulting event stream pass through UNVERIFIED — like the reference,
+event payloads carry no commit proof; verified state always comes from
+the block-ish routes above.
 """
 
 from __future__ import annotations
 
 import asyncio
 import base64
+import hashlib
 import json
+import os
+import urllib.parse
 import urllib.request
 
 from cometbft_tpu.libs import log as cmtlog
@@ -71,6 +78,78 @@ class _PrimaryRPC:
         return doc["result"]
 
 
+class _UpstreamWS:
+    """Minimal RFC 6455 client to the primary's /websocket endpoint
+    (client->server frames masked, as the RFC requires)."""
+
+    def __init__(self, base_url: str):
+        u = urllib.parse.urlparse(normalize_rpc_url(base_url))
+        self.host = u.hostname or "127.0.0.1"
+        self.port = u.port or 80
+        self.reader: asyncio.StreamReader | None = None
+        self.writer: asyncio.StreamWriter | None = None
+
+    async def connect(self, timeout: float = 10.0) -> None:
+        self.reader, self.writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), timeout)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.writer.write(
+            (f"GET /websocket HTTP/1.1\r\nHost: {self.host}:{self.port}\r\n"
+             "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+             f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n"
+             "\r\n").encode())
+        await self.writer.drain()
+        status = await self.reader.readline()
+        if b"101" not in status:
+            raise ConnectionError(f"ws upgrade rejected: {status!r}")
+        from cometbft_tpu.rpc.server import WS_GUID
+
+        want = base64.b64encode(
+            hashlib.sha1((key + WS_GUID).encode()).digest()).decode()
+        accept = ""
+        while True:
+            line = await self.reader.readline()
+            if line in (b"\r\n", b""):
+                break
+            name, _, val = line.decode().partition(":")
+            if name.strip().lower() == "sec-websocket-accept":
+                accept = val.strip()
+        if accept != want:
+            raise ConnectionError("ws upgrade: bad Sec-WebSocket-Accept")
+
+    async def send_json(self, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        mask = os.urandom(4)
+        ln = len(data)
+        head = b"\x81"  # FIN + text
+        if ln < 126:
+            head += bytes([0x80 | ln])
+        elif ln < (1 << 16):
+            head += bytes([0x80 | 126]) + ln.to_bytes(2, "big")
+        else:
+            head += bytes([0x80 | 127]) + ln.to_bytes(8, "big")
+        body = bytes(b ^ mask[i % 4] for i, b in enumerate(data))
+        self.writer.write(head + mask + body)
+        await self.writer.drain()
+
+    async def recv_json(self) -> dict | None:
+        """Next data message as JSON; None on close. Server frames are
+        unmasked; rpc/server._ws_recv handles either."""
+        from cometbft_tpu.rpc.server import _ws_recv
+
+        while True:
+            opcode, data, _controls = await _ws_recv(self.reader)
+            if opcode == 0x8:
+                return None
+            if opcode in (0x1, 0x2):
+                return json.loads(data)
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+            self.writer = None
+
+
 class ProxyEnv:
     """Route environment for the verified proxy (mirrors rpc/core
     Environment's handler signature: async fn(params) -> result dict)."""
@@ -78,6 +157,8 @@ class ProxyEnv:
     def __init__(self, client, primary_url: str):
         self.client = client  # light.Client
         self.primary = _PrimaryRPC(primary_url)
+        self.primary_url = primary_url
+        self._upstreams: dict[str, _UpstreamWS] = {}
 
     async def _verified(self, params: dict):
         h = params.get("height")
@@ -225,6 +306,44 @@ class ProxyEnv:
 
     async def broadcast_tx_commit(self, params: dict) -> dict:
         return await self.primary.call("broadcast_tx_commit", params)
+
+    # ------------------------------------------- websocket passthrough
+
+    async def ws_passthrough(self, req: dict, client_id: str, tasks,
+                             send_json) -> None:
+        """Relay subscribe/unsubscribe to the primary's /websocket and pump
+        its event stream back to the local client — UNVERIFIED, as in the
+        reference's light proxy (events carry no commit proof either way)."""
+        up = self._upstreams.get(client_id)
+        if up is None:
+            up = _UpstreamWS(self.primary_url)
+            try:
+                await up.connect()
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                await send_json({
+                    "jsonrpc": "2.0", "id": req.get("id", -1),
+                    "error": {"code": -32603,
+                              "message": f"primary ws unavailable: {e}"}})
+                return
+            self._upstreams[client_id] = up
+
+            async def pump():
+                try:
+                    while True:
+                        msg = await up.recv_json()
+                        if msg is None:
+                            return
+                        await send_json(msg)
+                except (ConnectionError, asyncio.IncompleteReadError, OSError):
+                    pass
+
+            tasks.spawn(pump(), name=f"ws-upstream-{client_id}")
+        await up.send_json(req)
+
+    async def ws_client_closed(self, client_id: str) -> None:
+        up = self._upstreams.pop(client_id, None)
+        if up is not None:
+            up.close()
 
     def routes(self) -> dict:
         return {

@@ -229,6 +229,12 @@ class RPCServer(BaseService):
             pass
         finally:
             await tasks.cancel_all()
+            closer = getattr(self.env, "ws_client_closed", None)
+            if closer is not None:
+                try:
+                    await closer(client_id)
+                except Exception:  # noqa: BLE001
+                    pass
             try:
                 if getattr(self.node, "event_bus", None) is not None:
                     self.node.event_bus.unsubscribe_all(client_id)
@@ -242,6 +248,13 @@ class RPCServer(BaseService):
         params = req.get("params") or {}
         bus = getattr(self.node, "event_bus", None)
         if bus is None:
+            # node-less servers (light proxy) may relay subscriptions
+            # upstream via an env-provided hook
+            ws_proxy = getattr(self.env, "ws_passthrough", None)
+            if ws_proxy is not None and method in (
+                    "subscribe", "unsubscribe", "unsubscribe_all"):
+                await ws_proxy(req, client_id, tasks, send_json)
+                return
             await send_json(_err_envelope(
                 rid, -32601, "subscriptions unavailable on this endpoint"))
             return

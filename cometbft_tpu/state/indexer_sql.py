@@ -4,9 +4,16 @@ Reference: state/indexer/sink/psql (psql.go:40-120 + schema.sql) — a
 relational event sink for operators who query events with SQL instead of
 the KV indexer's query language. Same four tables + joined views
 (blocks / tx_results / events / attributes, event_attributes /
-block_events / tx_events); the engine is sqlite (in this image there is
-no PostgreSQL server — the schema and write paths are engine-portable,
-so pointing it at psql is a connection-string change).
+block_events / tx_events).
+
+Engine portability is a first-class contract, not a comment: every DML
+statement lives in _STMTS using only the SQL subset both engines accept
+(RETURNING instead of lastrowid, ON CONFLICT instead of INSERT OR IGNORE),
+and schema_sql()/statements() render the DDL/DML for a named dialect —
+"sqlite" (executed here; no PostgreSQL server exists in this image) or
+"postgresql" (AUTOINCREMENT->BIGSERIAL, BLOB->BYTEA, ?->%s).
+tests/test_indexer_sql.py guards the postgresql rendering against
+sqlite-isms so the sink stays a connection-string change away from psql.
 
 Like the reference's psql sink it is WRITE-ONLY from the node's
 perspective: tx_search/block_search stay on the KV indexer; SQL consumers
@@ -76,6 +83,58 @@ def _now() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
 
+# every DML statement, in the engine-portable subset ("?" placeholders are
+# rendered per dialect)
+_STMTS = {
+    "upsert_block": (
+        "INSERT INTO blocks (height, chain_id, created_at) VALUES (?,?,?) "
+        "ON CONFLICT (height, chain_id) DO UPDATE SET created_at = "
+        "blocks.created_at RETURNING rowid"),
+    "delete_block_attrs": (
+        "DELETE FROM attributes WHERE event_id IN "
+        "(SELECT rowid FROM events WHERE block_id = ? AND tx_id IS NULL)"),
+    "delete_block_events": (
+        "DELETE FROM events WHERE block_id = ? AND tx_id IS NULL"),
+    "insert_event": (
+        "INSERT INTO events (block_id, tx_id, type) VALUES (?,?,?) "
+        "RETURNING rowid"),
+    "insert_attr": (
+        "INSERT INTO attributes (event_id, key, composite_key, value) "
+        "VALUES (?,?,?,?) ON CONFLICT (event_id, key) DO NOTHING"),
+    "insert_tx": (
+        'INSERT INTO tx_results (block_id, "index", created_at, tx_hash, '
+        "tx_result) VALUES (?,?,?,?,?) "
+        'ON CONFLICT (block_id, "index") DO NOTHING RETURNING rowid'),
+}
+
+_DIALECTS = ("sqlite", "postgresql")
+
+
+def schema_sql(dialect: str = "sqlite") -> str:
+    """The sink DDL rendered for `dialect`."""
+    if dialect not in _DIALECTS:
+        raise ValueError(f"unknown SQL dialect {dialect!r}")
+    if dialect == "sqlite":
+        return _SCHEMA
+    return (_SCHEMA
+            .replace("INTEGER PRIMARY KEY AUTOINCREMENT",
+                     "BIGSERIAL PRIMARY KEY")
+            .replace("BLOB", "BYTEA")
+            # PostgreSQL has no IF NOT EXISTS for plain views
+            .replace("CREATE VIEW IF NOT EXISTS", "CREATE OR REPLACE VIEW"))
+
+
+def statements(dialect: str = "sqlite") -> dict[str, str]:
+    """Every DML statement the sink executes, rendered for `dialect`
+    (placeholder style is the only difference — the statements themselves
+    are restricted to the engine-portable subset)."""
+    if dialect not in _DIALECTS:
+        raise ValueError(f"unknown SQL dialect {dialect!r}")
+    if dialect == "sqlite":
+        return dict(_STMTS)
+    return {k: v.replace("?", "%s") for k, v in _STMTS.items()}
+
+
 class SQLEventSink:
     """psql.go EventSink: IndexBlockEvents + IndexTxEvents."""
 
@@ -88,27 +147,21 @@ class SQLEventSink:
     # --------------------------------------------------------------- write
 
     def _block_rowid(self, cur, height: int) -> int:
-        cur.execute(
-            "INSERT INTO blocks (height, chain_id, created_at) VALUES (?,?,?) "
-            "ON CONFLICT (height, chain_id) DO UPDATE SET created_at = created_at "
-            "RETURNING rowid",
-            (height, self.chain_id, _now()))
+        cur.execute(_STMTS["upsert_block"], (height, self.chain_id, _now()))
         return cur.fetchone()[0]
 
     def _insert_events(self, cur, block_rowid: int, tx_rowid, events) -> None:
         for ev in events or []:
             if not ev.type_:
                 continue
-            cur.execute(
-                "INSERT INTO events (block_id, tx_id, type) VALUES (?,?,?)",
-                (block_rowid, tx_rowid, ev.type_))
-            event_id = cur.lastrowid
+            cur.execute(_STMTS["insert_event"],
+                        (block_rowid, tx_rowid, ev.type_))
+            event_id = cur.fetchone()[0]
             for attr in ev.attributes:
                 if not attr.key:
                     continue
                 cur.execute(
-                    "INSERT OR IGNORE INTO attributes "
-                    "(event_id, key, composite_key, value) VALUES (?,?,?,?)",
+                    _STMTS["insert_attr"],
                     (event_id, attr.key, f"{ev.type_}.{attr.key}", attr.value))
 
     def index_block_events(self, height: int, events) -> None:
@@ -117,13 +170,8 @@ class SQLEventSink:
         replaced, not duplicated."""
         cur = self._db.cursor()
         rowid = self._block_rowid(cur, height)
-        cur.execute(
-            "DELETE FROM attributes WHERE event_id IN "
-            "(SELECT rowid FROM events WHERE block_id = ? AND tx_id IS NULL)",
-            (rowid,))
-        cur.execute(
-            "DELETE FROM events WHERE block_id = ? AND tx_id IS NULL",
-            (rowid,))
+        cur.execute(_STMTS["delete_block_attrs"], (rowid,))
+        cur.execute(_STMTS["delete_block_events"], (rowid,))
         self._insert_events(cur, rowid, None, events)
         self._db.commit()
 
@@ -139,16 +187,14 @@ class SQLEventSink:
         for res in tx_results:
             rowid = self._block_rowid(cur, res.height)
             cur.execute(
-                "INSERT OR IGNORE INTO tx_results "
-                "(block_id, \"index\", created_at, tx_hash, tx_result) "
-                "VALUES (?,?,?,?,?)",
+                _STMTS["insert_tx"],
                 (rowid, res.index, _now(), tx_hash(res.tx).hex().upper(),
                  _json.dumps(abci_codec._to_jsonable(res.result)).encode()))
-            if cur.rowcount == 0:
+            row = cur.fetchone()
+            if row is None:
                 continue  # re-delivered tx: events already recorded
-            tx_rowid = cur.lastrowid
             self._insert_events(
-                cur, rowid, tx_rowid, getattr(res.result, "events", []))
+                cur, rowid, row[0], getattr(res.result, "events", []))
         self._db.commit()
 
     def close(self) -> None:

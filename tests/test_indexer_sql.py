@@ -77,3 +77,52 @@ def test_sql_sink_on_live_node(tmp_path):
         db.close()
 
     asyncio.run(main())
+
+
+class TestDialectGuards:
+    """The psql-portability contract: the postgresql rendering must carry
+    no sqlite-isms, the sqlite rendering must be exactly what executes,
+    and the portable statements must actually run (sqlite >= 3.35 supports
+    the shared RETURNING / ON CONFLICT subset)."""
+
+    def test_postgres_ddl_has_no_sqlite_isms(self):
+        from cometbft_tpu.state import indexer_sql as sink
+
+        pg = sink.schema_sql("postgresql")
+        assert "AUTOINCREMENT" not in pg
+        assert "BLOB" not in pg
+        assert "BIGSERIAL PRIMARY KEY" in pg
+        assert "BYTEA" in pg
+        # pg supports IF NOT EXISTS for tables/indexes but NOT plain views
+        assert "CREATE VIEW IF NOT EXISTS" not in pg
+        assert "CREATE OR REPLACE VIEW" in pg
+        # sqlite DDL unchanged
+        lite = sink.schema_sql("sqlite")
+        assert "AUTOINCREMENT" in lite
+
+    def test_postgres_statements_portable(self):
+        from cometbft_tpu.state import indexer_sql as sink
+
+        pg = sink.statements("postgresql")
+        for name, stmt in pg.items():
+            assert "?" not in stmt, name  # psycopg placeholder style
+            assert "%s" in stmt or "DELETE" in stmt, name
+            up = stmt.upper()
+            assert "INSERT OR IGNORE" not in up, name  # sqlite-only
+            assert "OR REPLACE" not in up, name
+            assert "AUTOINCREMENT" not in up, name
+        # inserts rely on RETURNING (portable), never cursor.lastrowid
+        import inspect
+
+        src = inspect.getsource(sink)
+        assert ".lastrowid" not in src
+
+    def test_unknown_dialect_rejected(self):
+        import pytest
+
+        from cometbft_tpu.state import indexer_sql as sink
+
+        with pytest.raises(ValueError):
+            sink.schema_sql("mysql")
+        with pytest.raises(ValueError):
+            sink.statements("mysql")

@@ -155,3 +155,57 @@ def test_light_proxy_rejects_forged_primary():
         assert witness.evidence
 
     asyncio.run(main())
+
+
+def test_light_proxy_ws_event_passthrough(tmp_path):
+    """WS subscriptions relay to the primary: a subscriber on the PROXY's
+    /websocket sees the primary's NewBlock events (unverified passthrough,
+    as in the reference's light proxy)."""
+    async def main():
+        cfg = init_files(str(tmp_path), chain_id="lpx-ws")
+        cfg.consensus.timeout_commit = 0.05
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        node = Node(cfg)
+        await node.start()
+        proxy = None
+        try:
+            deadline = asyncio.get_running_loop().time() + 30
+            while node.block_store.height() < 2:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            url = f"http://{node.rpc_server.bound_addr}"
+            root = await RPCProvider("lpx-ws", url).light_block(1)
+            client = light.Client(
+                "lpx-ws",
+                light.TrustOptions(
+                    period_ns=3600 * 10**9, height=1, hash_=root.hash()),
+                RPCProvider("lpx-ws", url), [RPCProvider("lpx-ws", url)],
+                LightStore(MemDB()),
+            )
+            proxy = LightProxy(client, url, "tcp://127.0.0.1:0")
+            await proxy.start()
+
+            from cometbft_tpu.light.proxy import _UpstreamWS
+
+            ws = _UpstreamWS(f"http://{proxy.bound_addr}")
+            await ws.connect()
+            await ws.send_json({
+                "jsonrpc": "2.0", "id": 7, "method": "subscribe",
+                "params": {"query": "tm.event = 'NewBlock'"}})
+            ack = await asyncio.wait_for(ws.recv_json(), 10)
+            assert ack["id"] == 7 and "error" not in ack
+            ev = await asyncio.wait_for(ws.recv_json(), 15)
+            assert ev["result"]["query"] == "tm.event = 'NewBlock'"
+            assert "NewBlock" in ev["result"]["data"]["type"]
+            # unsubscribe also relays
+            await ws.send_json({
+                "jsonrpc": "2.0", "id": 8, "method": "unsubscribe",
+                "params": {"query": "tm.event = 'NewBlock'"}})
+            ws.close()
+        finally:
+            if proxy is not None:
+                await proxy.stop()
+            await node.stop()
+
+    asyncio.run(main())
