@@ -100,3 +100,94 @@ def test_grpc_services_against_live_node(tmp_path):
             await node.stop()
 
     asyncio.run(main())
+
+
+def test_reference_proto_service_paths(tmp_path):
+    """The same listeners serve tendermint.services.*.v1.* with raw proto
+    bodies — the wire the reference's generated data-companion stubs use."""
+    import grpc as grpclib
+
+    from cometbft_tpu.utils import protobuf as pb
+
+    home = str(tmp_path / "home-proto")
+    init_files(home, chain_id="grpc-proto-chain", moniker="gp0")
+
+    def ident(b):
+        return b
+
+    async def main():
+        cfg = _node_config(home)
+        cfg.grpc.laddr = "tcp://127.0.0.1:0"
+        cfg.grpc.privileged_laddr = "tcp://127.0.0.1:0"
+        node = Node(cfg)
+        await node.start()
+        chan = priv_chan = None
+        try:
+            await _wait_height(node, 3)
+            chan = grpclib.aio.insecure_channel(node.grpc_bound)
+            priv_chan = grpclib.aio.insecure_channel(node.grpc_priv_bound)
+
+            async def call(ch, path, body=b""):
+                return await ch.unary_unary(
+                    path, request_serializer=ident,
+                    response_deserializer=ident)(body)
+
+            # VersionService/GetVersion -> {node=1 str, abci=2, p2p=3, block=4}
+            raw = await call(
+                chan, "/tendermint.services.version.v1.VersionService/GetVersion")
+            r = pb.Reader(raw)
+            fields = {}
+            while not r.at_end():
+                f, w = r.read_tag()
+                fields[f] = r.read_bytes() if w == 2 else r.read_uvarint()
+            assert fields[1].decode() == CMTSemVer
+            assert fields[4] == 11  # block protocol
+
+            # BlockService/GetByHeight(height=2) -> BlockID + Block protos
+            req = pb.Writer().varint_i64(1, 2).output()
+            raw = await call(
+                chan, "/tendermint.services.block.v1.BlockService/GetByHeight",
+                req)
+            r = pb.Reader(raw)
+            got = {}
+            while not r.at_end():
+                f, w = r.read_tag()
+                got[f] = r.read_bytes()
+            blk = Block.from_proto(got[2])
+            assert blk.hash() == node.block_store.load_block(2).hash()
+            bid = pb.Reader(got[1])
+            f, _ = bid.read_tag()
+            assert f == 1
+            assert bid.read_bytes() == node.block_store.load_block_meta(2).block_id.hash
+
+            # BlockResults on proto path
+            raw = await call(
+                chan, "/tendermint.services.block_results.v1."
+                      "BlockResultsService/GetBlockResults", req)
+            r = pb.Reader(raw)
+            f, _ = r.read_tag()
+            assert f == 1 and r.read_varint_i64() == 2
+
+            # Pruning set/get on the PRIVILEGED listener, proto bodies
+            h = node.block_store.height()
+            await call(priv_chan,
+                       "/tendermint.services.pruning.v1.PruningService/"
+                       "SetBlockRetainHeight",
+                       pb.Writer().uvarint(1, h - 1).output())
+            raw = await call(priv_chan,
+                             "/tendermint.services.pruning.v1.PruningService/"
+                             "GetBlockRetainHeight")
+            r = pb.Reader(raw)
+            vals = {}
+            while not r.at_end():
+                f, _w = r.read_tag()
+                vals[f] = r.read_uvarint()
+            assert vals.get(2) == h - 1  # pruning_service_retain_height
+        finally:
+            if chan is not None:
+                await chan.close()
+            if priv_chan is not None:
+                await priv_chan.close()
+            await node.stop()
+
+    asyncio.run(main())
