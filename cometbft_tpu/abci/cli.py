@@ -136,8 +136,25 @@ def main(argv: list[str] | None = None) -> int:
     ns = p.parse_args(argv)
 
     if ns.command == "kvstore":
-        # built-in server, as in the reference (abci-cli kvstore)
+        # built-in server, as in the reference (abci-cli kvstore); a
+        # grpc:// address serves the tendermint.abci.ABCI gRPC service
         from cometbft_tpu.abci.kvstore import KVStoreApplication
+
+        if ns.address.startswith("grpc://"):
+            import time as _time
+
+            from cometbft_tpu.abci.grpc import serve_grpc
+
+            server, bound = serve_grpc(KVStoreApplication(), ns.address)
+            print(f"abci-cli kvstore (grpc) listening on {bound}",
+                  file=sys.stderr)
+            try:
+                while True:
+                    _time.sleep(3600)
+            except KeyboardInterrupt:
+                server.stop(None)
+            return 0
+
         from cometbft_tpu.abci.server import ABCIServer
 
         async def serve():

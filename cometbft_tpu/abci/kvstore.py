@@ -77,6 +77,17 @@ class KVStoreApplication(abci.BaseApplication):
     def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
         for vu in req.validators:
             self.validators[vu.pub_key_bytes] = vu.power
+        # seed state from genesis app_state (reference kvstore app.go
+        # InitChain: a JSON object of initial key/values)
+        if req.app_state_bytes:
+            try:
+                seed = json.loads(req.app_state_bytes)
+            except ValueError:  # covers JSONDecodeError AND UnicodeDecodeError
+                seed = None
+            if isinstance(seed, dict):
+                for k, v in seed.items():
+                    if isinstance(k, str) and isinstance(v, str):
+                        self.state[k] = v
         return abci.ResponseInitChain(app_hash=self.app_hash)
 
     def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:

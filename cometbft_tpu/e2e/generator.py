@@ -1,0 +1,63 @@
+"""Random manifest generation over the config-matrix space.
+
+Reference: test/e2e/generator/generate.go:20-66 — uniform/weighted/
+probabilistic choices over topology, databases, ABCI transports, initial
+state, and a perturbation schedule, seeded for reproducibility."""
+
+from __future__ import annotations
+
+import random
+
+from cometbft_tpu.e2e.manifest import Manifest, NodeManifest
+
+TOPOLOGIES = ("single", "quad")  # node counts 1 / 4 (generate.go "topology")
+DATABASES = ("sqlite", "memdb")
+ABCI_PROTOCOLS = ("builtin", "tcp", "unix", "grpc")
+INITIAL_HEIGHTS = (1, 1000)
+INITIAL_STATES: tuple[dict, ...] = (
+    {},
+    {"initial01": "a", "initial02": "b", "initial03": "c"},
+)
+VOTE_EXT_HEIGHT_OFFSETS = (0, 2)  # 0 = disabled
+# perturbation -> probability a node gets it (generate.go nodePerturbations;
+# "disconnect" needs a network layer OS processes don't have — the in-proc
+# perturbation matrix, tests/test_e2e_perturb.py, covers it)
+PERTURBATIONS = {"kill": 0.1, "pause": 0.1, "restart": 0.1}
+
+
+def generate_manifest(rng: random.Random, index: int) -> Manifest:
+    topology = rng.choice(TOPOLOGIES)
+    n = {"single": 1, "quad": 4}[topology]
+    initial_height = rng.choice(INITIAL_HEIGHTS)
+    m = Manifest(
+        name=f"gen-{index:03d}-{topology}",
+        initial_height=initial_height,
+        initial_state=dict(rng.choice(INITIAL_STATES)),
+        vote_extensions_enable_height=(
+            initial_height + rng.choice(VOTE_EXT_HEIGHT_OFFSETS)
+            if rng.random() < 0.5 else 0),
+    )
+    for i in range(n):
+        node = NodeManifest(
+            database=rng.choice(DATABASES),
+            abci_protocol=rng.choice(ABCI_PROTOCOLS),
+            persist_interval=rng.choice((0, 1, 5)),
+            retain_blocks=rng.choice((0, 20)),
+        )
+        if n >= 4:  # perturbing a 1-node net just halts it
+            for p, prob in PERTURBATIONS.items():
+                if rng.random() < prob:
+                    node.perturb.append(p)
+        m.nodes[f"node{i}"] = node
+    # at most one perturbed node per net: +2/3 of 4 must stay live while a
+    # perturbation is in flight
+    perturbed = [name for name, nd in m.nodes.items() if nd.perturb]
+    for name in perturbed[1:]:
+        m.nodes[name].perturb = []
+    m.validate()
+    return m
+
+
+def generate_manifests(seed: int, count: int) -> list[Manifest]:
+    rng = random.Random(seed)
+    return [generate_manifest(rng, i) for i in range(count)]

@@ -1,0 +1,104 @@
+"""Testnet manifest: the declarative net description the generator emits
+and the runner consumes (reference: test/e2e/pkg/manifest.go, TOML shape
+mirroring networks/*.toml)."""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NodeManifest:
+    """One node's options (manifest.go Node)."""
+
+    database: str = "sqlite"        # sqlite | memdb
+    abci_protocol: str = "builtin"  # builtin | tcp | unix | grpc
+    privval_protocol: str = "file"  # file (remote-signer nets use tests')
+    persist_interval: int = 1
+    retain_blocks: int = 0
+    perturb: list[str] = field(default_factory=list)  # kill|pause|restart
+
+    def validate(self) -> None:
+        if self.database not in ("sqlite", "memdb"):
+            raise ValueError(f"unknown database {self.database!r}")
+        if self.abci_protocol not in ("builtin", "tcp", "unix", "grpc"):
+            raise ValueError(f"unknown abci protocol {self.abci_protocol!r}")
+        for p in self.perturb:
+            if p not in ("kill", "pause", "restart", "disconnect"):
+                raise ValueError(f"unknown perturbation {p!r}")
+
+
+@dataclass
+class Manifest:
+    """A whole testnet (manifest.go Manifest, the options this framework
+    exercises)."""
+
+    name: str = "testnet"
+    initial_height: int = 1
+    initial_state: dict[str, str] = field(default_factory=dict)
+    vote_extensions_enable_height: int = 0
+    target_height_delta: int = 4  # heights every node must advance
+    nodes: dict[str, NodeManifest] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if not self.nodes:
+            raise ValueError("manifest has no nodes")
+        if self.initial_height < 1:
+            raise ValueError("initial_height must be >= 1")
+        for n in self.nodes.values():
+            n.validate()
+
+    # ---------------------------------------------------------- TOML
+
+    def to_toml(self) -> str:
+        def q(s: str) -> str:
+            return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+        out = [
+            f"name = {q(self.name)}",
+            f"initial_height = {self.initial_height}",
+            f"vote_extensions_enable_height = {self.vote_extensions_enable_height}",
+            f"target_height_delta = {self.target_height_delta}",
+        ]
+        if self.initial_state:
+            out.append("")
+            out.append("[initial_state]")
+            for k in sorted(self.initial_state):
+                out.append(f"{q(k)} = {q(self.initial_state[k])}")
+        for name in sorted(self.nodes):
+            n = self.nodes[name]
+            out.append("")
+            out.append(f"[node.{name}]")
+            out.append(f"database = {q(n.database)}")
+            out.append(f"abci_protocol = {q(n.abci_protocol)}")
+            out.append(f"privval_protocol = {q(n.privval_protocol)}")
+            out.append(f"persist_interval = {n.persist_interval}")
+            out.append(f"retain_blocks = {n.retain_blocks}")
+            out.append(
+                "perturb = [" + ", ".join(q(p) for p in n.perturb) + "]")
+        return "\n".join(out) + "\n"
+
+    @classmethod
+    def from_toml(cls, text: str) -> "Manifest":
+        doc = tomllib.loads(text)
+        m = cls(
+            name=doc.get("name", "testnet"),
+            initial_height=int(doc.get("initial_height", 1)),
+            initial_state={str(k): str(v)
+                           for k, v in doc.get("initial_state", {}).items()},
+            vote_extensions_enable_height=int(
+                doc.get("vote_extensions_enable_height", 0)),
+            target_height_delta=int(doc.get("target_height_delta", 4)),
+        )
+        for name, nd in doc.get("node", {}).items():
+            m.nodes[name] = NodeManifest(
+                database=nd.get("database", "sqlite"),
+                abci_protocol=nd.get("abci_protocol", "builtin"),
+                privval_protocol=nd.get("privval_protocol", "file"),
+                persist_interval=int(nd.get("persist_interval", 1)),
+                retain_blocks=int(nd.get("retain_blocks", 0)),
+                perturb=list(nd.get("perturb", [])),
+            )
+        m.validate()
+        return m

@@ -1,0 +1,260 @@
+"""Config-matrix runner: set up, start, perturb, and verify one manifest's
+testnet of real OS processes over real TCP.
+
+Reference: test/e2e/runner (main.go Setup/Start/Perturb/Test/Cleanup;
+perturb.go:44-100). Differences are environmental: nodes are processes on
+one host (no docker network, so "disconnect" lives in the in-proc
+perturbation matrix instead), and out-of-process ABCI apps are one
+`abci-cli kvstore` server per node on the manifest's transport."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+from cometbft_tpu.e2e.manifest import Manifest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class RunError(Exception):
+    pass
+
+
+@dataclass
+class _Net:
+    manifest: Manifest
+    dir: str
+    base_port: int
+    homes: list[str] = field(default_factory=list)
+    node_procs: list = field(default_factory=list)
+    app_procs: list = field(default_factory=list)
+
+    def rpc_port(self, i: int) -> int:
+        return self.base_port + 1000 + i
+
+
+def _env() -> dict:
+    return dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1",
+                CBFT_NO_PALLAS="1")
+
+
+def setup(manifest: Manifest, out_dir: str, base_port: int) -> _Net:
+    """testnet homes + per-node config per the manifest (runner/setup.go)."""
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.node import init_files
+    from cometbft_tpu.p2p.key import NodeKey
+    from cometbft_tpu.privval.file_pv import FilePV
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.utils import cmttime
+
+    net = _Net(manifest=manifest, dir=out_dir, base_port=base_port)
+    names = sorted(manifest.nodes)
+    net.homes = [os.path.join(out_dir, name) for name in names]
+    pvs, node_keys = [], []
+    for home in net.homes:
+        cfg = Config(home=home)
+        os.makedirs(os.path.join(home, "config"), exist_ok=True)
+        os.makedirs(os.path.join(home, "data"), exist_ok=True)
+        pvs.append(FilePV.load_or_generate(
+            cfg.priv_validator_key_path(), cfg.priv_validator_state_path()))
+        node_keys.append(NodeKey.load_or_gen(cfg.node_key_path()))
+
+    gdoc = GenesisDoc(
+        genesis_time=cmttime.canonical_now_ms(),
+        chain_id=manifest.name,
+        initial_height=manifest.initial_height,
+        validators=[
+            GenesisValidator(address=pv.get_pub_key().address(),
+                             pub_key=pv.get_pub_key(), power=1, name=nm)
+            for nm, pv in zip(names, pvs)
+        ],
+        app_state=json.dumps(manifest.initial_state).encode(),
+    )
+    if manifest.vote_extensions_enable_height:
+        gdoc.consensus_params.abci.vote_extensions_enable_height = (
+            manifest.vote_extensions_enable_height)
+    gdoc.validate_and_complete()
+
+    peer_addrs = [f"{node_keys[i].id()}@127.0.0.1:{base_port + i}"
+                  for i in range(len(names))]
+    for i, (name, home) in enumerate(zip(names, net.homes)):
+        nm = manifest.nodes[name]
+        cfg = Config(home=home)
+        cfg.base.moniker = name
+        cfg.base.db_backend = nm.database
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{base_port + i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{net.rpc_port(i)}"
+        cfg.p2p.persistent_peers = ",".join(
+            a for j, a in enumerate(peer_addrs) if j != i)
+        cfg.crypto.backend = "cpu"  # N processes cannot share one chip
+        cfg.consensus.timeout_commit = 0.1
+        if nm.abci_protocol == "builtin":
+            cfg.base.proxy_app = "kvstore"
+        elif nm.abci_protocol == "tcp":
+            cfg.base.proxy_app = f"tcp://127.0.0.1:{base_port + 2000 + i}"
+        elif nm.abci_protocol == "unix":
+            cfg.base.proxy_app = f"unix://{home}/app.sock"
+        elif nm.abci_protocol == "grpc":
+            cfg.base.proxy_app = f"grpc://127.0.0.1:{base_port + 2000 + i}"
+        cfg.save()
+        with open(cfg.genesis_path(), "w") as f:
+            f.write(gdoc.to_json())
+    return net
+
+
+def _spawn_node(home: str):
+    return subprocess.Popen(
+        [sys.executable, "-m", "cometbft_tpu", "--home", home, "start"],
+        cwd=REPO, env=_env(), stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT, start_new_session=True)
+
+
+def _spawn_app(addr: str):
+    return subprocess.Popen(
+        [sys.executable, "-m", "cometbft_tpu.abci.cli",
+         "--address", addr, "kvstore"],
+        cwd=REPO, env=_env(), stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL, start_new_session=True)
+
+
+def _rpc(net: _Net, i: int, route: str, timeout=2.0):
+    url = f"http://127.0.0.1:{net.rpc_port(i)}/{route}"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.load(r)
+
+
+def _height(net: _Net, i: int) -> int:
+    try:
+        return int(_rpc(net, i, "status")["result"]["sync_info"]
+                   ["latest_block_height"])
+    except Exception:  # noqa: BLE001 - node not up yet
+        return -1
+
+
+def _wait(cond, timeout: float, what: str) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.3)
+    raise RunError(f"timed out waiting for {what}")
+
+
+def _kill(proc) -> None:
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        pass
+
+
+def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
+                 log=print) -> None:
+    """Setup + start + perturb + verify + cleanup. Raises RunError on any
+    violated expectation."""
+    manifest.validate()
+    net = setup(manifest, out_dir, base_port)
+    names = sorted(manifest.nodes)
+    n = len(names)
+    try:
+        # out-of-process apps first (the node dials them on boot)
+        for i, name in enumerate(names):
+            proto = manifest.nodes[name].abci_protocol
+            if proto == "builtin":
+                net.app_procs.append(None)
+                continue
+            from cometbft_tpu.config import Config
+
+            cfg = Config.load(net.homes[i])
+            net.app_procs.append(_spawn_app(cfg.base.proxy_app))
+        time.sleep(1.0)
+        net.node_procs = [_spawn_node(h) for h in net.homes]
+
+        start_h = manifest.initial_height
+        log(f"[{manifest.name}] waiting for height {start_h + 2} on {n} nodes")
+        _wait(lambda: all(_height(net, i) >= start_h + 2 for i in range(n)),
+              150, f"all {n} nodes reaching height {start_h + 2}")
+
+        # perturbations (perturb.go:44-100), one node at a time. A
+        # single-node net has no survivors to observe: kill degrades to
+        # restart, pause is a fixed-length stop (waiting on the perturbed
+        # node's own height would deadlock).
+        for i, name in enumerate(names):
+            for p in manifest.nodes[name].perturb:
+                others = [j for j in range(n) if j != i]
+                h0 = max((_height(net, j) for j in others), default=0)
+                if p == "kill":
+                    log(f"[{manifest.name}] kill {name}")
+                    _kill(net.node_procs[i])
+                    if others:
+                        _wait(lambda: min(_height(net, j) for j in others)
+                              >= h0 + 2, 120,
+                              "survivors advancing past a kill")
+                    net.node_procs[i] = _spawn_node(net.homes[i])
+                elif p == "restart":
+                    log(f"[{manifest.name}] restart {name}")
+                    _kill(net.node_procs[i])
+                    net.node_procs[i] = _spawn_node(net.homes[i])
+                elif p == "pause":
+                    log(f"[{manifest.name}] pause {name}")
+                    os.killpg(net.node_procs[i].pid, signal.SIGSTOP)
+                    if others:
+                        _wait(lambda: min(_height(net, j) for j in others)
+                              >= h0 + 2, 120,
+                              "survivors advancing past a pause")
+                    else:
+                        time.sleep(2.0)
+                    os.killpg(net.node_procs[i].pid, signal.SIGCONT)
+                # the perturbed node must rejoin the live head (generous
+                # deadline: CI shares the host with whatever else runs)
+                target = max((_height(net, j) for j in others),
+                             default=h0) + 1
+                _wait(lambda: _height(net, i) >= target, 240,
+                      f"{name} catching up to {target} after {p}")
+
+        target = max(manifest.initial_height + manifest.target_height_delta,
+                     max(_height(net, i) for i in range(n)))
+        log(f"[{manifest.name}] waiting for target height {target}")
+        _wait(lambda: all(_height(net, i) >= target for i in range(n)),
+              150, f"all nodes reaching target height {target}")
+
+        # no fork: every node agrees on the newest height they all have
+        h = min(_height(net, i) for i in range(n)) - 1
+        hashes = {
+            _rpc(net, i, f"block?height={h}")["result"]["block_id"]["hash"]
+            for i in range(n)
+        }
+        if len(hashes) != 1:
+            raise RunError(f"fork at height {h}: {hashes}")
+
+        # genesis app_state visible through every node's app
+        for key, want in manifest.initial_state.items():
+            q = _rpc(net, 0,
+                     f'abci_query?data={key.encode().hex()}&path="/store"')
+            if "result" not in q:
+                raise RunError(f"abci_query failed: {q}")
+            got = q["result"]["response"].get("value")
+            import base64 as _b64
+
+            if got is None or _b64.b64decode(got).decode() != want:
+                raise RunError(
+                    f"initial_state key {key!r} not served by the app "
+                    f"(got {got!r})")
+        log(f"[{manifest.name}] OK (height {h}, {n} nodes in agreement)")
+    finally:
+        for p in net.node_procs:
+            if p is not None:
+                _kill(p)
+        for p in net.app_procs:
+            if p is not None:
+                _kill(p)

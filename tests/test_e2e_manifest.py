@@ -1,0 +1,100 @@
+"""Manifest generator + runner plumbing (reference:
+test/e2e/generator/generate.go + pkg/manifest.go). The process-level
+config-matrix run itself is `python -m cometbft_tpu.e2e ci` (exercised in
+CI fashion, minutes per net); these tests cover generation determinism,
+TOML round-trip, validation, and the runner's setup stage."""
+
+import os
+import random
+
+import pytest
+
+from cometbft_tpu.e2e import Manifest, NodeManifest, generate_manifests
+from cometbft_tpu.e2e.generator import generate_manifest
+
+
+def test_generation_is_seed_deterministic():
+    a = generate_manifests(7, 8)
+    b = generate_manifests(7, 8)
+    assert a == b
+    c = generate_manifests(8, 8)
+    assert a != c
+
+
+def test_generated_manifests_cover_the_matrix():
+    ms = generate_manifests(3, 40)
+    protos = {n.abci_protocol for m in ms for n in m.nodes.values()}
+    dbs = {n.database for m in ms for n in m.nodes.values()}
+    sizes = {len(m.nodes) for m in ms}
+    heights = {m.initial_height for m in ms}
+    assert protos == {"builtin", "tcp", "unix", "grpc"}
+    assert dbs == {"sqlite", "memdb"}
+    assert sizes == {1, 4}
+    assert heights == {1, 1000}
+    # at most one perturbed node per net (liveness: +2/3 must stay up)
+    for m in ms:
+        assert sum(1 for n in m.nodes.values() if n.perturb) <= 1
+        m.validate()
+
+
+def test_toml_roundtrip():
+    rng = random.Random(5)
+    for i in range(12):
+        m = generate_manifest(rng, i)
+        assert Manifest.from_toml(m.to_toml()) == m
+
+
+def test_validation_rejects_bad_manifests():
+    with pytest.raises(ValueError, match="no nodes"):
+        Manifest().validate()
+    m = Manifest(nodes={"a": NodeManifest(database="rocksdb")})
+    with pytest.raises(ValueError, match="database"):
+        m.validate()
+    m = Manifest(nodes={"a": NodeManifest(abci_protocol="carrier-pigeon")})
+    with pytest.raises(ValueError, match="abci"):
+        m.validate()
+    m = Manifest(nodes={"a": NodeManifest(perturb=["meteor-strike"])})
+    with pytest.raises(ValueError, match="perturbation"):
+        m.validate()
+
+
+def test_runner_setup_materializes_manifest(tmp_path):
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.e2e.runner import setup
+
+    m = Manifest(name="setup-net", initial_height=50,
+                 initial_state={"k": "v"},
+                 vote_extensions_enable_height=52)
+    m.nodes["node0"] = NodeManifest(database="memdb", abci_protocol="tcp")
+    m.nodes["node1"] = NodeManifest(database="sqlite", abci_protocol="grpc")
+    net = setup(m, str(tmp_path / "net"), base_port=32500)
+    assert len(net.homes) == 2
+    cfg0 = Config.load(net.homes[0])
+    assert cfg0.base.db_backend == "memdb"
+    assert cfg0.base.proxy_app == "tcp://127.0.0.1:34500"
+    cfg1 = Config.load(net.homes[1])
+    assert cfg1.base.proxy_app.startswith("grpc://")
+    # shared genesis carries initial height, app state, ve enable height
+    import json
+
+    with open(cfg0.genesis_path()) as f:
+        gen = json.load(f)
+    assert int(gen["initial_height"]) == 50
+    assert gen["app_state"] == {"k": "v"}
+    assert int(gen["consensus_params"]["abci"]
+               ["vote_extensions_enable_height"]) == 52
+    # both nodes share the same genesis + peer each other
+    with open(cfg1.genesis_path()) as f:
+        assert json.load(f) == gen
+    assert cfg0.p2p.persistent_peers and "32501" in cfg0.p2p.persistent_peers
+
+
+def test_kvstore_seeds_from_genesis_app_state():
+    from cometbft_tpu.abci import types as abci
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+
+    app = KVStoreApplication()
+    app.init_chain(abci.RequestInitChain(
+        chain_id="x", app_state_bytes=b'{"seed1": "a", "seed2": "b"}'))
+    q = app.query(abci.RequestQuery(path="/store", data=b"seed1"))
+    assert q.value == b"a"
