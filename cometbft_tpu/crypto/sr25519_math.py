@@ -472,10 +472,31 @@ def batch_compute_challenges(
     msg_buf = b"".join(msgs)
     offs = np.zeros(n + 1, dtype=np.int64)
     np.cumsum([len(m) for m in msgs], out=offs[1:])
+    pub_buf = b"".join(pubs)
+    r_buf = b"".join(r_list)
     out = ctypes.create_string_buffer(64 * n)
-    _NATIVE.sr25519_batch_challenge(
-        b"".join(pubs), b"".join(r_list), msg_buf,
-        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, out)
+    offs_p = offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+    def run_range(start: int, count: int) -> None:
+        # ctypes releases the GIL for the duration of the C call, so
+        # chunks keccak in parallel on real cores
+        _NATIVE.sr25519_batch_challenge(
+            pub_buf[32 * start:], r_buf[32 * start:], msg_buf,
+            ctypes.cast(ctypes.byref(offs_p.contents, 8 * start),
+                        ctypes.POINTER(ctypes.c_int64)),
+            count, ctypes.cast(ctypes.byref(out, 64 * start),
+                               ctypes.POINTER(ctypes.c_char)))
+
+    workers = min(4, max(1, n // 512))
+    if workers > 1:
+        import concurrent.futures
+
+        step = (n + workers - 1) // workers
+        with concurrent.futures.ThreadPoolExecutor(workers) as ex:
+            list(ex.map(lambda s: run_range(s, min(step, n - s)),
+                        range(0, n, step)))
+    else:
+        run_range(0, n)
     raw = out.raw
     return [int.from_bytes(raw[64 * i: 64 * i + 64], "little") % L
             for i in range(n)]

@@ -62,6 +62,7 @@ class PprofServer(BaseService):
         self.bound_addr = ""
         self._server: asyncio.Server | None = None
         self._profiling = False
+        self._started_tracemalloc = False
 
     async def on_start(self) -> None:
         addr = self.laddr.removeprefix("tcp://").removeprefix("http://")
@@ -76,6 +77,13 @@ class PprofServer(BaseService):
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        if self._started_tracemalloc:
+            # tracemalloc taxes every allocation in the whole process;
+            # never leave it running past the profiler's lifetime
+            import tracemalloc
+
+            tracemalloc.stop()
+            self._started_tracemalloc = False
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
@@ -147,6 +155,7 @@ class PprofServer(BaseService):
 
         if not tracemalloc.is_tracing():
             tracemalloc.start(12)
+            self._started_tracemalloc = True
             await self._respond(
                 writer, 200,
                 b"tracemalloc started; request /debug/pprof/heap again for "

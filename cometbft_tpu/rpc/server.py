@@ -130,6 +130,15 @@ class RPCServer(BaseService):
                 if reg is None:
                     return 404, {"error": "metrics disabled"}
                 return 200, _RawText(reg.render())
+            if route == "openapi.yaml":
+                # the machine-readable API description (reference:
+                # rpc/openapi/openapi.yaml)
+                import os as _os
+
+                spec = _os.path.join(_os.path.dirname(
+                    _os.path.abspath(__file__)), "openapi.yaml")
+                with open(spec) as f:
+                    return 200, _RawText(f.read())
             params = {k: v[0] for k, v in urllib.parse.parse_qs(query).items()}
             # quoted URI params are string literals, unquoted hex/number
             # (http_uri_handler.go); keep which on the value so []byte args

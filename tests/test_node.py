@@ -227,3 +227,30 @@ def test_pprof_endpoint(tmp_path):
             await node.stop()
 
     asyncio.run(main())
+
+
+def test_pprof_stops_tracemalloc_on_shutdown():
+    import tracemalloc
+
+    from cometbft_tpu.node.pprof import PprofServer
+
+    async def main():
+        srv = PprofServer("tcp://127.0.0.1:0")
+        await srv.start()
+        try:
+            import urllib.request
+
+            def get():
+                with urllib.request.urlopen(
+                        f"http://{srv.bound_addr}/debug/pprof/heap",
+                        timeout=10) as r:
+                    return r.read()
+
+            await asyncio.to_thread(get)
+            assert tracemalloc.is_tracing()
+        finally:
+            await srv.stop()
+        # the process-wide allocation tax must die with the server
+        assert not tracemalloc.is_tracing()
+
+    asyncio.run(main())

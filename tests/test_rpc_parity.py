@@ -96,3 +96,25 @@ def test_rpc_route_parity(tmp_path):
             await node.stop()
 
     asyncio.run(main())
+
+
+def test_openapi_spec_covers_route_table():
+    """The served OpenAPI document (rpc/openapi.yaml, reference
+    rpc/openapi/openapi.yaml analog) must describe every route in the
+    table and invent none."""
+    import os
+
+    import yaml  # provided by the baked-in stack
+
+    from cometbft_tpu.rpc.core import Environment
+
+    spec_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "cometbft_tpu", "rpc", "openapi.yaml")
+    with open(spec_path) as f:
+        spec = yaml.safe_load(f)
+    documented = {p.strip("/") for p in spec["paths"]} - {"", "metrics",
+                                                          "websocket"}
+    table = set(Environment._routes_table(Environment.__new__(Environment)))
+    assert table - documented == set(), f"undocumented: {table - documented}"
+    assert documented - table == set(), f"phantom routes: {documented - table}"

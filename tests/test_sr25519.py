@@ -102,6 +102,24 @@ class TestSchnorrkel:
         assert got == want
         assert srm.batch_compute_challenges([], [], []) == []
 
+    def test_batch_challenges_threaded_path(self):
+        # n >= 1024 splits across GIL-released worker threads; the chunk
+        # boundary arithmetic must keep every row's transcript identical.
+        # Challenges are transcript-only, so arbitrary pub/R bytes suffice.
+        n = 1300
+        rng = __import__("random").Random(11)
+        pubs = [rng.randbytes(32) for _ in range(n)]
+        rs = [rng.randbytes(32) for _ in range(n)]
+        msgs = [rng.randbytes(rng.randrange(0, 200)) for _ in range(n)]
+        got = srm.batch_compute_challenges(pubs, rs, msgs)
+        # spot-check rows incl. the REAL chunk boundaries (same worker
+        # formula as the implementation)
+        workers = min(4, max(1, n // 512))
+        assert workers > 1  # the point of this test is the threaded path
+        step = (n + workers - 1) // workers
+        for i in {0, 1, step - 1, step, step + 1, n - 1}:
+            assert got[i] == srm.compute_challenge(pubs[i], rs[i], msgs[i]), i
+
     def test_transcript_determinism(self):
         t1 = srm.make_signing_transcript(b"msg")
         t2 = srm.make_signing_transcript(b"msg")
