@@ -1366,10 +1366,13 @@ def decode_response_bytes(data: bytes):
     return _decode_oneof(data, _RESP_BY_FIELD, _RESP_DECODERS, "response")
 
 
-async def read_delimited_async(reader, first_byte: bytes = b"") -> bytes:
-    """Read one varint-length-delimited message from an asyncio stream
-    (libs/protoio/reader.go semantics, 64 MB cap). first_byte: a prefix
-    byte the caller already consumed (the server's wire autodetector)."""
+async def read_delimited_async(reader, first_byte: bytes = b"",
+                               max_size: int = MAX_MSG_SIZE) -> bytes:
+    """Read one varint-length-delimited message from any object with an
+    async readexactly() (libs/protoio/reader.go semantics). first_byte: a
+    prefix byte the caller already consumed (the server's wire
+    autodetector). Shared by the ABCI socket and the p2p secret-connection
+    handshake — the single implementation of this framing."""
     n = 0
     shift = 0
     pre = first_byte
@@ -1378,14 +1381,16 @@ async def read_delimited_async(reader, first_byte: bytes = b"") -> bytes:
             b, pre = pre, b""
         else:
             b = await reader.readexactly(1)
+        if shift == 63 and b[0] > 1:
+            raise ValueError("varint length prefix overflows uint64")
         n |= (b[0] & 0x7F) << shift
         if not b[0] & 0x80:
             break
         shift += 7
         if shift > 63:
             raise ValueError("varint length prefix too long")
-    if n > MAX_MSG_SIZE:
-        raise ValueError(f"ABCI message of {n} bytes exceeds {MAX_MSG_SIZE}")
+    if n > max_size:
+        raise ValueError(f"message of {n} bytes exceeds {max_size}")
     return await reader.readexactly(n)
 
 

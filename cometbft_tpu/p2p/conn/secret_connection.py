@@ -8,15 +8,17 @@ flows in fixed-size sealed frames (1024 data bytes + 4-byte length header)
 with 96-bit little-endian counter nonces, one counter per direction
 (secret_connection.go:57-60,224-292).
 
-Design deltas from the reference (capability-preserving, documented):
-- the transcript is HMAC-SHA256-based HKDF over a SHA-256 transcript hash
-  rather than a Merlin/STROBE transcript — same binding (both ephemeral
-  pubkeys, sorted, plus the DH secret feed the KDF), standard primitives.
-- handshake messages are length-prefixed raw frames, not proto envelopes.
-
-Frames after the handshake are byte-compatible in *shape* with the
-reference (sealed 1028-byte chunks), so the flow-control numbers in
-MConnection carry over.
+The wire follows the reference exactly (secret_connection.go:71-175):
+varint-delimited google.protobuf.BytesValue carries each side's ephemeral
+pubkey; session keys come from HKDF-SHA256 over the raw DH secret (info
+"TENDERMINT_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN", lower-key party
+receives with the first 32 bytes); the sign-me challenge is extracted
+from a Merlin transcript ("TENDERMINT_SECRET_CONNECTION_TRANSCRIPT_HASH"
+binding the sorted ephemeral keys and the DH secret — the same Merlin
+implementation as the sr25519 stack, byte-checked against merlin
+vectors); authentication exchanges a varint-delimited
+tendermint.p2p.AuthSigMessage over the now-encrypted channel. Sealed
+1028-byte frames with 96-bit little-endian counter nonces.
 """
 
 from __future__ import annotations
@@ -62,18 +64,29 @@ def _hkdf(secret: bytes, info: bytes, length: int) -> bytes:
     return out[:length]
 
 
-def derive_secrets(dh_secret: bytes, loc_is_least: bool) -> tuple[bytes, bytes, bytes]:
-    """secret_connection.go:224-258 deriveSecretAndChallenge: expand the DH
-    secret into recv_key, send_key, challenge. The party with the
-    lexicographically smaller ephemeral pubkey receives with the first key;
-    the other side mirrors."""
+def derive_secrets(dh_secret: bytes, loc_is_least: bool) -> tuple[bytes, bytes]:
+    """secret_connection.go:335-364 deriveSecrets: HKDF-SHA256 over the raw
+    DH secret. The party with the lexicographically smaller ephemeral
+    pubkey receives with the first key; the other side mirrors."""
     okm = _hkdf(dh_secret, b"TENDERMINT_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN", 96)
     if loc_is_least:
         recv_key, send_key = okm[0:32], okm[32:64]
     else:
         send_key, recv_key = okm[0:32], okm[32:64]
-    challenge = okm[64:96]
-    return recv_key, send_key, challenge
+    return recv_key, send_key
+
+
+def handshake_challenge(lo_eph: bytes, hi_eph: bytes, dh_secret: bytes) -> bytes:
+    """The 32-byte sign-me challenge (secret_connection.go:111-135): a
+    Merlin transcript binding both ephemeral keys (sorted) and the DH
+    secret."""
+    from cometbft_tpu.crypto.sr25519_math import Transcript
+
+    t = Transcript(b"TENDERMINT_SECRET_CONNECTION_TRANSCRIPT_HASH")
+    t.append_message(b"EPHEMERAL_LOWER_PUBLIC_KEY", lo_eph)
+    t.append_message(b"EPHEMERAL_UPPER_PUBLIC_KEY", hi_eph)
+    t.append_message(b"DH_SECRET", dh_secret)
+    return t.challenge_bytes(b"SECRET_CONNECTION_MAC", 32)
 
 
 class _NonceCounter:
@@ -124,26 +137,30 @@ class SecretConnection:
         priv_key: ed25519.PrivKey,
     ) -> "SecretConnection":
         """MakeSecretConnection (secret_connection.go:71-130)."""
+        from cometbft_tpu.utils import protobuf as pb
+
         eph_priv = X25519PrivateKey.generate()
         eph_pub = eph_priv.public_key().public_bytes_raw()
 
-        # 1. concurrent ephemeral pubkey exchange (go: cmtasync.Parallel)
-        writer.write(struct.pack(">I", len(eph_pub)) + eph_pub)
+        # 1. concurrent ephemeral pubkey exchange as varint-delimited
+        #    google.protobuf.BytesValue (secret_connection.go shareEphPubKey)
+        bv = pb.Writer().bytes(1, eph_pub).output()
+        writer.write(pb.marshal_delimited(bv))
         await writer.drain()
         rem_eph_pub = await asyncio.wait_for(
-            _read_prefixed(reader), _HANDSHAKE_TIMEOUT
+            _read_bytes_value(reader), _HANDSHAKE_TIMEOUT
         )
         if len(rem_eph_pub) != 32:
             raise ErrHandshake("bad ephemeral pubkey length")
 
-        # 2. DH + transcript-ordered key derivation
+        # 2. DH; session keys via HKDF on the raw DH secret; the sign-me
+        #    challenge from the Merlin transcript (secret_connection.go:
+        #    111-135)
         dh_secret = eph_priv.exchange(X25519PublicKey.from_public_bytes(rem_eph_pub))
         loc_is_least = eph_pub < rem_eph_pub
         lo, hi = sorted((eph_pub, rem_eph_pub))
-        transcript = hashlib.sha256(b"SECRET_CONNECTION" + lo + hi).digest()
-        recv_key, send_key, challenge = derive_secrets(
-            _hkdf(dh_secret + transcript, b"DH_TRANSCRIPT_BIND", 32), loc_is_least
-        )
+        recv_key, send_key = derive_secrets(dh_secret, loc_is_least)
+        challenge = handshake_challenge(lo, hi, dh_secret)
         conn = cls(
             reader,
             writer,
@@ -152,15 +169,20 @@ class SecretConnection:
             remote_pubkey=None,  # set below
         )
 
-        # 3. authenticate: exchange (pubkey, sig(challenge)) over the
-        #    now-encrypted channel (secret_connection.go:113-127)
+        # 3. authenticate: varint-delimited tendermint.p2p.AuthSigMessage
+        #    {pub_key=1 (crypto.PublicKey oneof ed25519=1), sig=2} over the
+        #    now-encrypted channel (secret_connection.go:155-175)
         sig = priv_key.sign(challenge)
-        await conn.write_msg(priv_key.pub_key().bytes_() + sig)
-        auth = await asyncio.wait_for(conn.read_msg(), _HANDSHAKE_TIMEOUT)
-        if len(auth) != 32 + 64:
-            raise ErrHandshake("bad auth message length")
-        rem_pub = ed25519.PubKey(auth[:32])
-        if not rem_pub.verify_signature(challenge, auth[32:]):
+        pk = pb.Writer().bytes(1, priv_key.pub_key().bytes_(), always=True)
+        auth_msg = (pb.Writer()
+                    .message(1, pk.output(), always=True)
+                    .bytes(2, sig).output())
+        await conn.write(pb.marshal_delimited(auth_msg))
+        auth = await asyncio.wait_for(
+            conn.read_delimited(1 << 20), _HANDSHAKE_TIMEOUT)
+        rem_pub_bytes, rem_sig = _parse_auth_sig(auth)
+        rem_pub = ed25519.PubKey(rem_pub_bytes)
+        if not rem_pub.verify_signature(challenge, rem_sig):
             raise ErrHandshake("challenge verification failed")
         conn.remote_pubkey = rem_pub
         return conn
@@ -212,17 +234,25 @@ class SecretConnection:
             out += chunk
         return bytes(out)
 
-    # ---------------------------------------------- length-prefixed msgs
+    # ------------------------------------------ varint-delimited msgs
+    # (libs/protoio framing — what the reference speaks over the secret
+    # channel for AuthSigMessage and the NodeInfo handshake)
 
     async def write_msg(self, msg: bytes) -> None:
-        await self.write(struct.pack(">I", len(msg)) + msg)
+        from cometbft_tpu.utils.protobuf import marshal_delimited
+
+        await self.write(marshal_delimited(msg))
+
+    async def read_delimited(self, max_size: int = 1 << 22) -> bytes:
+        from cometbft_tpu.abci.proto_codec import read_delimited_async
+
+        try:
+            return await read_delimited_async(self, max_size=max_size)
+        except ValueError as e:
+            raise ErrHandshake(str(e)) from e
 
     async def read_msg(self, max_size: int = 1 << 22) -> bytes:
-        hdr = await self.readexactly(4)
-        (n,) = struct.unpack(">I", hdr)
-        if n > max_size:
-            raise ErrHandshake(f"message size {n} exceeds max {max_size}")
-        return await self.readexactly(n)
+        return await self.read_delimited(max_size)
 
     def close(self) -> None:
         try:
@@ -231,9 +261,49 @@ class SecretConnection:
             pass
 
 
-async def _read_prefixed(reader: asyncio.StreamReader) -> bytes:
-    hdr = await reader.readexactly(4)
-    (n,) = struct.unpack(">I", hdr)
-    if n > 64:
-        raise ErrHandshake("oversized handshake message")
-    return await reader.readexactly(n)
+async def _read_bytes_value(reader: asyncio.StreamReader) -> bytes:
+    """One varint-delimited google.protobuf.BytesValue {value=1: bytes}
+    from the raw stream (the pre-encryption ephemeral-key exchange)."""
+    from cometbft_tpu.abci.proto_codec import read_delimited_async
+    from cometbft_tpu.utils import protobuf as pb
+
+    try:
+        body = await read_delimited_async(reader, max_size=64)
+    except ValueError as e:
+        raise ErrHandshake(str(e)) from e
+    r = pb.Reader(body)
+    val = b""
+    while not r.at_end():
+        f, w = r.read_tag()
+        if f == 1:
+            val = r.read_bytes()
+        else:
+            r.skip(w)
+    return val
+
+
+def _parse_auth_sig(data: bytes) -> tuple[bytes, bytes]:
+    """tendermint.p2p.AuthSigMessage -> (ed25519 pubkey bytes, signature).
+    Only the ed25519 oneof arm is accepted (the framework's node identity
+    key type, as in the reference's default)."""
+    from cometbft_tpu.utils import protobuf as pb
+
+    r = pb.Reader(data)
+    pub, sig = b"", b""
+    while not r.at_end():
+        f, w = r.read_tag()
+        if f == 1:
+            pk = pb.Reader(r.read_bytes())
+            while not pk.at_end():
+                kf, kw = pk.read_tag()
+                if kf == 1:  # crypto.PublicKey oneof: ed25519
+                    pub = pk.read_bytes()
+                else:
+                    pk.skip(kw)
+        elif f == 2:
+            sig = r.read_bytes()
+        else:
+            r.skip(w)
+    if len(pub) != 32 or len(sig) != 64:
+        raise ErrHandshake("bad auth message")
+    return pub, sig

@@ -1,16 +1,19 @@
 """Node handshake info.
 
-Reference: p2p/node_info.go — exchanged in plaintext-over-SecretConnection
-right after the crypto handshake; carries protocol versions, the claimed
-node ID (must match the SecretConnection-authenticated pubkey), network
-(chain id), and the channel list for reactor compatibility checks
-(node_info.go:142 CompatibleWith).
+Reference: p2p/node_info.go — exchanged over the SecretConnection right
+after the crypto handshake; carries protocol versions, the claimed node ID
+(must match the SecretConnection-authenticated pubkey), network (chain
+id), and the channel list for reactor compatibility checks
+(node_info.go:142 CompatibleWith). Wire: the tendermint.p2p
+DefaultNodeInfo protobuf (proto/tendermint/p2p/types.proto:14-34),
+varint-delimited — the reference's handshake message, byte for byte.
 """
 
 from __future__ import annotations
 
-import json
 from dataclasses import dataclass, field
+
+from cometbft_tpu.utils import protobuf as pb
 
 
 @dataclass
@@ -57,37 +60,71 @@ class NodeInfo:
     # ------------------------------------------------------------- codec
 
     def encode(self) -> bytes:
-        doc = {
-            "node_id": self.node_id,
-            "listen_addr": self.listen_addr,
-            "network": self.network,
-            "version": self.version,
-            "channels": self.channels.hex(),
-            "moniker": self.moniker,
-            "protocol_version": {
-                "p2p": self.protocol_version.p2p,
-                "block": self.protocol_version.block,
-                "app": self.protocol_version.app,
-            },
-            "tx_index": self.tx_index,
-            "rpc_address": self.rpc_address,
-        }
-        return json.dumps(doc, separators=(",", ":")).encode()
+        """tendermint.p2p.DefaultNodeInfo (types.proto:20-29)."""
+        pv = pb.Writer()
+        pv.uvarint(1, self.protocol_version.p2p)
+        pv.uvarint(2, self.protocol_version.block)
+        pv.uvarint(3, self.protocol_version.app)
+        other = pb.Writer()
+        other.string(1, self.tx_index)
+        other.string(2, self.rpc_address)
+        w = pb.Writer()
+        w.message(1, pv.output(), always=True)
+        w.string(2, self.node_id)
+        w.string(3, self.listen_addr)
+        w.string(4, self.network)
+        w.string(5, self.version)
+        w.bytes(6, self.channels)
+        w.string(7, self.moniker)
+        w.message(8, other.output(), always=True)
+        return w.output()
 
     @classmethod
     def decode(cls, data: bytes) -> "NodeInfo":
-        doc = json.loads(data)
-        pv = doc.get("protocol_version", {})
-        return cls(
-            node_id=doc.get("node_id", ""),
-            listen_addr=doc.get("listen_addr", ""),
-            network=doc.get("network", ""),
-            version=doc.get("version", ""),
-            channels=bytes.fromhex(doc.get("channels", "")),
-            moniker=doc.get("moniker", ""),
-            protocol_version=ProtocolVersion(
-                p2p=pv.get("p2p", 0), block=pv.get("block", 0), app=pv.get("app", 0)
-            ),
-            tx_index=doc.get("tx_index", "on"),
-            rpc_address=doc.get("rpc_address", ""),
-        )
+        # proto3 zero values, NOT the dataclass defaults: an absent field
+        # must decode to zero (a peer omitting protocol_version must not
+        # inherit OUR version numbers and sneak past compatible_with)
+        out = cls(protocol_version=ProtocolVersion(p2p=0, block=0, app=0),
+                  tx_index="")
+        r = pb.Reader(data)
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1:
+                pvr = pb.Reader(r.read_bytes())
+                pv = ProtocolVersion(p2p=0, block=0, app=0)
+                while not pvr.at_end():
+                    pf, pw = pvr.read_tag()
+                    if pf == 1:
+                        pv.p2p = pvr.read_uvarint()
+                    elif pf == 2:
+                        pv.block = pvr.read_uvarint()
+                    elif pf == 3:
+                        pv.app = pvr.read_uvarint()
+                    else:
+                        pvr.skip(pw)
+                out.protocol_version = pv
+            elif f == 2:
+                out.node_id = r.read_string()
+            elif f == 3:
+                out.listen_addr = r.read_string()
+            elif f == 4:
+                out.network = r.read_string()
+            elif f == 5:
+                out.version = r.read_string()
+            elif f == 6:
+                out.channels = r.read_bytes()
+            elif f == 7:
+                out.moniker = r.read_string()
+            elif f == 8:
+                orr = pb.Reader(r.read_bytes())
+                while not orr.at_end():
+                    of, ow = orr.read_tag()
+                    if of == 1:
+                        out.tx_index = orr.read_string()
+                    elif of == 2:
+                        out.rpc_address = orr.read_string()
+                    else:
+                        orr.skip(ow)
+            else:
+                r.skip(w)
+        return out

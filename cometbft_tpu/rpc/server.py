@@ -33,6 +33,21 @@ WS_MAX_FRAME = 1 << 20
 WS_MAX_MESSAGE = 1 << 21  # aggregate cap across fragments (HTTP has MAX_BODY)
 
 
+_openapi_cache: str | None = None
+
+
+def _openapi_spec() -> str:
+    global _openapi_cache
+    if _openapi_cache is None:
+        import os as _os
+
+        path = _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)), "openapi.yaml")
+        with open(path) as f:
+            _openapi_cache = f.read()
+    return _openapi_cache
+
+
 class RPCServer(BaseService):
     def __init__(self, node, config, logger: cmtlog.Logger | None = None,
                  env=None):
@@ -132,13 +147,9 @@ class RPCServer(BaseService):
                 return 200, _RawText(reg.render())
             if route == "openapi.yaml":
                 # the machine-readable API description (reference:
-                # rpc/openapi/openapi.yaml)
-                import os as _os
-
-                spec = _os.path.join(_os.path.dirname(
-                    _os.path.abspath(__file__)), "openapi.yaml")
-                with open(spec) as f:
-                    return 200, _RawText(f.read())
+                # rpc/openapi/openapi.yaml) — immutable at runtime, read
+                # once (blocking file I/O must not recur on the event loop)
+                return 200, _RawText(_openapi_spec())
             params = {k: v[0] for k, v in urllib.parse.parse_qs(query).items()}
             # quoted URI params are string literals, unquoted hex/number
             # (http_uri_handler.go); keep which on the value so []byte args
