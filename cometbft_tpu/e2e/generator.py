@@ -54,6 +54,15 @@ def generate_manifest(rng: random.Random, index: int) -> Manifest:
     perturbed = [name for name, nd in m.nodes.items() if nd.perturb]
     for name in perturbed[1:]:
         m.nodes[name].perturb = []
+    # a kill/restart wipes a memdb node's stores while its out-of-process
+    # app keeps state -> the ABCI handshake correctly refuses an app ahead
+    # of the store. Such nodes need persistent storage (the reference
+    # matrix only has persistent engines, generate.go nodeDatabases);
+    # pause never loses the process, so memdb+pause stays in the matrix.
+    if perturbed:
+        nd = m.nodes[perturbed[0]]
+        if nd.database == "memdb" and set(nd.perturb) & {"kill", "restart"}:
+            nd.database = "sqlite"
     m.validate()
     return m
 

@@ -98,3 +98,14 @@ def test_kvstore_seeds_from_genesis_app_state():
         chain_id="x", app_state_bytes=b'{"seed1": "a", "seed2": "b"}'))
     q = app.query(abci.RequestQuery(path="/store", data=b"seed1"))
     assert q.value == b"a"
+
+
+def test_killed_nodes_get_persistent_storage():
+    """kill/restart wipes memdb stores while the node's external app keeps
+    state, which the ABCI handshake rightly refuses — the generator must
+    never pair those with volatile storage (pause keeps the process, so
+    memdb+pause stays a legal matrix cell)."""
+    for m in generate_manifests(42, 60):
+        for nd in m.nodes.values():
+            if set(nd.perturb) & {"kill", "restart"}:
+                assert nd.database == "sqlite", m.name
