@@ -145,7 +145,24 @@ class Handshaker:
             raise ErrAppBlockHeightTooHigh(
                 f"app height {app_height} exceeds store height {store_height}"
             )
-        if store_height > state_height + 1:
+        # truncated-store guards (replay.go:364-370): blocks the app would
+        # need to replay have been pruned away
+        store_base = self.block_store.base()
+        if app_height == 0 and state.initial_height < store_base:
+            raise RuntimeError(
+                f"app has no state and the block store is truncated above "
+                f"the initial height (store base {store_base}, initial "
+                f"height {state.initial_height})")
+        if 0 < app_height < store_base - 1:
+            raise RuntimeError(
+                f"app height {app_height} is below the truncated store "
+                f"base {store_base}")
+        # the height the state expects to apply next: the chain's FIRST
+        # block is initial_height, not 1 (a crash between saving block
+        # initial_height and the state save must remain recoverable)
+        next_height = (state.initial_height if state_height == 0
+                       else state_height + 1)
+        if store_height > next_height:
             raise RuntimeError(
                 f"block store height {store_height} is more than one ahead of "
                 f"state height {state_height}"
@@ -160,7 +177,8 @@ class Handshaker:
             # happy path: replay to the app only (replay.go:399-412)
             return await self._replay_to_app(state, app_height, store_height, proxy_app)
 
-        # store_height == state_height + 1: the crash window
+        # store_height == next_height: the crash window (one block saved
+        # beyond the last applied state)
         if app_height < state_height:
             # app missed earlier blocks too: catch it up, then apply the last
             state = await self._replay_to_app(state, app_height, state_height, proxy_app)
@@ -193,7 +211,12 @@ class Handshaker:
         from cometbft_tpu.state.execution import _abci_commit_info, _abci_misbehavior
 
         app_hash = b""
-        for h in range(app_height + 1, final_height + 1):
+        # a freshly-InitChained app starts at the chain's initial height,
+        # which need not be 1 (replay.go:465-468)
+        first = app_height + 1
+        if first == 1:
+            first = state.initial_height
+        for h in range(first, final_height + 1):
             block = self.block_store.load_block(h)
             if block is None:
                 raise RuntimeError(f"missing block {h} in store during replay")

@@ -20,9 +20,18 @@ from cometbft_tpu.node import Node, init_files
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _prep_home(tmp_path) -> str:
+def _prep_home(tmp_path, chain_id: str = "crash-chain", moniker: str = "c0",
+               initial_height: int = 1) -> str:
     home = str(tmp_path / "home")
-    init_files(home, chain_id="crash-chain", moniker="c0")
+    init_files(home, chain_id=chain_id, moniker=moniker)
+    if initial_height != 1:
+        import json
+
+        gen_path = os.path.join(home, "config", "genesis.json")
+        doc = json.load(open(gen_path))
+        doc["initial_height"] = str(initial_height)
+        with open(gen_path, "w") as f:
+            json.dump(doc, f)
     cfg = make_node_test_config(home=home)
     cfg.base.db_backend = "sqlite"
     cfg.rpc.laddr = ""  # not needed; keeps the crashed process simple
@@ -97,3 +106,59 @@ def _loaded_config(home: str):
     cfg.rpc.laddr = ""
     cfg.p2p.laddr = "tcp://127.0.0.1:0"
     return cfg
+
+
+def test_restart_with_nonunit_initial_height(tmp_path):
+    """A restarted in-process app on a chain whose first block is
+    initial_height > 1 must be replayed from initial_height, not height 1
+    (replay.go:465-468 firstBlock = state.InitialHeight)."""
+    home = _prep_home(tmp_path, chain_id="ih-chain", moniker="ih0",
+                      initial_height=500)
+
+    async def run_until(target: int) -> int:
+        from cometbft_tpu.config import Config
+
+        node = Node(Config.load(home))
+        await node.start()
+        try:
+            deadline = asyncio.get_running_loop().time() + 60
+            while node.block_store.height() < target:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            return node.block_store.height()
+        finally:
+            await node.stop()
+
+    h1 = asyncio.run(run_until(502))
+    assert h1 >= 502
+    # restart: the fresh builtin app (height 0) must be replayed from 500
+    h2 = asyncio.run(run_until(h1 + 2))
+    assert h2 >= h1 + 2
+
+
+@pytest.mark.parametrize("fail_index", [1, 2, 3])
+def test_crash_window_at_first_nonunit_height_recovers(tmp_path, fail_index):
+    """The crash window around the chain's FIRST block when initial_height
+    > 1: block initial_height is saved but the state (or app) is not. The
+    handshake must treat store_height == initial_height with state_height
+    == 0 as the recoverable crash window, not a corrupt store."""
+    home = _prep_home(tmp_path, chain_id="ih-crash", moniker="ihc0",
+                      initial_height=300)
+    _run_until_crash(home, fail_index)
+
+    async def recover() -> int:
+        from cometbft_tpu.config import Config
+
+        node = Node(Config.load(home))
+        await node.start()
+        try:
+            deadline = asyncio.get_running_loop().time() + 60
+            while node.block_store.height() < 302:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            assert node.block_store.base() == 300
+            return node.block_store.height()
+        finally:
+            await node.stop()
+
+    assert asyncio.run(recover()) >= 302
