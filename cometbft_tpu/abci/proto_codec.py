@@ -92,9 +92,15 @@ def _dec_ts(data: bytes) -> cmttime.Timestamp:
 
 
 def _duration(ns: int) -> bytes:
+    # truncation toward zero so seconds and nanos share a sign (gogoproto /
+    # protobuf Duration rule: -1.5s is seconds=-1, nanos=-500000000, never
+    # the mixed-sign pair Python floor division would produce)
+    secs, nanos = divmod(abs(ns), 1_000_000_000)
+    if ns < 0:
+        secs, nanos = -secs, -nanos
     w = pb.Writer()
-    w.varint_i64(1, ns // 1_000_000_000)
-    w.varint_i64(2, ns % 1_000_000_000)
+    w.varint_i64(1, secs)
+    w.varint_i64(2, nanos)
     return w.output()
 
 

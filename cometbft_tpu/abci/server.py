@@ -8,7 +8,10 @@ Wire format is detected per connection from the first byte: the reference's
 varint-delimited proto Request stream starts with a nonzero length prefix,
 while the framework-native JSON frame starts with a 4-byte big-endian
 length whose first byte is zero for any sane frame (<16 MB). A reference
-node or abci-cli therefore connects with no configuration.
+node or abci-cli therefore connects with no configuration. A first byte of
+0x00 alone is ambiguous (it is also the varint length of an empty proto
+frame), so the detector peeks the next 4 bytes: JSON carries 3 more length
+bytes then '{'.
 """
 
 from __future__ import annotations
@@ -21,6 +24,23 @@ from cometbft_tpu.abci import codec
 from cometbft_tpu.abci import proto_codec
 from cometbft_tpu.abci import types as abci
 from cometbft_tpu.libs.service import BaseService, TaskRunner
+
+
+class _PrefixedReader:
+    """StreamReader facade replaying bytes the wire autodetector peeked
+    past a 0x00 first byte before handing the stream to the proto reader."""
+
+    def __init__(self, reader: asyncio.StreamReader, buf: bytes):
+        self._reader = reader
+        self._buf = buf
+
+    async def readexactly(self, n: int) -> bytes:
+        out = b""
+        if self._buf:
+            out, self._buf = self._buf[:n], self._buf[n:]
+        if len(out) < n:
+            out += await self._reader.readexactly(n - len(out))
+        return out
 
 
 class ABCIServer(BaseService):
@@ -61,14 +81,24 @@ class ABCIServer(BaseService):
         try:
             try:
                 first = await reader.readexactly(1)
+                if first == b"\x00":
+                    # Ambiguous first byte: a JSON frame's 4-byte BE length
+                    # starts 0x00 for bodies < 2^24, but 0x00 is also the
+                    # varint length of an empty proto frame. JSON carries 3
+                    # more length bytes then '{' — peek them to decide.
+                    peek = await reader.readexactly(4)
+                    if peek[3:4] == b"{":
+                        wire = codec
+                        read_req = self._json_reader(reader, first + peek)
+                    else:
+                        wire = proto_codec
+                        read_req = self._proto_reader(
+                            _PrefixedReader(reader, peek), first)
+                else:
+                    wire = proto_codec
+                    read_req = self._proto_reader(reader, first)
             except (asyncio.IncompleteReadError, ConnectionError):
                 return
-            if first == b"\x00":
-                wire = codec
-                read_req = self._json_reader(reader, first)
-            else:
-                wire = proto_codec
-                read_req = self._proto_reader(reader, first)
             while self.is_running:
                 try:
                     method, req = await read_req()
@@ -90,18 +120,20 @@ class ABCIServer(BaseService):
             writer.close()
 
     @staticmethod
-    def _json_reader(reader, first: bytes):
-        state = {"first": first}
+    def _json_reader(reader, consumed: bytes):
+        """consumed: the 5 autodetection bytes (4-byte BE length + the
+        leading '{' of the body)."""
+        state = {"consumed": consumed}
 
         async def read():
-            if state["first"] is not None:
+            if state["consumed"] is not None:
                 import json as _json
                 import struct as _struct
 
-                hdr = state["first"] + await reader.readexactly(3)
-                state["first"] = None
-                (n,) = _struct.unpack(">I", hdr)
-                raw = await reader.readexactly(n)
+                buf = state["consumed"]
+                state["consumed"] = None
+                (n,) = _struct.unpack(">I", buf[:4])
+                raw = buf[4:] + await reader.readexactly(n - 1)
                 return codec._decode_request_body(_json.loads(raw))
             return await codec.decode_request_async(reader)
 
@@ -109,12 +141,20 @@ class ABCIServer(BaseService):
 
     @staticmethod
     def _proto_reader(reader, first: bytes):
+        """first: the single already-consumed varint byte (0x00 here means
+        an empty proto frame — the autodetector's peeked bytes ride a
+        _PrefixedReader so the next frame is not lost)."""
         state = {"first": first}
 
         async def read():
-            pre, state["first"] = state["first"] or b"", None
-            return proto_codec.decode_request_bytes(
-                await proto_codec.read_delimited_async(reader, first_byte=pre))
+            while True:
+                pre, state["first"] = state["first"] or b"", None
+                data = await proto_codec.read_delimited_async(
+                    reader, first_byte=pre)
+                if data:
+                    return proto_codec.decode_request_bytes(data)
+                # zero-length frame (an empty Request): nothing to serve,
+                # keep the stream aligned and read the next frame
 
         return read
 

@@ -19,7 +19,11 @@ Layout under the node home (config.go:208-236):
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib  # 3.11+
+except ImportError:  # 3.10: the API-identical backport
+    import tomli as tomllib
 from dataclasses import dataclass, field, fields
 
 from cometbft_tpu.consensus.config import ConsensusConfig
@@ -54,15 +58,62 @@ class BaseConfig:
 @dataclass
 class CryptoConfig:
     """The TPU framework's addition (SURVEY §5.6, BASELINE.json): which
-    backend verifies signature batches."""
+    backend verifies signature batches, and how the node survives the
+    backend failing.
+
+    Degradation semantics (`backend = "auto"` — see ops/dispatch.py): every
+    batch rides the highest healthy rung of the TPU (Pallas) -> XLA -> CPU
+    (exact host oracle) ladder. Transient device failures retry with capped
+    exponential backoff + jitter; `breaker_failure_threshold` consecutive
+    failed operations (or one permanent Mosaic failure) open a circuit
+    breaker that routes ALL new batches to the CPU rung; every
+    `breaker_cooldown` seconds the breaker half-opens and one probe batch
+    re-tries the device — success closes the breaker and reclaims it.
+    `backend = "cpu"` pins the CPU rung; `backend = "tpu"` still degrades
+    to CPU on device failure (liveness beats placement) but never stops
+    re-probing the device."""
 
     backend: str = "auto"  # "cpu" | "tpu" | "auto"
     # coalesce at most this many signatures into one device batch
     max_batch_size: int = 16384
+    # --- device-fault supervision (ops/dispatch.py DeviceSupervisor) ---
+    # transient failures: retries per dispatch, with backoff doubling from
+    # retry_backoff_base up to retry_backoff_cap (plus jitter)
+    retry_max_attempts: int = 2
+    retry_backoff_base: float = 0.05
+    retry_backoff_cap: float = 1.0
+    # consecutive failed operations before the breaker opens (a permanent
+    # Mosaic failure opens it immediately)
+    breaker_failure_threshold: int = 3
+    # seconds the breaker stays open before a half-open re-probe
+    breaker_cooldown: float = 30.0
+    # wall-clock cap on any single device dispatch wait or device->host
+    # fetch; a hung device fails the batch onto the CPU ladder instead of
+    # stalling a consensus round. Generous by default: it must cover a
+    # cold first-dispatch kernel compile, not just steady-state batches
+    watchdog_timeout: float = 120.0
+    # deterministic device-fault injection schedule (libs/chaos.py syntax,
+    # e.g. "ed25519.dispatch=transient:3,pallas.trace=permanent");
+    # test/e2e only — the CBFT_CHAOS env var overlays this
+    chaos: str = ""
 
     def validate_basic(self) -> None:
         if self.backend not in ("cpu", "tpu", "auto"):
             raise ValueError(f"unknown crypto backend {self.backend!r}")
+        if self.retry_max_attempts < 0:
+            raise ValueError("retry_max_attempts cannot be negative")
+        if self.retry_backoff_base < 0 or self.retry_backoff_cap < 0:
+            raise ValueError("retry backoff values cannot be negative")
+        if self.breaker_failure_threshold < 1:
+            raise ValueError("breaker_failure_threshold must be >= 1")
+        if self.breaker_cooldown < 0:
+            raise ValueError("breaker_cooldown cannot be negative")
+        if self.watchdog_timeout <= 0:
+            raise ValueError("watchdog_timeout must be positive")
+        if self.chaos:
+            from cometbft_tpu.libs import chaos as _chaos
+
+            _chaos.parse_spec(self.chaos)  # raises ValueError on any part
 
 
 @dataclass

@@ -49,9 +49,53 @@ def _device_present() -> bool:
 
 
 def resolve_backend() -> str:
-    if _BACKEND == "auto":
-        return "tpu" if _device_present() else "cpu"
-    return _BACKEND
+    """The backend that a batch staged NOW should target. "auto" prefers
+    the device when one is present; either way a "tpu" resolution defers
+    to the device supervisor's circuit breaker (ops/dispatch.py) — while
+    the breaker is open the whole node runs the CPU ladder, and the
+    half-open re-probe window routes batches back to the device so a
+    recovered chip is reclaimed."""
+    backend = _BACKEND
+    if backend == "auto":
+        backend = "tpu" if _device_present() else "cpu"
+    if backend == "tpu":
+        from cometbft_tpu.ops import dispatch
+
+        if not dispatch.device_allowed():
+            backend = "cpu"
+    _publish_active(backend)
+    return backend
+
+
+def _publish_active(backend: str) -> None:
+    try:
+        from cometbft_tpu.libs import metrics
+
+        g = metrics.crypto_metrics().backend_active
+        for b in ("cpu", "tpu"):
+            g.labels(b).set(1.0 if b == backend else 0.0)
+    except Exception:  # noqa: BLE001 - metrics must never break dispatch
+        pass
+
+
+def configure(crypto_cfg) -> None:
+    """Apply config.crypto at node boot: backend selection, supervision
+    knobs (retry/backoff/breaker/watchdog), and any chaos schedule."""
+    set_backend(crypto_cfg.backend)
+    from cometbft_tpu.ops import dispatch
+
+    dispatch.configure(
+        failure_threshold=crypto_cfg.breaker_failure_threshold,
+        cooldown=crypto_cfg.breaker_cooldown,
+        retry_attempts=crypto_cfg.retry_max_attempts,
+        retry_base=crypto_cfg.retry_backoff_base,
+        retry_cap=crypto_cfg.retry_backoff_cap,
+        watchdog_timeout=crypto_cfg.watchdog_timeout,
+    )
+    if crypto_cfg.chaos:
+        from cometbft_tpu.libs import chaos
+
+        chaos.arm_spec(crypto_cfg.chaos)
 
 
 def supports_batch_verifier(pub_key: crypto.PubKey | None) -> bool:

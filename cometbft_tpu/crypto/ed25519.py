@@ -19,11 +19,16 @@ from __future__ import annotations
 import hashlib
 import secrets
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+
+    _HAVE_OPENSSL = True
+except ImportError:  # degraded: pure-Python ZIP-215 oracle does everything
+    _HAVE_OPENSSL = False
 
 from cometbft_tpu import crypto
 from cometbft_tpu.crypto import ed25519_math, tmhash
@@ -56,6 +61,16 @@ class PubKey(crypto.PubKey):
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
         if len(sig) != SIGNATURE_SIZE:
             return False
+        if not _HAVE_OPENSSL:
+            from cometbft_tpu.crypto import _libcrypto
+
+            if _libcrypto.available():
+                # same strict-then-ZIP-215 split as the cryptography path
+                if _libcrypto.ed25519_verify(self._bytes, msg, sig):
+                    return True
+            if int.from_bytes(sig[32:], "little") >= ed25519_math.L:
+                return False
+            return ed25519_math.verify_zip215(self._bytes, msg, sig)
         try:
             if self._openssl is None:
                 self._openssl = Ed25519PublicKey.from_public_bytes(self._bytes)
@@ -90,14 +105,29 @@ class PrivKey(crypto.PrivKey):
         else:
             raise crypto.ErrInvalidKey("ed25519 privkey must be 32 or 64 bytes")
         self._seed = seed
-        self._openssl = Ed25519PrivateKey.from_private_bytes(seed)
-        pub = self._openssl.public_key().public_bytes_raw()
+        if _HAVE_OPENSSL:
+            self._openssl = Ed25519PrivateKey.from_private_bytes(seed)
+            pub = self._openssl.public_key().public_bytes_raw()
+        else:
+            from cometbft_tpu.crypto import _libcrypto
+
+            self._openssl = None
+            if _libcrypto.available():
+                pub = _libcrypto.ed25519_pub_from_seed(seed)
+            else:
+                pub = ed25519_math.public_key_from_seed(seed)
         self._pub = PubKey(pub)
 
     def bytes_(self) -> bytes:
         return self._seed + self._pub.bytes_()
 
     def sign(self, msg: bytes) -> bytes:
+        if self._openssl is None:
+            from cometbft_tpu.crypto import _libcrypto
+
+            if _libcrypto.available():
+                return _libcrypto.ed25519_sign(self._seed, msg)
+            return ed25519_math.sign(self._seed, msg)
         return self._openssl.sign(msg)
 
     def pub_key(self) -> PubKey:

@@ -1,0 +1,350 @@
+"""Pure-Python stand-ins for the OpenSSL-backed primitives.
+
+The production fast paths ride the `cryptography` package (OpenSSL). A
+node must still FUNCTION without it — the same degradation philosophy as
+the TPU->XLA->CPU verify ladder (ops/dispatch.py): a missing accelerator
+(native crypto here, the device kernel there) costs throughput, never
+liveness. Every consumer gates its import and falls back to this module:
+
+  crypto/ed25519.py           sign/verify via the ZIP-215 oracle
+  crypto/secp256k1.py         ECDSA sign (RFC 6979) / verify
+  p2p/conn/secret_connection  X25519 (RFC 7748) + ChaCha20-Poly1305 (RFC 8439)
+  crypto/xchacha20poly1305    the same AEAD under an HChaCha20 subkey
+  crypto/xsalsa20symmetric    Poly1305
+
+Implementations follow the RFCs directly and are cross-checked against
+the reference vectors in tests/test_legacy_crypto.py / test_secp256k1.py /
+test_p2p.py (which compare wire bytes with fixtures produced by the
+OpenSSL-backed code paths).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import struct
+
+# ---------------------------------------------------------------------------
+# ChaCha20 (RFC 8439 §2.3) — the quarter round lives in xchacha20poly1305
+# ---------------------------------------------------------------------------
+
+_M32 = 0xFFFFFFFF
+
+
+def _chacha20_block(key: bytes, counter: int, nonce12: bytes) -> bytes:
+    from cometbft_tpu.crypto.xchacha20poly1305 import _SIGMA, _quarter
+
+    st = (list(_SIGMA) + list(struct.unpack("<8L", key)) + [counter & _M32]
+          + list(struct.unpack("<3L", nonce12)))
+    ws = list(st)
+    for _ in range(10):
+        _quarter(ws, 0, 4, 8, 12)
+        _quarter(ws, 1, 5, 9, 13)
+        _quarter(ws, 2, 6, 10, 14)
+        _quarter(ws, 3, 7, 11, 15)
+        _quarter(ws, 0, 5, 10, 15)
+        _quarter(ws, 1, 6, 11, 12)
+        _quarter(ws, 2, 7, 8, 13)
+        _quarter(ws, 3, 4, 9, 14)
+    return struct.pack("<16L", *((w + s) & _M32 for w, s in zip(ws, st)))
+
+
+def _chacha20_keystream_np(key: bytes, counter: int, nonce12: bytes,
+                           nblocks: int) -> bytes:
+    """All `nblocks` 64-byte keystream blocks at once, quarter rounds
+    vectorized over the counter axis with numpy uint32 — the p2p secret
+    connection pushes every wire byte through this, so the per-byte Python
+    loop of the naive version is not an option."""
+    import numpy as np
+
+    from cometbft_tpu.crypto.xchacha20poly1305 import _SIGMA
+
+    st = np.empty((16, nblocks), dtype=np.uint32)
+    st[0:4, :] = np.array(_SIGMA, dtype=np.uint32)[:, None]
+    st[4:12, :] = np.frombuffer(key, dtype="<u4").astype(np.uint32)[:, None]
+    st[12, :] = (np.arange(counter, counter + nblocks, dtype=np.uint64)
+                 & 0xFFFFFFFF).astype(np.uint32)
+    st[13:16, :] = np.frombuffer(nonce12, dtype="<u4").astype(
+        np.uint32)[:, None]
+    ws = st.copy()
+
+    def rotl(v, n):
+        return (v << np.uint32(n)) | (v >> np.uint32(32 - n))
+
+    def quarter(a, b, c, d):
+        ws[a] += ws[b]
+        ws[d] = rotl(ws[d] ^ ws[a], 16)
+        ws[c] += ws[d]
+        ws[b] = rotl(ws[b] ^ ws[c], 12)
+        ws[a] += ws[b]
+        ws[d] = rotl(ws[d] ^ ws[a], 8)
+        ws[c] += ws[d]
+        ws[b] = rotl(ws[b] ^ ws[c], 7)
+
+    with np.errstate(over="ignore"):
+        for _ in range(10):
+            quarter(0, 4, 8, 12)
+            quarter(1, 5, 9, 13)
+            quarter(2, 6, 10, 14)
+            quarter(3, 7, 11, 15)
+            quarter(0, 5, 10, 15)
+            quarter(1, 6, 11, 12)
+            quarter(2, 7, 8, 13)
+            quarter(3, 4, 9, 14)
+        ws += st
+    # (16, N) words -> per-block little-endian byte serialization
+    return ws.T.astype("<u4").tobytes()
+
+
+def chacha20_xor(key: bytes, nonce12: bytes, data: bytes,
+                 counter: int = 1) -> bytes:
+    import numpy as np
+
+    n = len(data)
+    if n == 0:
+        return b""
+    nblocks = (n + 63) // 64
+    stream = _chacha20_keystream_np(key, counter, nonce12, nblocks)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    ks = np.frombuffer(stream, dtype=np.uint8)[:n]
+    return (buf ^ ks).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Poly1305 (RFC 8439 §2.5)
+# ---------------------------------------------------------------------------
+
+_P1305 = (1 << 130) - 5
+_RMASK = 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+
+
+def poly1305_mac(key32: bytes, msg: bytes) -> bytes:
+    r = int.from_bytes(key32[:16], "little") & _RMASK
+    s = int.from_bytes(key32[16:32], "little")
+    acc = 0
+    for i in range(0, len(msg), 16):
+        chunk = msg[i:i + 16]
+        n = int.from_bytes(chunk, "little") + (1 << (8 * len(chunk)))
+        acc = ((acc + n) * r) % _P1305
+    return ((acc + s) & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+class ChaCha20Poly1305:
+    """RFC 8439 AEAD, API-compatible with
+    cryptography.hazmat.primitives.ciphers.aead.ChaCha20Poly1305. Uses the
+    process libcrypto via ctypes when present (crypto/_libcrypto.py — the
+    p2p frame path is throughput-critical); pure Python otherwise."""
+
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise ValueError("ChaCha20Poly1305 key must be 32 bytes")
+        self._key = bytes(key)
+        from cometbft_tpu.crypto import _libcrypto
+
+        self._native = _libcrypto if _libcrypto.available() else None
+
+    def _tag(self, nonce: bytes, ct: bytes, aad: bytes) -> bytes:
+        poly_key = _chacha20_block(self._key, 0, nonce)[:32]
+        mac_data = (aad + b"\x00" * (-len(aad) % 16)
+                    + ct + b"\x00" * (-len(ct) % 16)
+                    + struct.pack("<QQ", len(aad), len(ct)))
+        return poly1305_mac(poly_key, mac_data)
+
+    def encrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        if len(nonce) != 12:
+            raise ValueError("nonce must be 12 bytes")
+        aad = aad or b""
+        if self._native is not None:
+            return self._native.aead_seal(self._key, nonce, data, aad)
+        ct = chacha20_xor(self._key, nonce, data)
+        return ct + self._tag(nonce, ct, aad)
+
+    def decrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        if len(nonce) != 12:
+            raise ValueError("nonce must be 12 bytes")
+        if len(data) < 16:
+            raise InvalidTag("ciphertext too short")
+        aad = aad or b""
+        if self._native is not None:
+            try:
+                return self._native.aead_open(self._key, nonce, data, aad)
+            except ValueError as e:
+                raise InvalidTag(str(e)) from None
+        ct, tag = data[:-16], data[-16:]
+        if not _hmac.compare_digest(self._tag(nonce, ct, aad), tag):
+            raise InvalidTag("poly1305 tag mismatch")
+        return chacha20_xor(self._key, nonce, ct)
+
+
+class InvalidTag(Exception):
+    """Mirror of cryptography.exceptions.InvalidTag for gated imports."""
+
+
+# ---------------------------------------------------------------------------
+# X25519 (RFC 7748 §5)
+# ---------------------------------------------------------------------------
+
+_P255 = 2**255 - 19
+_A24 = 121665
+
+
+def _x25519_ladder(k: int, u: int) -> int:
+    x1, x2, z2, x3, z3 = u, 1, 0, u, 1
+    swap = 0
+    for t in range(254, -1, -1):
+        k_t = (k >> t) & 1
+        if swap ^ k_t:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = k_t
+        a = (x2 + z2) % _P255
+        aa = a * a % _P255
+        b = (x2 - z2) % _P255
+        bb = b * b % _P255
+        e = (aa - bb) % _P255
+        c = (x3 + z3) % _P255
+        d = (x3 - z3) % _P255
+        da = d * a % _P255
+        cb = c * b % _P255
+        x3 = (da + cb) % _P255
+        x3 = x3 * x3 % _P255
+        z3 = (da - cb) % _P255
+        z3 = z3 * z3 % _P255
+        z3 = z3 * x1 % _P255
+        x2 = aa * bb % _P255
+        z2 = e * (aa + _A24 * e) % _P255
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    return x2 * pow(z2, _P255 - 2, _P255) % _P255
+
+
+def x25519(scalar: bytes, u_bytes: bytes) -> bytes:
+    """RFC 7748 X25519(k, u) with standard clamping. libcrypto when
+    present; pure-Python Montgomery ladder otherwise."""
+    from cometbft_tpu.crypto import _libcrypto
+
+    if _libcrypto.available():
+        return _libcrypto.x25519(scalar, u_bytes)
+    k = int.from_bytes(scalar, "little")
+    k &= ~(7 | (1 << 255))
+    k |= 1 << 254
+    u = int.from_bytes(u_bytes, "little") & ((1 << 255) - 1)
+    out = _x25519_ladder(k, u)
+    if out == 0:
+        raise ValueError("x25519: low-order point (all-zero shared secret)")
+    return out.to_bytes(32, "little")
+
+
+X25519_BASEPOINT = (9).to_bytes(32, "little")
+
+
+# ---------------------------------------------------------------------------
+# secp256k1 ECDSA (SEC 2 curve, RFC 6979 deterministic nonces)
+# ---------------------------------------------------------------------------
+
+SECP_P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+SECP_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_SECP_G = (
+    0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798,
+    0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8,
+)
+
+
+def _secp_add(p, q):
+    if p is None:
+        return q
+    if q is None:
+        return p
+    x1, y1 = p
+    x2, y2 = q
+    if x1 == x2 and (y1 + y2) % SECP_P == 0:
+        return None
+    if p == q:
+        lam = (3 * x1 * x1) * pow(2 * y1, SECP_P - 2, SECP_P) % SECP_P
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, SECP_P - 2, SECP_P) % SECP_P
+    x3 = (lam * lam - x1 - x2) % SECP_P
+    return x3, (lam * (x1 - x3) - y1) % SECP_P
+
+
+def _secp_mul(k: int, p):
+    acc = None
+    while k:
+        if k & 1:
+            acc = _secp_add(acc, p)
+        p = _secp_add(p, p)
+        k >>= 1
+    return acc
+
+
+def secp_point_decompress(data: bytes):
+    """33-byte SEC compressed encoding -> (x, y) or None."""
+    if len(data) != 33 or data[0] not in (2, 3):
+        return None
+    x = int.from_bytes(data[1:], "big")
+    if x >= SECP_P:
+        return None
+    y2 = (pow(x, 3, SECP_P) + 7) % SECP_P
+    y = pow(y2, (SECP_P + 1) // 4, SECP_P)
+    if y * y % SECP_P != y2:
+        return None
+    if y & 1 != data[0] & 1:
+        y = SECP_P - y
+    return x, y
+
+
+def secp_point_compress(p) -> bytes:
+    x, y = p
+    return bytes([2 | (y & 1)]) + x.to_bytes(32, "big")
+
+
+def secp_pub_from_priv(d: int) -> bytes:
+    return secp_point_compress(_secp_mul(d, _SECP_G))
+
+
+def _rfc6979_k(d: int, h1: bytes) -> int:
+    """RFC 6979 §3.2 deterministic nonce for SHA-256/secp256k1."""
+    x = d.to_bytes(32, "big")
+    # bits2octets: reduce the hash mod N before keying HMAC (§2.3.4)
+    h1 = (int.from_bytes(h1, "big") % SECP_N).to_bytes(32, "big")
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    k = _hmac.new(k, v + b"\x00" + x + h1, hashlib.sha256).digest()
+    v = _hmac.new(k, v, hashlib.sha256).digest()
+    k = _hmac.new(k, v + b"\x01" + x + h1, hashlib.sha256).digest()
+    v = _hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = _hmac.new(k, v, hashlib.sha256).digest()
+        cand = int.from_bytes(v, "big")
+        if 0 < cand < SECP_N:
+            return cand
+        k = _hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = _hmac.new(k, v, hashlib.sha256).digest()
+
+
+def secp_sign(d: int, msg: bytes) -> tuple[int, int]:
+    """ECDSA-SHA256 -> (r, s); caller canonicalizes S."""
+    h1 = hashlib.sha256(msg).digest()
+    z = int.from_bytes(h1, "big") % SECP_N
+    while True:
+        k = _rfc6979_k(d, h1)
+        pt = _secp_mul(k, _SECP_G)
+        r = pt[0] % SECP_N
+        if r == 0:
+            continue
+        s = (z + r * d) * pow(k, SECP_N - 2, SECP_N) % SECP_N
+        if s == 0:
+            continue
+        return r, s
+
+
+def secp_verify(pub33: bytes, msg: bytes, r: int, s: int) -> bool:
+    pt = secp_point_decompress(pub33)
+    if pt is None or not (0 < r < SECP_N and 0 < s < SECP_N):
+        return False
+    z = int.from_bytes(hashlib.sha256(msg).digest(), "big") % SECP_N
+    w = pow(s, SECP_N - 2, SECP_N)
+    res = _secp_add(
+        _secp_mul(z * w % SECP_N, _SECP_G), _secp_mul(r * w % SECP_N, pt))
+    return res is not None and res[0] % SECP_N == r

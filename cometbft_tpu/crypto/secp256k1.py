@@ -16,15 +16,21 @@ from __future__ import annotations
 import hashlib
 import secrets as _secrets
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    decode_dss_signature,
-    encode_dss_signature,
-)
-from cryptography.hazmat.primitives import hashes
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+    from cryptography.hazmat.primitives import hashes
+
+    _HAVE_OPENSSL = True
+except ImportError:  # degraded: pure-Python ECDSA (crypto/fallback.py)
+    _HAVE_OPENSSL = False
 
 from cometbft_tpu import crypto
+from cometbft_tpu.crypto import fallback as _fb
 
 KEY_TYPE = "secp256k1"
 PUB_KEY_SIZE = 33
@@ -65,6 +71,8 @@ class PubKey(crypto.PubKey):
         s = int.from_bytes(sig[32:], "big")
         if not (0 < r < N and 0 < s <= _HALF_N):
             return False
+        if not _HAVE_OPENSSL:
+            return _fb.secp_verify(self._bytes, msg, r, s)
         try:
             if self._openssl is None:
                 self._openssl = ec.EllipticCurvePublicKey.from_encoded_point(
@@ -89,14 +97,18 @@ class PrivKey(crypto.PrivKey):
         d = int.from_bytes(data, "big")
         if not 0 < d < N:
             raise crypto.ErrInvalidKey("secp256k1 privkey out of range")
-        self._openssl = ec.derive_private_key(d, ec.SECP256K1())
-        from cryptography.hazmat.primitives.serialization import (
-            Encoding,
-            PublicFormat,
-        )
+        if _HAVE_OPENSSL:
+            self._openssl = ec.derive_private_key(d, ec.SECP256K1())
+            from cryptography.hazmat.primitives.serialization import (
+                Encoding,
+                PublicFormat,
+            )
 
-        pub = self._openssl.public_key().public_bytes(
-            Encoding.X962, PublicFormat.CompressedPoint)
+            pub = self._openssl.public_key().public_bytes(
+                Encoding.X962, PublicFormat.CompressedPoint)
+        else:
+            self._openssl = None
+            pub = _fb.secp_pub_from_priv(d)
         self._pub = PubKey(pub)
 
     def bytes_(self) -> bytes:
@@ -104,8 +116,11 @@ class PrivKey(crypto.PrivKey):
 
     def sign(self, msg: bytes) -> bytes:
         """64-byte R||S with low-S canonicalization (secp256k1.go:160-178)."""
-        der = self._openssl.sign(msg, ec.ECDSA(hashes.SHA256()))
-        r, s = decode_dss_signature(der)
+        if self._openssl is None:
+            r, s = _fb.secp_sign(int.from_bytes(self._bytes, "big"), msg)
+        else:
+            der = self._openssl.sign(msg, ec.ECDSA(hashes.SHA256()))
+            r, s = decode_dss_signature(der)
         if s > _HALF_N:
             s = N - s
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")

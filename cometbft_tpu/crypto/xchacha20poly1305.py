@@ -53,7 +53,10 @@ def hchacha20(key: bytes, nonce16: bytes) -> bytes:
 
 
 def _aead(key: bytes, nonce: bytes):
-    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    try:
+        from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    except ImportError:  # degraded: pure-Python AEAD (crypto/fallback.py)
+        from cometbft_tpu.crypto.fallback import ChaCha20Poly1305
 
     if len(key) != KEY_SIZE:
         raise ValueError("xchacha20poly1305: bad key length")
@@ -73,7 +76,10 @@ def seal(key: bytes, nonce: bytes, plaintext: bytes,
 def open_(key: bytes, nonce: bytes, ciphertext: bytes,
           additional_data: bytes = b"") -> bytes:
     """Raises ValueError on authentication failure (xchachapoly.go Open)."""
-    from cryptography.exceptions import InvalidTag
+    try:
+        from cryptography.exceptions import InvalidTag
+    except ImportError:
+        from cometbft_tpu.crypto.fallback import InvalidTag
 
     aead, n12 = _aead(key, nonce)
     if len(ciphertext) < TAG_SIZE:

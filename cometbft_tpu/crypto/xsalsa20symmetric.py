@@ -113,7 +113,12 @@ def _xsalsa20_xor(key: bytes, nonce24: bytes, data: bytes) -> tuple[bytes, bytes
 
 
 def _poly1305(key32: bytes, msg: bytes) -> bytes:
-    from cryptography.hazmat.primitives import poly1305
+    try:
+        from cryptography.hazmat.primitives import poly1305
+    except ImportError:  # degraded: pure-Python MAC (crypto/fallback.py)
+        from cometbft_tpu.crypto.fallback import poly1305_mac
+
+        return poly1305_mac(key32, msg)
 
     p = poly1305.Poly1305(key32)
     p.update(msg)

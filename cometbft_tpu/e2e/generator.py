@@ -21,8 +21,11 @@ INITIAL_STATES: tuple[dict, ...] = (
 VOTE_EXT_HEIGHT_OFFSETS = (0, 2)  # 0 = disabled
 # perturbation -> probability a node gets it (generate.go nodePerturbations;
 # "disconnect" needs a network layer OS processes don't have — the in-proc
-# perturbation matrix, tests/test_e2e_perturb.py, covers it)
-PERTURBATIONS = {"kill": 0.1, "pause": 0.1, "restart": 0.1}
+# perturbation matrix, tests/test_e2e_perturb.py, covers it). device-kill /
+# device-flap restart a node with a CBFT_CHAOS schedule armed (runner.py):
+# the accelerator dies or flaps and the verify ladder must keep committing.
+PERTURBATIONS = {"kill": 0.1, "pause": 0.1, "restart": 0.1,
+                 "device-kill": 0.05, "device-flap": 0.05}
 
 
 def generate_manifest(rng: random.Random, index: int) -> Manifest:
@@ -61,7 +64,9 @@ def generate_manifest(rng: random.Random, index: int) -> Manifest:
     # pause never loses the process, so memdb+pause stays in the matrix.
     if perturbed:
         nd = m.nodes[perturbed[0]]
-        if nd.database == "memdb" and set(nd.perturb) & {"kill", "restart"}:
+        # device-kill/device-flap also kill + respawn the OS process
+        if nd.database == "memdb" and set(nd.perturb) & {
+                "kill", "restart", "device-kill", "device-flap"}:
             nd.database = "sqlite"
     m.validate()
     return m

@@ -4,7 +4,10 @@ mirroring networks/*.toml)."""
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib  # 3.11+
+except ImportError:  # 3.10: the API-identical backport
+    import tomli as tomllib
 from dataclasses import dataclass, field
 
 
@@ -17,7 +20,15 @@ class NodeManifest:
     privval_protocol: str = "file"  # file (remote-signer nets use tests')
     persist_interval: int = 1
     retain_blocks: int = 0
-    perturb: list[str] = field(default_factory=list)  # kill|pause|restart
+    # process faults: kill | pause | restart (perturb.go:44-100) and
+    # device faults: device-kill (restart with the accelerator permanently
+    # dead via a CBFT_CHAOS schedule — the node must keep committing on
+    # the CPU ladder), device-flap (restart with a transient-fault
+    # schedule — the supervisor must retry/re-probe back onto the device)
+    perturb: list[str] = field(default_factory=list)
+
+    PERTURBATIONS = ("kill", "pause", "restart", "disconnect",
+                     "device-kill", "device-flap")
 
     def validate(self) -> None:
         if self.database not in ("sqlite", "memdb"):
@@ -25,7 +36,7 @@ class NodeManifest:
         if self.abci_protocol not in ("builtin", "tcp", "unix", "grpc"):
             raise ValueError(f"unknown abci protocol {self.abci_protocol!r}")
         for p in self.perturb:
-            if p not in ("kill", "pause", "restart", "disconnect"):
+            if p not in self.PERTURBATIONS:
                 raise ValueError(f"unknown perturbation {p!r}")
 
 

@@ -109,11 +109,38 @@ def setup(manifest: Manifest, out_dir: str, base_port: int) -> _Net:
     return net
 
 
+# device-fault perturbation schedules (libs/chaos.py syntax). The net
+# normally pins crypto.backend=cpu (N processes cannot share one real
+# chip), so the perturbation rewrites the ONE perturbed node's config to
+# backend="tpu" (JAX_PLATFORMS=cpu in _env makes that the XLA-on-CPU
+# device path — no chip contention) with the chaos schedule armed from
+# config: its supervisor/breaker/fallback paths genuinely run, and the
+# node must still rejoin the live head.
+DEVICE_KILL_CHAOS = ("ed25519.dispatch=permanent,sr25519.dispatch=permanent,"
+                     "pallas.trace=permanent")
+DEVICE_FLAP_CHAOS = ("ed25519.dispatch=transient:4,ed25519.fetch=timeout:1,"
+                     "sr25519.dispatch=transient:2")
+
+
 def _spawn_node(home: str):
     return subprocess.Popen(
         [sys.executable, "-m", "cometbft_tpu", "--home", home, "start"],
         cwd=REPO, env=_env(), stdout=subprocess.DEVNULL,
         stderr=subprocess.STDOUT, start_new_session=True)
+
+
+def _arm_device_chaos(home: str, spec: str) -> None:
+    """Point the node's on-disk config at the device path with `spec`
+    armed (survives the respawn; CBFT_CHAOS env would work too but the
+    config knob keeps the whole schedule visible in the node's home)."""
+    from cometbft_tpu.config import Config
+
+    cfg = Config.load(home)
+    cfg.crypto.backend = "tpu"
+    cfg.crypto.chaos = spec
+    # a dead device should sideline fast in a liveness test
+    cfg.crypto.breaker_failure_threshold = 1
+    cfg.save()
 
 
 def _spawn_app(addr: str):
@@ -205,6 +232,18 @@ def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
                     log(f"[{manifest.name}] restart {name}")
                     _kill(net.node_procs[i])
                     net.node_procs[i] = _spawn_node(net.homes[i])
+                elif p in ("device-kill", "device-flap"):
+                    # restart the node on the device backend with a chaos
+                    # schedule armed: its accelerator is dead (permanent)
+                    # or flapping (transient) from boot — catching up to
+                    # the live head below proves the degraded verify
+                    # ladder commits; crypto_health is asserted after
+                    chaos = (DEVICE_KILL_CHAOS if p == "device-kill"
+                             else DEVICE_FLAP_CHAOS)
+                    log(f"[{manifest.name}] {p} {name}")
+                    _kill(net.node_procs[i])
+                    _arm_device_chaos(net.homes[i], chaos)
+                    net.node_procs[i] = _spawn_node(net.homes[i])
                 elif p == "pause":
                     log(f"[{manifest.name}] pause {name}")
                     os.killpg(net.node_procs[i].pid, signal.SIGSTOP)
@@ -216,11 +255,29 @@ def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
                         time.sleep(2.0)
                     os.killpg(net.node_procs[i].pid, signal.SIGCONT)
                 # the perturbed node must rejoin the live head (generous
-                # deadline: CI shares the host with whatever else runs)
+                # deadline: CI shares the host with whatever else runs,
+                # and a device perturbation pays cold kernel compiles)
                 target = max((_height(net, j) for j in others),
                              default=h0) + 1
                 _wait(lambda: _height(net, i) >= target, 240,
                       f"{name} catching up to {target} after {p}")
+                if p in ("device-kill", "device-flap"):
+                    # the degradation must be OBSERVED, not assumed: the
+                    # supervisor recorded device failures and (for a dead
+                    # device) the node now serves verifies from the CPU rung
+                    h = _rpc(net, i, "crypto_health")["result"]
+                    dev = h["supervisors"].get("device", {})
+                    if dev.get("failures", 0) < 1:
+                        raise RunError(
+                            f"{p} on {name}: no supervised device failures "
+                            f"recorded (crypto_health: {h})")
+                    if (p == "device-kill"
+                            and dev.get("breaker", {}).get("state") == "closed"):
+                        # only a SUCCESSFUL device op closes the breaker —
+                        # impossible with a permanently dead device
+                        raise RunError(
+                            f"device-kill on {name}: breaker closed, so a "
+                            f"device op succeeded (crypto_health: {h})")
 
         target = max(manifest.initial_height + manifest.target_height_delta,
                      max(_height(net, i) for i in range(n)))

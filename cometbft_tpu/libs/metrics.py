@@ -28,6 +28,13 @@ class _Metric:
                 f"{self.name}: want {len(self.label_names)} labels, got {len(label_values)}")
         return _Bound(self, tuple(str(v) for v in label_values))
 
+    def value(self, *label_values: str) -> float:
+        """Current value for the label combination (0.0 if never set) —
+        the assertion-friendly read side for tests and health snapshots."""
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
     def _set(self, key: tuple, v: float) -> None:
         with self._lock:
             self._values[key] = v
@@ -258,6 +265,31 @@ class CryptoMetrics:
         self.mask_oracle_disagreement = reg.counter(
             "crypto", "mask_oracle_disagreement",
             "Device-rejected lanes the host oracle re-accepted")
+        # backend-health plane (device-fault resilience layer,
+        # ops/dispatch.py): which rung of the TPU->XLA->CPU ladder is
+        # serving verifies, and how the supervisors are doing
+        self.backend_active = reg.gauge(
+            "crypto", "backend_active",
+            "1 for the backend currently serving verify batches",
+            labels=("backend",))
+        self.breaker_state = reg.gauge(
+            "crypto", "breaker_state",
+            "Device circuit breaker: 0 closed, 1 half-open, 2 open",
+            labels=("name",))
+        self.device_retries = reg.counter(
+            "crypto", "device_retries",
+            "Transient device-op retries (backoff path)", labels=("name",))
+        self.device_failures = reg.counter(
+            "crypto", "device_failures",
+            "Supervised device operations that failed after retries",
+            labels=("name", "class"))
+        self.breaker_transitions = reg.counter(
+            "crypto", "breaker_transitions",
+            "Circuit breaker state transitions", labels=("name", "to"))
+        self.fallback_verifies = reg.counter(
+            "crypto", "fallback_verifies",
+            "Signature lanes verified on the CPU ladder after a device "
+            "failure", labels=("scheme",))
 
 
 _global: Optional[Registry] = None
@@ -268,3 +300,20 @@ def global_registry() -> Registry:
     if _global is None:
         _global = Registry()
     return _global
+
+
+_crypto: Optional[CryptoMetrics] = None
+_crypto_lock = threading.Lock()
+
+
+def crypto_metrics() -> CryptoMetrics:
+    """Process-global CryptoMetrics on the global registry. The device is a
+    process-global resource, so its health plane is too (unlike the
+    per-node Consensus/Mempool/P2P structs). Double-checked init: racing
+    first calls must not register duplicate series."""
+    global _crypto
+    if _crypto is None:
+        with _crypto_lock:
+            if _crypto is None:
+                _crypto = CryptoMetrics(global_registry())
+    return _crypto

@@ -114,14 +114,39 @@ class TaskRunner:
         return task
 
     async def cancel_all(self) -> None:
-        tasks = list(self._tasks)
+        """Cancel and reap every task. Cancels REPEATEDLY: on Python < 3.11
+        asyncio.wait_for can swallow a pending cancellation when its inner
+        future completes in the same scheduling window (bpo-42130), leaving
+        a task alive after one cancel — a single `await t` would then hang
+        the whole service stop. Re-cancelling until the task actually dies
+        makes teardown immune to that lost-wakeup race; tasks that survive
+        every attempt (a tight loop swallowing CancelledError) are abandoned
+        with a warning rather than wedging shutdown."""
+        current = asyncio.current_task()
+        # a service routine may stop its own service (a peer's recv loop
+        # tearing the peer down): never cancel-and-await the calling task —
+        # it ends naturally after teardown, and cancelling it here would
+        # abort the teardown itself mid-flight
+        tasks = [t for t in self._tasks if t is not current]
         for t in tasks:
             t.cancel()
-        for t in tasks:
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
+        pending = set(tasks)
+        for _attempt in range(10):
+            if not pending:
+                break
+            done, pending = await asyncio.wait(pending, timeout=1.0)
+            for t in done:
+                if not t.cancelled() and t.exception() is not None:
+                    pass  # swallowed: stop paths must not re-raise task errors
+            for t in pending:
+                t.cancel()
+        if pending:
+            import logging
+
+            logging.getLogger("cometbft").warning(
+                "%s.cancel_all: %d task(s) survived repeated cancellation: %s",
+                self.name, len(pending),
+                [t.get_name() for t in pending])
         self._tasks.clear()
 
     def __len__(self) -> int:

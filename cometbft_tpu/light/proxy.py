@@ -84,6 +84,13 @@ class _UpstreamWS:
 
     def __init__(self, base_url: str):
         u = urllib.parse.urlparse(normalize_rpc_url(base_url))
+        if u.scheme in ("https", "wss"):
+            # this client speaks plaintext only; silently opening a clear
+            # socket to an https primary would leak the relay's traffic
+            raise ValueError(
+                f"light proxy: TLS primaries are not supported for the "
+                f"websocket relay (got {base_url!r}); use an http:// "
+                "primary or terminate TLS in front of the proxy")
         self.host = u.hostname or "127.0.0.1"
         self.port = u.port or 80
         self.reader: asyncio.StreamReader | None = None
@@ -159,6 +166,15 @@ class ProxyEnv:
         self.primary = _PrimaryRPC(primary_url)
         self.primary_url = primary_url
         self._upstreams: dict[str, _UpstreamWS] = {}
+        # fail at construction, not inside some client's first ws
+        # subscribe: the websocket relay cannot speak TLS (_UpstreamWS
+        # raises the same error as defense in depth)
+        if urllib.parse.urlparse(
+                normalize_rpc_url(primary_url)).scheme in ("https", "wss"):
+            raise ValueError(
+                f"light proxy: TLS primaries are not supported for the "
+                f"websocket relay (got {primary_url!r}); use an http:// "
+                "primary or terminate TLS in front of the proxy")
 
     async def _verified(self, params: dict):
         h = params.get("height")

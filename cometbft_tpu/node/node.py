@@ -110,8 +110,9 @@ class Node(BaseService):
         self.config = config
         config.validate_basic()
 
-        # crypto backend selection (BASELINE: --crypto.backend flag)
-        crypto_batch.set_backend(config.crypto.backend)
+        # crypto backend selection + device-fault supervision knobs
+        # (BASELINE: --crypto.backend flag; ops/dispatch.py supervisor)
+        crypto_batch.configure(config.crypto)
 
         # ---- genesis + identity (node.go:274-300)
         if genesis_doc is None:

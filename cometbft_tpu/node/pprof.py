@@ -120,12 +120,17 @@ class PprofServer(BaseService):
             writer.close()
 
     async def _profile(self, writer, params: dict) -> None:
+        import math
+
         try:
-            seconds = min(float(params.get("seconds", "5")),
-                          MAX_PROFILE_SECONDS)
+            s = float(params.get("seconds", "5"))
         except ValueError:
             await self._respond(writer, 400, b"bad seconds\n")
             return
+        if not math.isfinite(s):  # nan/inf must never reach asyncio.sleep
+            await self._respond(writer, 400, b"bad seconds\n")
+            return
+        seconds = min(MAX_PROFILE_SECONDS, max(0.0, s))
         if self._profiling:
             await self._respond(writer, 409, b"profile already running\n")
             return

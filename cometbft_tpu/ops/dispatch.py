@@ -1,4 +1,4 @@
-"""Shared kernel-dispatch lock.
+"""Shared kernel-dispatch lock + the device-fault supervision layer.
 
 The Pallas Ed25519 kernel trace temporarily swaps the field/curve module
 constants for VMEM refs (pallas_verify._verify_block_kernel). ANY other
@@ -8,32 +8,464 @@ another kernel's refs/tracers into its compiled program. Every jit
 dispatch of a curve kernel therefore serializes on this one lock
 (compiled-cache dispatch under the lock is sub-ms; the expensive
 host<->device transfers stay outside it).
+
+Supervision (the device-fault resilience layer): the node's hot path lives
+on an accelerator that can time out, OOM, lose its Mosaic compile, or
+vanish behind a contended tunnel. Instead of the old one-way `broken`
+latch, every device operation runs under a DeviceSupervisor:
+
+  classify   transient (XlaRuntimeError RESOURCE_EXHAUSTED/UNAVAILABLE,
+             timeouts) vs permanent (Mosaic/lowering death)
+  retry      transients retry with capped exponential backoff + jitter
+  break      N consecutive failed operations (or one permanent) open a
+             circuit breaker — new batches skip the device entirely
+  re-probe   after `cooldown` the breaker half-opens and ONE batch probes
+             the device; success closes the breaker and reclaims the
+             device, failure re-opens it
+
+The supervisor only decides *whether* the device is used; the verify
+ladder TPU (Pallas) -> XLA -> CPU (exact host oracle) does the falling
+back, in ops/ed25519_kernel.py / ops/sr25519_kernel.py and
+crypto/batch.resolve_backend. Fault injection for all of this lives in
+libs/chaos.py.
 """
 
 from __future__ import annotations
 
+import random
 import threading
+import time
 
 KERNEL_DISPATCH_LOCK = threading.Lock()
 
+# failure classes
+TRANSIENT = "transient"
+PERMANENT = "permanent"
+TIMEOUT = "timeout"
+
+# breaker states (gauge encoding: the wire values are part of the
+# metrics/RPC contract, keep in sync with README)
+CLOSED = "closed"
+HALF_OPEN = "half_open"
+OPEN = "open"
+_STATE_GAUGE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class DeviceUnavailable(Exception):
+    """Breaker open: the device is sidelined until the next re-probe."""
+
+
+class DeviceOpFailed(Exception):
+    """A supervised device operation failed (after retries). The original
+    exception rides __cause__; the supervisor has already recorded it —
+    catchers fall back without double-counting."""
+
+
+# transient markers in XlaRuntimeError/RuntimeError text (gRPC-style codes
+# the PJRT client surfaces for contended/hung/OOM devices)
+_TRANSIENT_MARKERS = (
+    "RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED", "UNAVAILABLE", "ABORTED",
+    "CANCELLED", "connection reset", "timed out", "temporarily",
+)
+# permanent markers: a failing Mosaic trace/lowering costs seconds and
+# will fail the same way every time for this program shape
+_PERMANENT_MARKERS = (
+    "Mosaic", "mosaic", "lowering", "Unsupported", "NOT_FOUND",
+    "UNIMPLEMENTED", "INVALID_ARGUMENT",
+)
+
+
+def classify_failure(exc: BaseException) -> str:
+    """Map a device-op exception to a failure class. Unknown errors count
+    as transient: a flapping tunnel produces novel error text, and the
+    breaker bounds how long we keep trying."""
+    from cometbft_tpu.libs import chaos
+
+    if isinstance(exc, chaos.ChaosPermanentError):
+        return PERMANENT
+    if isinstance(exc, chaos.ChaosTransientError):
+        return TRANSIENT
+    if isinstance(exc, (chaos.ChaosTimeout, TimeoutError)):
+        return TIMEOUT
+    try:  # concurrent.futures.TimeoutError is TimeoutError on 3.11+, not 3.10
+        import concurrent.futures as _cf
+
+        if isinstance(exc, _cf.TimeoutError):
+            return TIMEOUT
+    except ImportError:  # pragma: no cover
+        pass
+    text = f"{type(exc).__name__}: {exc}"
+    if any(m in text for m in _PERMANENT_MARKERS):
+        return PERMANENT
+    if any(m in text for m in _TRANSIENT_MARKERS):
+        return TRANSIENT
+    return TRANSIENT
+
+
+def _metrics():
+    """Lazy process-global CryptoMetrics; never raises (metrics must not
+    break verification)."""
+    try:
+        from cometbft_tpu.libs import metrics as m
+
+        return m.crypto_metrics()
+    except Exception:  # noqa: BLE001
+        return None
+
+
+class CircuitBreaker:
+    """closed -> (N consecutive failures | 1 permanent) -> open ->
+    (cooldown elapses) -> half_open -> one probe -> closed | open."""
+
+    def __init__(self, name: str, failure_threshold: int = 3,
+                 cooldown: float = 30.0, clock=time.monotonic):
+        self.name = name
+        self.failure_threshold = failure_threshold
+        self.cooldown = cooldown
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self._publish(CLOSED, transition=False)
+
+    def _publish(self, state: str, transition: bool = True) -> None:
+        m = _metrics()
+        if m is None:
+            return
+        try:
+            m.breaker_state.labels(self.name).set(_STATE_GAUGE[state])
+            if transition:
+                m.breaker_transitions.labels(self.name, state).inc()
+        except Exception:  # noqa: BLE001
+            pass
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._consecutive
+
+    def allow(self) -> bool:
+        """Claim permission for a device operation. An OPEN breaker whose
+        cooldown has elapsed half-opens and admits the caller as THE probe;
+        while that probe is in flight every other caller is refused — one
+        batch tests a possibly-dead device, not a whole blocksync window.
+        Read-only callers (health snapshots, backend resolution at staging
+        time) must use peek() instead: allow() is a state transition."""
+        with self._lock:
+            if self._state == OPEN:
+                if self._clock() - self._opened_at < self.cooldown:
+                    return False
+                self._state = HALF_OPEN
+                self._probe_inflight = True
+                self._publish(HALF_OPEN)
+                return True
+            if self._state == HALF_OPEN:
+                if self._probe_inflight:
+                    return False
+                self._probe_inflight = True
+            return True
+
+    def peek(self) -> bool:
+        """Would a device operation be admitted now? No transitions, no
+        probe claim — safe for health snapshots and staging decisions."""
+        with self._lock:
+            if self._state == OPEN:
+                return self._clock() - self._opened_at >= self.cooldown
+            if self._state == HALF_OPEN:
+                return not self._probe_inflight
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._probe_inflight = False
+            if self._state != CLOSED:
+                self._state = CLOSED
+                self._publish(CLOSED)
+
+    def record_failure(self, fclass: str) -> None:
+        """A failed operation (retries exhausted). Permanent failures and a
+        failed half-open probe open immediately; transients open at the
+        threshold."""
+        with self._lock:
+            self._consecutive += 1
+            self._probe_inflight = False
+            opens = (
+                fclass == PERMANENT
+                or self._state == HALF_OPEN
+                or self._consecutive >= self.failure_threshold
+            )
+            if opens and self._state != OPEN:
+                self._state = OPEN
+                self._opened_at = self._clock()
+                self._publish(OPEN)
+            elif self._state == OPEN:
+                self._opened_at = self._clock()  # failed probe: restart timer
+
+    def health(self) -> dict:
+        with self._lock:
+            out = {
+                "state": self._state,
+                "consecutive_failures": self._consecutive,
+                "failure_threshold": self.failure_threshold,
+                "cooldown_seconds": self.cooldown,
+            }
+            if self._state == OPEN:
+                out["reprobe_in_seconds"] = round(
+                    max(0.0, self.cooldown - (self._clock() - self._opened_at)), 3)
+            return out
+
+
+class DeviceSupervisor:
+    """Retry/backoff + breaker + bookkeeping around one class of device
+    operation. `sleep`/`clock` are injectable so chaos tests run on a fake
+    timeline."""
+
+    def __init__(self, name: str, failure_threshold: int = 3,
+                 cooldown: float = 30.0, retry_attempts: int = 2,
+                 retry_base: float = 0.05, retry_cap: float = 1.0,
+                 sleep=time.sleep, clock=time.monotonic):
+        self.name = name
+        self.breaker = CircuitBreaker(
+            name, failure_threshold=failure_threshold, cooldown=cooldown,
+            clock=clock)
+        self.retry_attempts = retry_attempts
+        self.retry_base = retry_base
+        self.retry_cap = retry_cap
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self.retries = 0
+        self.failures = 0
+        self.successes = 0
+        self.last_error: str | None = None
+
+    # ------------------------------------------------------------ stats
+
+    def _count_retry(self) -> None:
+        with self._lock:
+            self.retries += 1
+        m = _metrics()
+        if m is not None:
+            try:
+                m.device_retries.labels(self.name).inc()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _count_failure(self, fclass: str, exc: BaseException) -> None:
+        with self._lock:
+            self.failures += 1
+            self.last_error = f"{fclass}: {type(exc).__name__}: {exc}"
+        m = _metrics()
+        if m is not None:
+            try:
+                m.device_failures.labels(self.name, fclass).inc()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -------------------------------------------------------------- run
+
+    def run(self, fn, *args, **kwargs):
+        """Run fn under supervision. Raises DeviceUnavailable (breaker open,
+        nothing attempted) or DeviceOpFailed (attempted and failed; already
+        recorded). Success resets the breaker."""
+        if not self.breaker.allow():
+            raise DeviceUnavailable(self.name)
+        attempt = 0
+        while True:
+            try:
+                out = fn(*args, **kwargs)
+            except Exception as exc:  # noqa: BLE001 - classified below
+                fclass = classify_failure(exc)
+                if fclass == TRANSIENT and attempt < self.retry_attempts:
+                    self._count_retry()
+                    delay = min(self.retry_cap, self.retry_base * (2 ** attempt))
+                    self._sleep(delay * (0.5 + random.random() / 2))
+                    attempt += 1
+                    continue
+                self._count_failure(fclass, exc)
+                self.breaker.record_failure(fclass)
+                try:
+                    from cometbft_tpu.libs import log as _log
+
+                    _log.default().error(
+                        "supervised device operation failed",
+                        supervisor=self.name, failure_class=fclass,
+                        attempts=str(attempt + 1),
+                        breaker=self.breaker.state, err=str(exc))
+                except Exception:  # noqa: BLE001
+                    pass
+                raise DeviceOpFailed(
+                    f"{self.name}: {fclass} device failure "
+                    f"after {attempt + 1} attempt(s)") from exc
+            with self._lock:
+                self.successes += 1
+            self.breaker.record_success()
+            return out
+
+    def record_op_failure(self, exc: BaseException) -> str:
+        """Record a failure observed outside run() (e.g. a watchdog timeout
+        on the fetch side). Returns the failure class."""
+        fclass = classify_failure(exc)
+        self._count_failure(fclass, exc)
+        self.breaker.record_failure(fclass)
+        return fclass
+
+    def health(self) -> dict:
+        with self._lock:
+            out = {
+                "retries": self.retries,
+                "failures": self.failures,
+                "successes": self.successes,
+                "last_error": self.last_error,
+            }
+        out["breaker"] = self.breaker.health()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# process-global supervisor registry + knobs (configured from
+# config.crypto at node boot; tests poke configure() directly)
+# ---------------------------------------------------------------------------
+
+_config = {
+    "failure_threshold": 3,
+    "cooldown": 30.0,
+    "retry_attempts": 2,
+    "retry_base": 0.05,
+    "retry_cap": 1.0,
+    # must comfortably cover a COLD first-dispatch compile (Mosaic traces
+    # run tens of seconds; the per-call watchdog cannot tell compile from
+    # hang) while still bounding a wedged fetch to well under a blocksync
+    # window retry
+    "watchdog_timeout": 120.0,
+    # Pallas gets a longer leash: a failed Mosaic trace costs seconds, so
+    # re-probe it an order of magnitude less often than the XLA/device path
+    "pallas_cooldown": 300.0,
+}
+
+_registry_lock = threading.Lock()
+_supervisors: dict[str, DeviceSupervisor] = {}
+
+
+def configure(**kwargs) -> None:
+    """Set supervision knobs (unknown keys rejected). Existing supervisors
+    pick up the new values in place so a node reconfig (or a test) does not
+    orphan live breakers."""
+    with _registry_lock:
+        for k, v in kwargs.items():
+            if k not in _config:
+                raise ValueError(f"unknown supervision knob {k!r}")
+            _config[k] = v
+        for name, sup in _supervisors.items():
+            pallas = name.startswith("pallas")
+            sup.breaker.failure_threshold = _config["failure_threshold"]
+            sup.breaker.cooldown = (
+                _config["pallas_cooldown"] if pallas else _config["cooldown"])
+            # pallas rungs never retry in place: a transient re-runs as XLA
+            # now and Pallas is re-probed on the next aligned batch
+            sup.retry_attempts = 0 if pallas else _config["retry_attempts"]
+            sup.retry_base = _config["retry_base"]
+            sup.retry_cap = _config["retry_cap"]
+
+
+def watchdog_timeout() -> float:
+    return _config["watchdog_timeout"]
+
+
+def supervisor(name: str) -> DeviceSupervisor:
+    with _registry_lock:
+        sup = _supervisors.get(name)
+        if sup is None:
+            pallas = name.startswith("pallas")
+            sup = DeviceSupervisor(
+                name,
+                failure_threshold=_config["failure_threshold"],
+                cooldown=(_config["pallas_cooldown"] if pallas
+                          else _config["cooldown"]),
+                retry_attempts=0 if pallas else _config["retry_attempts"],
+                retry_base=_config["retry_base"],
+                retry_cap=_config["retry_cap"],
+            )
+            _supervisors[name] = sup
+        return sup
+
+
+def device_allowed() -> bool:
+    """May a NEW batch target the device? Side-effect-free peek: False
+    while the device breaker is open or another probe is mid-flight
+    (crypto/batch.resolve_backend degrades to the CPU ladder on this).
+    The authoritative probe CLAIM happens inside DeviceSupervisor.run via
+    breaker.allow() — health snapshots and staging decisions polling this
+    never change failover state."""
+    return supervisor("device").breaker.peek()
+
+
+def reset_supervision() -> None:
+    """Forget breakers/counters (tests; a fresh process state)."""
+    with _registry_lock:
+        _supervisors.clear()
+
+
+def health_snapshot() -> dict:
+    """The RPC-visible crypto-health snapshot (rpc crypto_health route)."""
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.libs import chaos
+
+    with _registry_lock:
+        sups = dict(_supervisors)
+    return {
+        "configured_backend": crypto_batch.get_backend(),
+        "active_backend": crypto_batch.resolve_backend(),
+        "watchdog_timeout_seconds": _config["watchdog_timeout"],
+        "supervisors": {name: sup.health() for name, sup in sups.items()},
+        "chaos": chaos.snapshot(),
+    }
+
 
 class PallasGate:
-    """The one dispatch policy for a Pallas kernel with an XLA fallback:
-    lane-aligned batches go to Pallas while it works; the first Mosaic
-    failure permanently disables it (a failing trace costs seconds — never
-    pay it per batch). Callers hold KERNEL_DISPATCH_LOCK around run()."""
+    """Dispatch policy for a Pallas kernel with an XLA fallback: lane-aligned
+    batches go to Pallas while its breaker is closed; a Mosaic failure opens
+    the breaker (a failing trace costs seconds — never pay it per batch) and
+    the half-open schedule re-probes, so a recovered device is reclaimed
+    instead of abandoned for the process lifetime. Callers hold
+    KERNEL_DISPATCH_LOCK around run()."""
 
-    def __init__(self) -> None:
-        self.broken = False
+    def __init__(self, name: str = "pallas") -> None:
+        self.name = name
+
+    @property
+    def supervisor(self) -> DeviceSupervisor:
+        return supervisor(self.name)
+
+    @property
+    def broken(self) -> bool:
+        """Back-compat view of the old one-way latch (bench.py reads it):
+        True while the breaker is sidelining Pallas — open, or half-open
+        with the probe already claimed."""
+        return not self.supervisor.breaker.peek()
 
     def run(self, pallas_fn, xla_fn, args, lane_count: int):
+        from cometbft_tpu.libs import chaos
         from cometbft_tpu.ops import pallas_verify as PV
         from cometbft_tpu.ops.ed25519_kernel import _pallas_available
 
-        if (not self.broken and _pallas_available()
-                and lane_count % PV.LANES == 0):
-            try:
+        if _pallas_available() and lane_count % PV.LANES == 0:
+            def _probe():
+                chaos.fire("pallas.trace")
                 return pallas_fn(*args)
-            except Exception:  # noqa: BLE001 - Mosaic/backend failure
-                self.broken = True
+
+            try:
+                # pallas supervisors are created with retry_attempts=0 (see
+                # supervisor()): a transient re-runs as XLA below and
+                # Pallas is re-probed on the next aligned batch
+                return self.supervisor.run(_probe)
+            except (DeviceUnavailable, DeviceOpFailed):
+                pass
         return xla_fn(*args)

@@ -176,6 +176,18 @@ def _integrity_payload(mask, rw, sw, kw, expected):
     return jnp.concatenate([mask, ~mask, ok[None]])
 
 
+def host_oracle_mask(n, pre_ok, ok_a, rows, info) -> np.ndarray:
+    """The CPU rung of the verify ladder: the scheme's exact host oracle
+    over the batch rows. Counts the lanes as fallback verifies."""
+    verify_fn = info[0]
+    pubs, msgs, sigs = rows
+    host = np.fromiter(
+        (verify_fn(p, m, s) for p, m, s in zip(pubs, msgs, sigs)),
+        dtype=bool, count=n)
+    _count_fallback(info[1], n)
+    return host & pre_ok & ok_a
+
+
 def decode_payload(payload: np.ndarray, n, pre_ok, ok_a, rows, info,
                    redo=None) -> np.ndarray:
     """Validate the integrity payload and produce the final (N,) mask.
@@ -197,42 +209,54 @@ def decode_payload(payload: np.ndarray, n, pre_ok, ok_a, rows, info,
             mask_echo_ok=str(echo_ok),
             action="retry" if redo is not None else "host-oracle fallback")
         if redo is not None:
-            return decode_payload(
-                np.asarray(redo()), n, pre_ok, ok_a, rows, info, redo=None)
-        verify_fn = info[0]
-        pubs, msgs, sigs = rows
-        host = np.fromiter(
-            (verify_fn(p, m, s) for p, m, s in zip(pubs, msgs, sigs)),
-            dtype=bool, count=n)
-        return host & pre_ok & ok_a
+            try:
+                fresh = np.asarray(redo())
+            except Exception:  # noqa: BLE001 - device died during the retry
+                fresh = None
+            if fresh is not None:
+                return decode_payload(
+                    fresh, n, pre_ok, ok_a, rows, info, redo=None)
+        return host_oracle_mask(n, pre_ok, ok_a, rows, info)
     mask = mask[:n] & pre_ok & ok_a
     return apply_recheck(mask, pre_ok & ok_a, rows, info)
 
 
-_crypto_metrics = None
-_crypto_metrics_lock = __import__("threading").Lock()
-
-
 def _count_integrity(kind: str, n: int = 1) -> None:
-    global _crypto_metrics
     try:
-        if _crypto_metrics is None:
-            # racing inits would register duplicate counter series in the
-            # global registry (Registry._register appends without dedup)
-            with _crypto_metrics_lock:
-                if _crypto_metrics is None:
-                    from cometbft_tpu.libs import metrics as _metrics
+        from cometbft_tpu.libs import metrics as _metrics
 
-                    _crypto_metrics = _metrics.CryptoMetrics(
-                        _metrics.global_registry())
-        getattr(_crypto_metrics, kind).inc(n)
+        getattr(_metrics.crypto_metrics(), kind).inc(n)
     except Exception:  # noqa: BLE001 - metrics must never break verification
         pass
 
 
+def _count_fallback(scheme: str, n: int) -> None:
+    """Count lanes that fell off the device onto the CPU ladder."""
+    try:
+        from cometbft_tpu.libs import metrics as _metrics
+
+        _metrics.crypto_metrics().fallback_verifies.labels(scheme).inc(n)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _count_device_batch(scheme: str, lanes: int) -> None:
+    """Count a successfully dispatched device batch (the TPU-path-is-alive
+    signal the chaos tests assert on)."""
+    try:
+        from cometbft_tpu.libs import metrics as _metrics
+
+        cm = _metrics.crypto_metrics()
+        cm.device_batches.labels(scheme).inc()
+        cm.device_lanes.labels(scheme).inc(lanes)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+from cometbft_tpu.ops import dispatch as _dispatch
 from cometbft_tpu.ops.dispatch import PallasGate
 
-_pallas_gate = PallasGate()
+_pallas_gate = PallasGate("pallas.ed25519")
 
 
 def _dispatch_verify(a_dev, r_words, s_words, k_words):
@@ -516,6 +540,87 @@ def apply_recheck(mask, eligible, rows, info):
     return mask
 
 
+def make_host_thunk(n, pre_ok, rows, info):
+    """A verify thunk that never touches the device — the CPU rung of the
+    ladder, used when the breaker has sidelined the device or staging
+    failed. Same thunk contract as verify_batch_async (device_parts with a
+    None payload acquirer and n > 0 routes resolve_batches here too)."""
+    ones = np.ones(n, dtype=bool)
+    cached: dict = {}
+
+    def result() -> np.ndarray:
+        if "m" not in cached:
+            cached["m"] = host_oracle_mask(n, pre_ok, ones, rows, info)
+        return cached["m"]
+
+    result.device_parts = lambda: (None, n, pre_ok, ones, rows, info, None)
+    return result
+
+
+def supervised_device_thunk(scheme: str, sup, submit_fn, fetch_site: str,
+                            n, pre_ok, ok_a, rows, info):
+    """The shared thunk shape for a supervised device batch (ed25519 and
+    sr25519 build their dispatch closure, this builds the rest): dispatch
+    runs on the transfer pool under the supervisor; the payload fetch is
+    watchdog-bounded; every failure drops the batch onto the host oracle
+    instead of raising into the verify seam."""
+    fut = _xfer_pool().submit(sup.run, submit_fn)
+
+    def _acquire():
+        """Block until dispatch completes; returns the device-resident
+        payload. Raises DeviceOpFailed/DeviceUnavailable (recorded)."""
+        try:
+            return fut.result(timeout=_dispatch.watchdog_timeout())
+        except (_dispatch.DeviceOpFailed, _dispatch.DeviceUnavailable):
+            raise
+        except Exception as exc:  # noqa: BLE001 - watchdog timeout etc.
+            sup.record_op_failure(exc)
+            raise _dispatch.DeviceOpFailed(f"{scheme} dispatch wait") from exc
+
+    def _fetch_np(payload_dev) -> np.ndarray:
+        """Device->host payload fetch: chaos site + watchdog + injected
+        lane corruption (the integrity echo plane must catch it)."""
+        from cometbft_tpu.libs import chaos
+
+        try:
+            chaos.fire(fetch_site)
+            out = _fetch_pool().submit(
+                lambda: np.asarray(payload_dev)).result(
+                    timeout=_dispatch.watchdog_timeout())
+        except Exception as exc:  # noqa: BLE001
+            sup.record_op_failure(exc)
+            raise _dispatch.DeviceOpFailed(f"{scheme} payload fetch") from exc
+        return chaos.corrupt_mask(fetch_site, out)
+
+    def _redo():
+        """Integrity-retry path: full fresh transfer+dispatch+fetch,
+        supervised AND watchdog-bounded like every other device wait — a
+        device that hangs during the retry must not stall the verify seam
+        (decode_payload catches and falls to the host oracle), and the
+        hang/failure is recorded so the breaker and crypto_health see it."""
+        try:
+            return _fetch_pool().submit(
+                lambda: np.asarray(sup.run(submit_fn))).result(
+                    timeout=_dispatch.watchdog_timeout())
+        except (_dispatch.DeviceOpFailed, _dispatch.DeviceUnavailable):
+            raise  # sup.run already recorded it
+        except Exception as exc:  # noqa: BLE001 - watchdog timeout etc.
+            sup.record_op_failure(exc)
+            raise
+
+    def result() -> np.ndarray:
+        try:
+            payload = _fetch_np(_acquire())
+        except (_dispatch.DeviceOpFailed, _dispatch.DeviceUnavailable):
+            return host_oracle_mask(n, pre_ok, ok_a, rows, info)
+        return decode_payload(
+            payload, n, pre_ok, ok_a, rows, info, redo=_redo)
+
+    result.device_parts = lambda: (
+        _acquire, n, pre_ok, ok_a, rows, info, _redo)
+    return result
+
+
 def verify_batch_async(
     pubs: list[bytes],
     msgs: list[bytes],
@@ -527,7 +632,12 @@ def verify_batch_async(
     materializes the (N,) bool mask. Lets callers (blocksync streaming,
     VoteSet flush) overlap host staging of batch N+1 with device compute of
     batch N. recheck_groups: per-commit row boundaries of a coalesced
-    window (see apply_recheck)."""
+    window (see apply_recheck).
+
+    Device faults never escape the thunk: dispatch runs under the "device"
+    supervisor (transient retry + breaker, ops/dispatch.py), fetches are
+    watchdog-bounded, and any failure resolves the batch on the exact host
+    oracle — a hung or dead device costs latency, not a consensus round."""
     n = len(sigs)
     assert len(pubs) == n and len(msgs) == n
     if n == 0:
@@ -540,33 +650,39 @@ def verify_batch_async(
 
     b = bucket_size(n)
     pre_ok, safe_pubs, r_words, s_words, k_words = stage_batch(pubs, msgs, sigs, b)
-    ok_a, a_dev = _stage_gather(cache, safe_pubs, b)
+    rows = (safe_pubs, list(msgs), list(sigs))
+    info = (oracle.verify_zip215, "ed25519", recheck_groups)
+    sup = _dispatch.supervisor("device")
+
+    a_dev = None
+    if _dispatch.device_allowed():
+        try:
+            ok_a, a_dev = _stage_gather(cache, safe_pubs, b)
+        except Exception as exc:  # noqa: BLE001 - device died in staging
+            sup.record_op_failure(exc)
+    if a_dev is None:
+        return make_host_thunk(n, pre_ok, rows, info)
     expected = np.uint32(_host_checksum(r_words, s_words, k_words))
 
     def _transfer_and_dispatch():
+        from cometbft_tpu.libs import chaos
+
+        chaos.fire("ed25519.dispatch")
         rw = jnp.asarray(r_words)
         sw = jnp.asarray(s_words)
         kw = jnp.asarray(k_words)
         mask = _dispatch_verify(a_dev, rw, sw, kw)
-        return _integrity_payload(mask, rw, sw, kw, expected)
+        payload = _integrity_payload(mask, rw, sw, kw, expected)
+        _count_device_batch("ed25519", b)
+        return payload
 
     # The host->device copy blocks the calling thread for the wire time
     # (~45 ms/MB through the axon tunnel), so it runs on a small pool:
     # the caller can stage batch i+1 while batch i's bytes are in flight,
     # and parallel puts multiplex the tunnel.
-    fut = _xfer_pool().submit(_transfer_and_dispatch)
-
-    rows = (safe_pubs, list(msgs), list(sigs))
-    info = (oracle.verify_zip215, "ed25519", recheck_groups)
-
-    def result() -> np.ndarray:
-        return decode_payload(
-            np.asarray(fut.result()), n, pre_ok, ok_a, rows, info,
-            redo=_transfer_and_dispatch)
-
-    result.device_parts = lambda: (
-        fut.result(), n, pre_ok, ok_a, rows, info, _transfer_and_dispatch)
-    return result
+    return supervised_device_thunk(
+        "ed25519", sup, _transfer_and_dispatch, "ed25519.fetch",
+        n, pre_ok, ok_a, rows, info)
 
 
 def resolve_batches(thunks) -> list[np.ndarray]:
@@ -575,15 +691,50 @@ def resolve_batches(thunks) -> list[np.ndarray]:
     ~89 ms round trip, so streaming callers (blocksync, bench) resolve a
     window of batches at once. Thunks may mix schemes (the mixed
     mega-commit resolves its ed25519 and sr25519 sub-batches together) —
-    each carries its own host re-check oracle."""
+    each carries its own host re-check oracle.
+
+    Device-fault behavior: a batch whose dispatch failed (or that was
+    staged host-side because the breaker was open) resolves on the host
+    oracle; a failed/hung combined fetch (watchdog) drops every device
+    batch in the window onto the host oracle. The function never raises on
+    device trouble — blocksync's pool routine awaits it from an executor."""
     parts = [t.device_parts() for t in thunks]
-    nonempty = [p[0] for p in parts if p[0] is not None]
-    flat = np.asarray(jnp.concatenate(nonempty)) if nonempty else np.zeros(0, bool)
+    payloads: list = []
+    for p in parts:
+        acquire = p[0]
+        if acquire is None:
+            payloads.append(None)
+            continue
+        try:
+            payloads.append(acquire())
+        except Exception:  # noqa: BLE001 - recorded by the thunk's supervisor
+            payloads.append(False)
+    nonempty = [p for p in payloads if p is not None and p is not False]
+    flat = np.zeros(0, dtype=bool)
+    if nonempty:
+        sup = _dispatch.supervisor("device")
+
+        def _pull():
+            from cometbft_tpu.libs import chaos
+
+            chaos.fire("mixed.resolve")
+            return np.asarray(jnp.concatenate(nonempty))
+
+        try:
+            flat = _fetch_pool().submit(_pull).result(
+                timeout=_dispatch.watchdog_timeout())
+        except Exception as exc:  # noqa: BLE001 - window falls to the CPU rung
+            sup.record_op_failure(exc)
+            flat = None
     out = []
     off = 0
-    for payload_dev, n, pre_ok, ok_a, rows, info, redo in parts:
-        if payload_dev is None:
+    for payload_dev, (acquire, n, pre_ok, ok_a, rows, info, redo) in zip(
+            payloads, parts):
+        if payload_dev is None and acquire is None and n == 0:
             out.append(np.zeros(0, dtype=bool))
+            continue
+        if payload_dev is None or payload_dev is False or flat is None:
+            out.append(host_oracle_mask(n, pre_ok, ok_a, rows, info))
             continue
         b = payload_dev.shape[0]
         out.append(decode_payload(
@@ -593,6 +744,7 @@ def resolve_batches(thunks) -> list[np.ndarray]:
 
 
 _pool = None
+_fpool = None
 
 
 def _xfer_pool():
@@ -604,3 +756,19 @@ def _xfer_pool():
             max_workers=4, thread_name_prefix="ed25519-xfer"
         )
     return _pool
+
+
+def _fetch_pool():
+    """Separate pool for watchdog-bounded device->host fetches: a fetch
+    abandoned by the watchdog keeps its thread until jax gives up, and it
+    must not starve the dispatch pool. If a hung device clogs both workers,
+    subsequent fetches time out too — which is the truth — and the breaker
+    stops new device batches after the threshold."""
+    global _fpool
+    if _fpool is None:
+        import concurrent.futures
+
+        _fpool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="device-fetch"
+        )
+    return _fpool

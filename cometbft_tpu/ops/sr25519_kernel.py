@@ -120,7 +120,7 @@ _verify_kernel = jax.jit(verify_math_sr)
 
 from cometbft_tpu.ops.dispatch import PallasGate  # noqa: E402
 
-_pallas_gate = PallasGate()
+_pallas_gate = PallasGate("pallas.sr25519")
 
 
 def decompress_points(enc: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -240,15 +240,34 @@ def verify_batch_async(
             None, 0, np.zeros(0, bool), np.zeros(0, bool), ([], [], []),
             (srm.verify, "sr25519", None), None)
         return empty
-    pre_ok, ok_a, n, a_dev, r_np, s_np, k_np = stage_batch_sr(
-        pubs, msgs, sigs, cache=cache
-    )
+    from cometbft_tpu.ops import dispatch as D
     from cometbft_tpu.ops import ed25519_kernel as EK
     from cometbft_tpu.ops.dispatch import KERNEL_DISPATCH_LOCK
 
+    rows = (list(pubs), list(msgs), list(sigs))
+    info = (srm.verify, "sr25519", None)
+    sup = D.supervisor("device")
+
+    staged = None
+    if D.device_allowed():
+        try:
+            staged = stage_batch_sr(pubs, msgs, sigs, cache=cache)
+        except Exception as exc:  # noqa: BLE001 - device died in staging
+            sup.record_op_failure(exc)
+    if staged is None:
+        # structural pre-checks still run host-side so pre_ok keeps the
+        # identity-placeholder semantics of the device path
+        pre_ok = np.fromiter(
+            (len(p) == 32 and srm.parse_signature(s) is not None
+             for p, s in zip(pubs, sigs)), dtype=bool, count=n)
+        return EK.make_host_thunk(n, pre_ok, rows, info)
+    pre_ok, ok_a, n, a_dev, r_np, s_np, k_np = staged
     expected = np.uint32(EK._host_checksum(r_np, s_np, k_np))
 
     def _dispatch():
+        from cometbft_tpu.libs import chaos
+
+        chaos.fire("sr25519.dispatch")
         # any curve-kernel trace swaps field/curve module constants under
         # this lock (ops/dispatch.py); never trace concurrently
         r_w = jnp.asarray(r_np)
@@ -260,20 +279,13 @@ def verify_batch_async(
             mask = _pallas_gate.run(
                 PV.verify_pallas_sr, _verify_kernel,
                 (*a_dev, r_w, s_w, k_w), r_w.shape[1])
-        return EK._integrity_payload(mask, r_w, s_w, k_w, expected)
+        payload = EK._integrity_payload(mask, r_w, s_w, k_w, expected)
+        EK._count_device_batch("sr25519", r_w.shape[1])
+        return payload
 
-    fut = EK._xfer_pool().submit(_dispatch)
-    rows = (list(pubs), list(msgs), list(sigs))
-    info = (srm.verify, "sr25519", None)
-
-    def result() -> np.ndarray:
-        return EK.decode_payload(
-            np.asarray(fut.result()), n, pre_ok, ok_a, rows, info,
-            redo=_dispatch)
-
-    result.device_parts = lambda: (
-        fut.result(), n, pre_ok, ok_a, rows, info, _dispatch)
-    return result
+    return EK.supervised_device_thunk(
+        "sr25519", sup, _dispatch, "sr25519.fetch",
+        n, pre_ok, ok_a, rows, info)
 
 
 def verify_batch(

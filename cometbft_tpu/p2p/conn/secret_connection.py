@@ -28,12 +28,19 @@ import hashlib
 import hmac
 import struct
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+    _HAVE_OPENSSL = True
+except ImportError:  # degraded: pure-Python X25519 + AEAD (crypto/fallback)
+    from cometbft_tpu.crypto.fallback import ChaCha20Poly1305, InvalidTag
+
+    _HAVE_OPENSSL = False
 
 from cometbft_tpu.crypto import ed25519
 
@@ -139,8 +146,17 @@ class SecretConnection:
         """MakeSecretConnection (secret_connection.go:71-130)."""
         from cometbft_tpu.utils import protobuf as pb
 
-        eph_priv = X25519PrivateKey.generate()
-        eph_pub = eph_priv.public_key().public_bytes_raw()
+        if _HAVE_OPENSSL:
+            eph_priv = X25519PrivateKey.generate()
+            eph_pub = eph_priv.public_key().public_bytes_raw()
+        else:
+            import secrets as _secrets
+
+            from cometbft_tpu.crypto import fallback as _fb
+
+            eph_seed = _secrets.token_bytes(32)
+            eph_priv = None
+            eph_pub = _fb.x25519(eph_seed, _fb.X25519_BASEPOINT)
 
         # 1. concurrent ephemeral pubkey exchange as varint-delimited
         #    google.protobuf.BytesValue (secret_connection.go shareEphPubKey)
@@ -156,7 +172,11 @@ class SecretConnection:
         # 2. DH; session keys via HKDF on the raw DH secret; the sign-me
         #    challenge from the Merlin transcript (secret_connection.go:
         #    111-135)
-        dh_secret = eph_priv.exchange(X25519PublicKey.from_public_bytes(rem_eph_pub))
+        if eph_priv is not None:
+            dh_secret = eph_priv.exchange(
+                X25519PublicKey.from_public_bytes(rem_eph_pub))
+        else:
+            dh_secret = _fb.x25519(eph_seed, rem_eph_pub)
         loc_is_least = eph_pub < rem_eph_pub
         lo, hi = sorted((eph_pub, rem_eph_pub))
         recv_key, send_key = derive_secrets(dh_secret, loc_is_least)

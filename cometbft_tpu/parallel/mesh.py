@@ -38,7 +38,12 @@ def shard_verify_kernel(mesh: Mesh):
     spec_tail = P(None, SIG_AXIS)
     in_specs = (spec_tail,) * 7
     out_specs = P(SIG_AXIS)
-    fn = jax.shard_map(
+    # jax.shard_map graduated from jax.experimental in newer releases;
+    # support both so the mesh path runs on whatever jax the host bakes in
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:
+        from jax.experimental.shard_map import shard_map
+    fn = shard_map(
         K.verify_math, mesh=mesh, in_specs=in_specs, out_specs=out_specs
     )
     return jax.jit(fn)

@@ -89,6 +89,16 @@ class Environment:
             raise RPCError(-32603, "consensus failure: receive routine dead")
         return {}
 
+    async def crypto_health(self, _params: dict) -> dict:
+        """The device-fault resilience snapshot (no reference analog):
+        active verify backend, breaker states, retry/failure counters and
+        any armed chaos schedule (ops/dispatch.py health_snapshot). Served
+        in inspect mode too — a crashed node's disk plus the process-global
+        device state remain examinable."""
+        from cometbft_tpu.ops import dispatch
+
+        return dispatch.health_snapshot()
+
     async def status(self, _params: dict) -> dict:
         """rpc/core/status.go."""
         n = self.node
@@ -769,6 +779,7 @@ class Environment:
     def _routes_table(self) -> dict:
         return {
             "health": self.health,
+            "crypto_health": self.crypto_health,
             "status": self.status,
             "net_info": self.net_info,
             "genesis": self.genesis,

@@ -140,11 +140,20 @@ class RPCServer(BaseService):
             if route == "metrics":
                 # Prometheus text exposition (config.instrumentation;
                 # reference serves this on prometheus_laddr — one process
-                # port here, same scrape contract)
+                # port here, same scrape contract). The crypto backend-
+                # health plane lives in the process-global registry (the
+                # device is shared across in-proc nodes) and is appended
+                # after the node's own series.
                 reg = getattr(self.node, "metrics_registry", None)
                 if reg is None:
                     return 404, {"error": "metrics disabled"}
-                return 200, _RawText(reg.render())
+                from cometbft_tpu.libs import metrics as cmtmetrics
+
+                body = reg.render()
+                if reg is not cmtmetrics.global_registry():
+                    cmtmetrics.crypto_metrics()  # ensure series exist
+                    body += cmtmetrics.global_registry().render()
+                return 200, _RawText(body)
             if route == "openapi.yaml":
                 # the machine-readable API description (reference:
                 # rpc/openapi/openapi.yaml) — immutable at runtime, read

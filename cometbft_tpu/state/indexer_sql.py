@@ -109,6 +109,17 @@ _STMTS = {
 
 _DIALECTS = ("sqlite", "postgresql")
 
+# RETURNING landed in SQLite 3.35 (2021); older embedded libsqlite still
+# ships on some hosts. The sink degrades to lastrowid/SELECT lookups there
+# — same rows, one extra statement per upsert.
+_RETURNING_OK = sqlite3.sqlite_version_info >= (3, 35, 0)
+
+_STMTS_NO_RETURNING = {
+    k: v.replace(" RETURNING rowid", "") for k, v in _STMTS.items()
+}
+_SELECT_BLOCK_ROWID = (
+    "SELECT rowid FROM blocks WHERE height = ? AND chain_id = ?")
+
 
 def schema_sql(dialect: str = "sqlite") -> str:
     """The sink DDL rendered for `dialect`."""
@@ -147,16 +158,27 @@ class SQLEventSink:
     # --------------------------------------------------------------- write
 
     def _block_rowid(self, cur, height: int) -> int:
-        cur.execute(_STMTS["upsert_block"], (height, self.chain_id, _now()))
+        if _RETURNING_OK:
+            cur.execute(_STMTS["upsert_block"],
+                        (height, self.chain_id, _now()))
+            return cur.fetchone()[0]
+        cur.execute(_STMTS_NO_RETURNING["upsert_block"],
+                    (height, self.chain_id, _now()))
+        cur.execute(_SELECT_BLOCK_ROWID, (height, self.chain_id))
         return cur.fetchone()[0]
 
     def _insert_events(self, cur, block_rowid: int, tx_rowid, events) -> None:
         for ev in events or []:
             if not ev.type_:
                 continue
-            cur.execute(_STMTS["insert_event"],
-                        (block_rowid, tx_rowid, ev.type_))
-            event_id = cur.fetchone()[0]
+            if _RETURNING_OK:
+                cur.execute(_STMTS["insert_event"],
+                            (block_rowid, tx_rowid, ev.type_))
+                event_id = cur.fetchone()[0]
+            else:
+                cur.execute(_STMTS_NO_RETURNING["insert_event"],
+                            (block_rowid, tx_rowid, ev.type_))
+                event_id = cur.lastrowid
             for attr in ev.attributes:
                 if not attr.key:
                     continue
@@ -186,15 +208,22 @@ class SQLEventSink:
         cur = self._db.cursor()
         for res in tx_results:
             rowid = self._block_rowid(cur, res.height)
-            cur.execute(
-                _STMTS["insert_tx"],
-                (rowid, res.index, _now(), tx_hash(res.tx).hex().upper(),
-                 _json.dumps(abci_codec._to_jsonable(res.result)).encode()))
-            row = cur.fetchone()
-            if row is None:
-                continue  # re-delivered tx: events already recorded
+            params = (
+                rowid, res.index, _now(), tx_hash(res.tx).hex().upper(),
+                _json.dumps(abci_codec._to_jsonable(res.result)).encode())
+            if _RETURNING_OK:
+                cur.execute(_STMTS["insert_tx"], params)
+                row = cur.fetchone()
+                if row is None:
+                    continue  # re-delivered tx: events already recorded
+                tx_rowid = row[0]
+            else:
+                cur.execute(_STMTS_NO_RETURNING["insert_tx"], params)
+                if cur.rowcount == 0:
+                    continue  # conflict DO NOTHING: re-delivered tx
+                tx_rowid = cur.lastrowid
             self._insert_events(
-                cur, rowid, row[0], getattr(res.result, "events", []))
+                cur, rowid, tx_rowid, getattr(res.result, "events", []))
         self._db.commit()
 
     def close(self) -> None:

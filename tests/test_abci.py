@@ -93,6 +93,37 @@ def test_socket_server_roundtrip(tmp_path):
     run(main())
 
 
+def test_socket_server_empty_proto_frame_not_misclassified(tmp_path):
+    """A proto stream whose first frame is empty (varint length 0, first
+    byte 0x00) must not be autodetected as JSON: the peeked bytes belong to
+    the next proto frame and the request after the empty frame is served."""
+    import asyncio
+
+    from cometbft_tpu.abci import proto_codec as pc
+
+    async def main():
+        app = KVStoreApplication()
+        addr = f"unix://{tmp_path}/abci0.sock"
+        server = ABCIServer(app, addr)
+        await server.start()
+        try:
+            reader, writer = await asyncio.open_unix_connection(
+                addr[len("unix://"):])
+            echo = pc.encode_request("echo", abci.RequestEcho(message="hi"))
+            # one write: empty frame + a real varint-delimited echo request
+            writer.write(b"\x00" + echo)
+            await writer.drain()
+            raw = await asyncio.wait_for(
+                pc.read_delimited_async(reader), 10)
+            method, resp = pc.decode_response_bytes(raw)
+            assert method == "echo" and resp.message == "hi"
+            writer.close()
+        finally:
+            await server.stop()
+
+    run(main())
+
+
 def test_socket_parallel_connections(tmp_path):
     """4 logical connections hitting one socket server concurrently —
     the proxy pattern (proxy/multi_app_conn.go)."""

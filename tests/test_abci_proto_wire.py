@@ -185,6 +185,37 @@ def test_echo_info_checktx_bytes(wiretest):
     assert got == ref.SerializeToString()
 
 
+def test_negative_duration_truncates_toward_zero():
+    """protobuf Duration same-sign rule (gogoproto truncation): -1.5s must
+    encode seconds=-1, nanos=-500000000 — never the mixed-sign pair Python
+    floor division produces — and round-trip exactly."""
+    from cometbft_tpu.abci.proto_codec import _dec_duration, _duration
+    from cometbft_tpu.utils import protobuf as pb
+
+    data = _duration(-1_500_000_000)
+    r = pb.Reader(data)
+    fields = {}
+    while not r.at_end():
+        f, _w = r.read_tag()
+        fields[f] = r.read_varint_i64()
+    assert fields[1] == -1 and fields[2] == -500_000_000
+    for ns in (0, 1, -1, 999_999_999, -999_999_999, -1_000_000_000,
+               -172800 * 10**9 - 500, 172800 * 10**9 + 500):
+        assert _dec_duration(_duration(ns)) == ns
+
+
+def test_negative_duration_matches_reference_bytes(wiretest):
+    """Byte-exactness of a negative max_age_duration against
+    google-protobuf's Duration encoding."""
+    from cometbft_tpu.abci.proto_codec import _duration
+
+    ref = wiretest.Request(init_chain=wiretest.RequestInitChain())
+    d = ref.init_chain.consensus_params.evidence.max_age_duration
+    d.seconds = -1
+    d.nanos = -500_000_000
+    assert _duration(-1_500_000_000) == d.SerializeToString()
+
+
 def test_init_chain_bytes_with_params(wiretest):
     params = ConsensusParams(
         block=BlockParams(max_bytes=4194304, max_gas=-1),

@@ -1,0 +1,544 @@
+"""Chaos-matrix coverage for the device-fault resilience layer
+(libs/chaos.py + ops/dispatch.py + the kernel verify ladder).
+
+Every degradation path the supervisor owns is exercised deterministically:
+transient retry/backoff, breaker open on permanent Mosaic death, half-open
+re-probe reclaiming a recovered device, watchdog timeouts, corrupted lane
+masks caught by the integrity echo plane, and the consensus/blocksync
+seams committing heights with the device dead, flapping, and recovering
+mid-run — all asserted via the backend-health metrics, not log scraping.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.libs import chaos
+from cometbft_tpu.libs import metrics as cmtmetrics
+from cometbft_tpu.ops import dispatch as D
+from cometbft_tpu.ops import ed25519_kernel as EK
+
+
+@pytest.fixture(autouse=True)
+def _clean_device_state():
+    """Every case starts with no chaos armed, fresh breakers, tight retry
+    timings (no real backoff sleeps), and ends back on the cpu backend."""
+    chaos.reset()
+    D.reset_supervision()
+    D.configure(failure_threshold=3, cooldown=30.0, retry_attempts=2,
+                retry_base=0.0, retry_cap=0.0, watchdog_timeout=120.0)
+    yield
+    chaos.reset()
+    D.reset_supervision()
+    D.configure(failure_threshold=3, cooldown=30.0, retry_attempts=2,
+                retry_base=0.05, retry_cap=1.0, watchdog_timeout=120.0)
+    crypto_batch.set_backend("cpu")
+
+
+def _metrics() -> cmtmetrics.CryptoMetrics:
+    return cmtmetrics.crypto_metrics()
+
+
+def _batch(n: int = 4):
+    privs = [ed25519.gen_priv_key() for _ in range(n)]
+    pubs = [p.pub_key().bytes_() for p in privs]
+    msgs = [b"chaos-%d" % i for i in range(n)]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    return pubs, msgs, sigs
+
+
+# ------------------------------------------------------------ chaos registry
+
+
+class TestChaosRegistry:
+    def test_spec_parsing_and_counts(self):
+        chaos.arm_spec("ed25519.dispatch=transient:2,pallas.trace=permanent")
+        assert chaos.armed("ed25519.dispatch") == "transient"
+        assert chaos.armed("pallas.trace") == "permanent"
+        assert chaos.armed("sr25519.dispatch") is None
+        with pytest.raises(chaos.ChaosTransientError):
+            chaos.fire("ed25519.dispatch")
+        with pytest.raises(chaos.ChaosTransientError):
+            chaos.fire("ed25519.dispatch")
+        chaos.fire("ed25519.dispatch")  # count exhausted: site healed
+        assert chaos.fired("ed25519.dispatch") == 2
+        with pytest.raises(chaos.ChaosPermanentError):
+            chaos.fire("pallas.trace")
+        with pytest.raises(chaos.ChaosPermanentError):
+            chaos.fire("pallas.trace")  # unlimited
+
+    def test_unknown_site_and_kind_rejected(self):
+        with pytest.raises(ValueError):
+            chaos.arm("nope.site", "transient")
+        with pytest.raises(ValueError):
+            chaos.arm("ed25519.dispatch", "meteor")
+
+    def test_timeout_kind_and_snapshot(self):
+        chaos.arm("ed25519.fetch", "timeout", count=1)
+        with pytest.raises(chaos.ChaosTimeout):
+            chaos.fire("ed25519.fetch")
+        snap = chaos.snapshot()
+        assert snap["ed25519.fetch"]["fired"] == 1
+        assert snap["ed25519.fetch"]["remaining"] == 0
+
+    def test_corrupt_flips_one_lane(self):
+        chaos.arm("ed25519.fetch", "corrupt", count=1)
+        payload = np.array([True, True, True])
+        out = chaos.corrupt_mask("ed25519.fetch", payload)
+        assert not out[0] and out[1] and out[2]
+        again = chaos.corrupt_mask("ed25519.fetch", payload)
+        assert again[0]  # healed after one firing
+
+    def test_corrupt_does_not_raise_at_fire(self):
+        chaos.arm("ed25519.dispatch", "corrupt")
+        chaos.fire("ed25519.dispatch")  # corrupt never raises at fire()
+
+
+# ----------------------------------------------------------- supervisor unit
+
+
+class TestSupervisor:
+    def test_transient_retries_with_backoff_then_success(self):
+        sleeps: list[float] = []
+        sup = D.DeviceSupervisor("t", failure_threshold=3, cooldown=5.0,
+                                 retry_attempts=2, retry_base=0.1,
+                                 retry_cap=1.0, sleep=sleeps.append)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise chaos.ChaosTransientError("UNAVAILABLE")
+            return "ok"
+
+        assert sup.run(flaky) == "ok"
+        assert len(calls) == 3 and len(sleeps) == 2
+        # capped exponential backoff with jitter in [0.5, 1.0] x base*2^i
+        assert 0.05 <= sleeps[0] <= 0.1 and 0.1 <= sleeps[1] <= 0.2
+        assert sup.breaker.state == D.CLOSED and sup.retries == 2
+
+    def test_retries_exhausted_counts_toward_breaker(self):
+        sup = D.DeviceSupervisor("t", failure_threshold=2, cooldown=5.0,
+                                 retry_attempts=1, retry_base=0.0,
+                                 sleep=lambda _s: None)
+
+        def dead():
+            raise chaos.ChaosTransientError("DEADLINE_EXCEEDED")
+
+        with pytest.raises(D.DeviceOpFailed):
+            sup.run(dead)
+        assert sup.breaker.state == D.CLOSED  # 1 of 2
+        with pytest.raises(D.DeviceOpFailed):
+            sup.run(dead)
+        assert sup.breaker.state == D.OPEN  # threshold hit
+
+    def test_permanent_opens_immediately_and_reprobe_recloses(self):
+        t = [0.0]
+        sup = D.DeviceSupervisor("t", failure_threshold=5, cooldown=10.0,
+                                 retry_attempts=2, retry_base=0.0,
+                                 sleep=lambda _s: None, clock=lambda: t[0])
+
+        def mosaic_death():
+            raise chaos.ChaosPermanentError("Mosaic lowering failed")
+
+        with pytest.raises(D.DeviceOpFailed):
+            sup.run(mosaic_death)
+        assert sup.breaker.state == D.OPEN
+        with pytest.raises(D.DeviceUnavailable):
+            sup.run(lambda: "never reached")
+        t[0] = 10.1  # cooldown elapsed: the next caller is the probe
+        assert sup.run(lambda: "probe") == "probe"
+        assert sup.breaker.state == D.CLOSED
+
+    def test_failed_probe_reopens_and_restarts_cooldown(self):
+        t = [0.0]
+        sup = D.DeviceSupervisor("t", failure_threshold=1, cooldown=10.0,
+                                 retry_attempts=0, sleep=lambda _s: None,
+                                 clock=lambda: t[0])
+        with pytest.raises(D.DeviceOpFailed):
+            sup.run(lambda: (_ for _ in ()).throw(
+                chaos.ChaosPermanentError("Mosaic")))
+        t[0] = 10.5
+        with pytest.raises(D.DeviceOpFailed):
+            sup.run(lambda: (_ for _ in ()).throw(
+                chaos.ChaosTransientError("UNAVAILABLE")))
+        assert sup.breaker.state == D.OPEN
+        t[0] = 15.0  # only 4.5s since the failed probe: still open
+        with pytest.raises(D.DeviceUnavailable):
+            sup.run(lambda: "x")
+
+    def test_half_open_admits_exactly_one_probe(self):
+        t = [0.0]
+        sup = D.DeviceSupervisor("t", failure_threshold=1, cooldown=10.0,
+                                 retry_attempts=0, sleep=lambda _s: None,
+                                 clock=lambda: t[0])
+        with pytest.raises(D.DeviceOpFailed):
+            sup.run(lambda: (_ for _ in ()).throw(
+                chaos.ChaosPermanentError("Mosaic")))
+        t[0] = 11.0
+        # peek is side-effect free: polling it must not claim the probe
+        assert sup.breaker.peek() and sup.breaker.state == D.OPEN
+        assert sup.breaker.peek()
+        # the first allow() claims the probe; the second caller is refused
+        assert sup.breaker.allow() and sup.breaker.state == D.HALF_OPEN
+        assert not sup.breaker.allow()
+        assert not sup.breaker.peek()
+        sup.breaker.record_success()
+        assert sup.breaker.state == D.CLOSED
+
+    def test_classification(self):
+        assert D.classify_failure(chaos.ChaosTimeout("t")) == D.TIMEOUT
+        assert D.classify_failure(TimeoutError()) == D.TIMEOUT
+        assert D.classify_failure(
+            RuntimeError("RESOURCE_EXHAUSTED: out of HBM")) == D.TRANSIENT
+        assert D.classify_failure(
+            RuntimeError("Mosaic lowering failed")) == D.PERMANENT
+        assert D.classify_failure(
+            RuntimeError("INVALID_ARGUMENT: bad shape")) == D.PERMANENT
+        assert D.classify_failure(ValueError("novel junk")) == D.TRANSIENT
+
+
+# ------------------------------------------------- verify ladder end-to-end
+
+
+class TestVerifyLadder:
+    def test_permanent_death_degrades_to_cpu_and_stays_correct(self):
+        pubs, msgs, sigs = _batch()
+        m = _metrics()
+        fb0 = m.fallback_verifies.value("ed25519")
+        chaos.arm("ed25519.dispatch", "permanent")
+        crypto_batch.set_backend("tpu")
+        D.configure(failure_threshold=1)
+        ok, mask = EK.verify_batch(pubs, msgs, sigs)
+        assert ok and all(mask)
+        assert m.fallback_verifies.value("ed25519") == fb0 + len(sigs)
+        assert m.device_failures.value("device", "permanent") >= 1
+        assert D.supervisor("device").breaker.state == D.OPEN
+        assert m.breaker_state.value("device") == 2
+        # the whole node now resolves to the CPU rung...
+        assert crypto_batch.resolve_backend() == "cpu"
+        assert m.backend_active.value("cpu") == 1.0
+        # ...and a batch staged now never touches the device (no new
+        # failures recorded: the breaker check happens before staging)
+        f0 = D.supervisor("device").failures
+        ok, mask = EK.verify_batch(pubs, msgs, sigs)
+        assert ok and D.supervisor("device").failures == f0
+
+    def test_transient_flap_retries_on_device(self):
+        pubs, msgs, sigs = _batch()
+        m = _metrics()
+        db0 = m.device_batches.value("ed25519")
+        chaos.arm("ed25519.dispatch", "transient", count=1)
+        ok, mask = EK.verify_batch(pubs, msgs, sigs)
+        assert ok and all(mask)
+        assert D.supervisor("device").breaker.state == D.CLOSED
+        assert m.device_retries.value("device") >= 1
+        assert m.device_batches.value("ed25519") == db0 + 1  # device served it
+
+    def test_breaker_recloses_and_batches_return_to_device(self):
+        pubs, msgs, sigs = _batch()
+        m = _metrics()
+        chaos.arm("ed25519.dispatch", "permanent", count=1)
+        D.configure(failure_threshold=1, retry_attempts=0)
+        ok, _ = EK.verify_batch(pubs, msgs, sigs)
+        assert ok and D.supervisor("device").breaker.state == D.OPEN
+        # cooldown elapses (device healed: the chaos count is exhausted)
+        D.supervisor("device").breaker.cooldown = 0.0
+        db0 = m.device_batches.value("ed25519")
+        ok, mask = EK.verify_batch(pubs, msgs, sigs)
+        assert ok and all(mask)
+        assert D.supervisor("device").breaker.state == D.CLOSED
+        assert m.device_batches.value("ed25519") == db0 + 1
+        crypto_batch.set_backend("tpu")
+        assert crypto_batch.resolve_backend() == "tpu"
+        assert m.backend_active.value("tpu") == 1.0
+
+    def test_corrupted_lane_mask_is_detected_and_repaired(self):
+        pubs, msgs, sigs = _batch()
+        m = _metrics()
+        echo0 = m.mask_echo_mismatch.value()
+        chaos.arm("ed25519.fetch", "corrupt", count=1)
+        ok, mask = EK.verify_batch(pubs, msgs, sigs)
+        # an honest signature must never be condemned by a flipped bit
+        assert ok and all(mask)
+        assert m.mask_echo_mismatch.value() == echo0 + 1
+
+    def test_fetch_timeout_fails_batch_onto_cpu_ladder(self):
+        pubs, msgs, sigs = _batch()
+        m = _metrics()
+        fb0 = m.fallback_verifies.value("ed25519")
+        chaos.arm("ed25519.fetch", "timeout", count=1)
+        ok, mask = EK.verify_batch(pubs, msgs, sigs)
+        assert ok and all(mask)
+        assert m.fallback_verifies.value("ed25519") == fb0 + len(sigs)
+        assert m.device_failures.value("device", "timeout") >= 1
+
+    def test_sr25519_dispatch_death_falls_back(self):
+        from cometbft_tpu.crypto import sr25519 as sr
+
+        privs = [sr.gen_priv_key() for _ in range(3)]
+        pubs = [p.pub_key().bytes_() for p in privs]
+        msgs = [b"sr-%d" % i for i in range(3)]
+        sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+        m = _metrics()
+        fb0 = m.fallback_verifies.value("sr25519")
+        chaos.arm("sr25519.dispatch", "permanent")
+        D.configure(failure_threshold=1)
+        from cometbft_tpu.ops import sr25519_kernel as SK
+
+        ok, mask = SK.verify_batch(pubs, msgs, sigs)
+        assert ok and all(mask)
+        assert m.fallback_verifies.value("sr25519") == fb0 + 3
+
+    def test_mixed_resolve_failure_degrades_whole_window(self):
+        pubs, msgs, sigs = _batch(3)
+        m = _metrics()
+        fb0 = m.fallback_verifies.value("ed25519")
+        chaos.arm("mixed.resolve", "transient", count=1)
+        thunks = [EK.verify_batch_async(pubs, msgs, sigs),
+                  EK.verify_batch_async(pubs, msgs, [sigs[0]] + sigs[1:])]
+        masks = EK.resolve_batches(thunks)
+        assert all(mk.all() for mk in masks)
+        assert m.fallback_verifies.value("ed25519") == fb0 + 6
+
+    def test_bad_signature_still_pinpointed_on_cpu_ladder(self):
+        pubs, msgs, sigs = _batch()
+        sigs[2] = sigs[2][:-1] + bytes([sigs[2][-1] ^ 0xFF])
+        chaos.arm("ed25519.dispatch", "permanent")
+        D.configure(failure_threshold=1)
+        ok, mask = EK.verify_batch(pubs, msgs, sigs)
+        assert not ok
+        assert mask == [True, True, False, True]
+
+    def test_health_snapshot_shape(self):
+        chaos.arm("ed25519.dispatch", "permanent")
+        D.configure(failure_threshold=1)
+        pubs, msgs, sigs = _batch(2)
+        EK.verify_batch(pubs, msgs, sigs)
+        snap = D.health_snapshot()
+        assert snap["active_backend"] in ("cpu", "tpu")
+        assert snap["supervisors"]["device"]["failures"] >= 1
+        assert snap["supervisors"]["device"]["breaker"]["state"] == D.OPEN
+        assert "reprobe_in_seconds" in snap["supervisors"]["device"]["breaker"]
+        assert snap["chaos"]["ed25519.dispatch"]["kind"] == "permanent"
+
+
+# ------------------------------------------------------------- config knobs
+
+
+class TestConfigKnobs:
+    def test_crypto_config_validates_chaos_spec(self):
+        from cometbft_tpu.config.config import CryptoConfig
+
+        cfg = CryptoConfig(chaos="ed25519.dispatch=transient:3")
+        cfg.validate_basic()
+        with pytest.raises(ValueError):
+            CryptoConfig(chaos="bogus.site=transient").validate_basic()
+        with pytest.raises(ValueError):
+            CryptoConfig(chaos="ed25519.dispatch=meteor").validate_basic()
+        with pytest.raises(ValueError, match="count"):
+            CryptoConfig(chaos="ed25519.dispatch=transient:x").validate_basic()
+        with pytest.raises(ValueError):
+            CryptoConfig(breaker_failure_threshold=0).validate_basic()
+        with pytest.raises(ValueError):
+            CryptoConfig(watchdog_timeout=0.0).validate_basic()
+
+    def test_configure_applies_knobs_and_chaos(self):
+        from cometbft_tpu.config.config import CryptoConfig
+
+        crypto_batch.configure(CryptoConfig(
+            backend="cpu", retry_max_attempts=7, breaker_failure_threshold=9,
+            chaos="pallas.trace=permanent:1"))
+        sup = D.supervisor("device")
+        assert sup.retry_attempts == 7
+        assert sup.breaker.failure_threshold == 9
+        assert chaos.armed("pallas.trace") == "permanent"
+
+    def test_config_toml_roundtrip_keeps_supervision_fields(self, tmp_path):
+        from cometbft_tpu.config import Config
+
+        cfg = Config(home=str(tmp_path))
+        cfg.crypto.breaker_cooldown = 12.5
+        cfg.crypto.chaos = "ed25519.fetch=timeout:2"
+        cfg.save()
+        loaded = Config.load(str(tmp_path))
+        assert loaded.crypto.breaker_cooldown == 12.5
+        assert loaded.crypto.chaos == "ed25519.fetch=timeout:2"
+
+
+# --------------------------------------------- consensus + blocksync seams
+
+
+def _arm_device_death():
+    chaos.arm("ed25519.dispatch", "permanent")
+    chaos.arm("sr25519.dispatch", "permanent")
+    chaos.arm("pallas.trace", "permanent")
+
+
+def _warm_device_kernels():
+    """One tiny healthy batch so the bucket-8 kernels are compiled before a
+    net starts — a cold compile inside the first vote flush would eat the
+    liveness timeouts these tests assert on."""
+    pubs, msgs, sigs = _batch(2)
+    ok, _ = EK.verify_batch(pubs, msgs, sigs)
+    assert ok
+
+
+class TestConsensusUnderChaos:
+    def test_four_validator_net_commits_through_device_death(self):
+        """Acceptance: chaos kills the device permanently mid-run; a
+        4-validator in-proc net keeps committing heights on the CPU ladder
+        with zero failed heights — asserted via backend-health metrics."""
+        from net_harness import make_net
+        from cometbft_tpu.consensus.config import (
+            test_consensus_config as make_test_config)
+
+        crypto_batch.set_backend("tpu")
+        D.configure(failure_threshold=1)
+        _warm_device_kernels()
+        m = _metrics()
+        fb0 = m.fallback_verifies.value("ed25519")
+
+        async def main():
+            cfg = make_test_config()
+            cfg.batch_vote_verification = True
+            net = await make_net(4, config=cfg)
+            await net.start()
+            try:
+                await net.wait_for_height(2, timeout=90.0)
+                _arm_device_death()  # the device dies mid-run
+                await net.wait_for_height(6, timeout=90.0)
+            finally:
+                await net.stop()
+            return net
+
+        net = asyncio.run(main())
+        for node in net.nodes:
+            assert node.block_store.height() >= 6
+        h6 = {n.block_store.load_block(6).hash() for n in net.nodes}
+        assert len(h6) == 1  # zero failed/forked heights
+        # the commits after the kill ran on the CPU ladder
+        assert m.fallback_verifies.value("ed25519") > fb0
+        assert D.supervisor("device").breaker.state == D.OPEN
+        assert crypto_batch.resolve_backend() == "cpu"
+
+    def test_four_validator_net_reclaims_device_after_flap(self):
+        """Acceptance: a transient-fault schedule; the breaker re-closes
+        and the final verify batches run on the TPU path again."""
+        from net_harness import make_net
+        from cometbft_tpu.consensus.config import (
+            test_consensus_config as make_test_config)
+
+        crypto_batch.set_backend("tpu")
+        D.configure(failure_threshold=2, retry_attempts=0, cooldown=0.2)
+        _warm_device_kernels()
+        m = _metrics()
+        db_at_open = [None]
+
+        async def main():
+            cfg = make_test_config()
+            cfg.batch_vote_verification = True
+            net = await make_net(4, config=cfg)
+            await net.start()
+            try:
+                await net.wait_for_height(2, timeout=90.0)
+                # flap: exactly enough transient failures to open the
+                # breaker, then the device heals (finite count)
+                chaos.arm("ed25519.dispatch", "transient", count=2)
+
+                async def wait_open():
+                    while D.supervisor("device").breaker.state != D.OPEN:
+                        await asyncio.sleep(0.01)
+
+                await asyncio.wait_for(wait_open(), 30)
+                db_at_open[0] = m.device_batches.value("ed25519")
+                await net.wait_for_height(10, timeout=90.0)
+            finally:
+                await net.stop()
+            return net
+
+        net = asyncio.run(main())
+        for node in net.nodes:
+            assert node.block_store.height() >= 10
+        # the breaker re-closed and the device served batches again after
+        # the half-open probe succeeded
+        assert D.supervisor("device").breaker.state == D.CLOSED
+        assert m.breaker_state.value("device") == 0
+        assert m.breaker_transitions.value("device", "open") >= 1
+        assert m.breaker_transitions.value("device", "closed") >= 1
+        assert m.device_batches.value("ed25519") > db_at_open[0]
+        assert crypto_batch.resolve_backend() == "tpu"
+
+
+class TestBlocksyncUnderChaos:
+    def test_blocksync_catchup_with_dead_device(self):
+        """Acceptance: blocksync catch-up commits every height on the CPU
+        ladder with the device fully dead (windowed verify + vote-set
+        flush seams must not raise, stall, or skip heights)."""
+        from test_blocksync import build_chain
+        from cometbft_tpu.abci import types as abci
+        from cometbft_tpu.abci.kvstore import KVStoreApplication
+        from cometbft_tpu.blocksync import BlocksyncReactor
+        from cometbft_tpu.mempool.mempool import CListMempool, MempoolConfig
+        from cometbft_tpu.proxy import AppConns, local_client_creator
+        from cometbft_tpu.state import BlockExecutor, State, StateStore
+        from cometbft_tpu.store import BlockStore, MemDB
+
+        crypto_batch.set_backend("tpu")
+        D.configure(failure_threshold=1)
+        _arm_device_death()
+        m = _metrics()
+        fb0 = m.fallback_verifies.value("ed25519")
+
+        async def main():
+            n_blocks = 12
+            gdoc, _src_state, _sst, src_bstore = await build_chain(n_blocks)
+            app = KVStoreApplication()
+            conns = AppConns(local_client_creator(app))
+            await conns.start()
+            await conns.consensus.init_chain(
+                abci.RequestInitChain(chain_id=gdoc.chain_id))
+            sstore = StateStore(MemDB())
+            state = State.from_genesis(gdoc)
+            sstore.bootstrap(state)
+            bstore = BlockStore(MemDB())
+            execu = BlockExecutor(
+                sstore, conns.consensus, CListMempool(MempoolConfig(), conns.mempool))
+            bcr = BlocksyncReactor(execu, bstore, active=True, window=4)
+            bcr.set_state(state)
+            await bcr._start_sync()
+
+            # feed the pool straight from the source store (no TCP: the
+            # seam under test is the windowed verify, not the transport)
+            async def send(height, peer_id):
+                bcr.pool.add_block(
+                    peer_id, src_bstore.load_block(height), None, 1)
+
+            bcr.pool._send_request = send
+            bcr.pool.set_peer_range("src", 1, n_blocks)
+
+            synced_to = n_blocks - 1
+
+            async def wait_caught():
+                while bstore.height() < synced_to:
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(wait_caught(), 60)
+            await bcr.on_stop()
+            await conns.stop()
+            return bstore, src_bstore, synced_to
+
+        bstore, src_bstore, synced_to = asyncio.run(main())
+        for h in range(1, synced_to + 1):  # zero failed heights
+            assert bstore.load_block(h).hash() == src_bstore.load_block(h).hash()
+        # the first staged window tried the device and fell onto the host
+        # oracle; every later window was staged straight onto the CPU rung
+        # because the open breaker flipped resolve_backend()
+        assert m.fallback_verifies.value("ed25519") >= fb0 + 4
+        assert D.supervisor("device").breaker.state == D.OPEN
+        assert crypto_batch.resolve_backend() == "cpu"

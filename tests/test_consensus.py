@@ -83,6 +83,14 @@ def test_single_validator_chain_produces_blocks(tmp_path):
         await cs.start()
         try:
             await wait_for_height(block_store, 3)
+            # the block store leads the app by one while an apply_block is
+            # in flight, and stop() may freeze it there (the crash-window
+            # the recovery tests exercise) — wait for the app's Commit too
+            async def app_caught_up():
+                while app.height < 3:
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(app_caught_up(), 20)
         finally:
             await cs.stop()
             await conns.stop()

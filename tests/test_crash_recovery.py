@@ -40,10 +40,12 @@ def _prep_home(tmp_path, chain_id: str = "crash-chain", moniker: str = "c0",
     return home
 
 
-def _run_until_crash(home: str, fail_index: int) -> None:
+def _run_until_crash(home: str, fail_index: int, chaos_spec: str = "") -> None:
     env = dict(os.environ)
     env["FAIL_TEST_INDEX"] = str(fail_index)
     env["JAX_PLATFORMS"] = "cpu"
+    if chaos_spec:
+        env["CBFT_CHAOS"] = chaos_spec
     proc = subprocess.run(
         [sys.executable, "-m", "cometbft_tpu", "--home", home, "start",
          "--log_level", "error"],
@@ -106,6 +108,79 @@ def _loaded_config(home: str):
     cfg.rpc.laddr = ""
     cfg.p2p.laddr = "tcp://127.0.0.1:0"
     return cfg
+
+
+def test_crash_window_with_device_mid_degradation(tmp_path):
+    """Crash-point x device-fault interaction: the fail-point 2 crash
+    window (EndHeight fsynced, ApplyBlock lost) is exercised with the
+    crypto backend mid-degradation — the crashing node runs with a chaos
+    schedule that kills its device dispatch paths, and the restarted node
+    keeps the same dead device. WAL replay must re-verify and re-commit on
+    whichever backend is healthy at restart (here: the CPU ladder)."""
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.libs import chaos
+    from cometbft_tpu.libs import metrics as cmtmetrics
+    from cometbft_tpu.ops import dispatch as D
+    from cometbft_tpu.ops import ed25519_kernel as EK
+
+    home = _prep_home(tmp_path, chain_id="chaos-crash")
+    dead = ("ed25519.dispatch=permanent,sr25519.dispatch=permanent,"
+            "pallas.trace=permanent")
+    _run_until_crash(home, 2, chaos_spec=dead)
+
+    chaos.reset()
+    D.reset_supervision()
+    chaos.arm_spec(dead)  # the device is still dead at restart
+    try:
+        async def recover():
+            node = Node(_loaded_config(home))
+            crash_h = node.block_store.height()
+            await node.start()
+            try:
+                st0 = node.state_store.load()
+                target = max(crash_h, 1) + 2
+
+                async def poll():
+                    while (node.state_store.load() or st0).last_block_height < target:
+                        await asyncio.sleep(0.02)
+
+                await asyncio.wait_for(poll(), 30)
+            finally:
+                await node.stop()
+            return node, crash_h
+
+        node, crash_h = asyncio.run(recover())
+        st = node.state_store.load()
+        assert st.last_block_height >= max(crash_h, 1) + 2
+        for h in range(2, node.block_store.height() + 1):
+            blk = node.block_store.load_block(h)
+            meta = node.block_store.load_block_meta(h - 1)
+            assert blk.header.last_block_id.hash == meta.block_id.hash
+
+        # with the device still dead, a batch re-verification of a stored
+        # commit's signature runs on the CPU rung — the backend WAL replay
+        # would use if the engine asked for the device
+        m = cmtmetrics.crypto_metrics()
+        fb0 = m.fallback_verifies.value("ed25519")
+        crypto_batch.set_backend("tpu")
+        D.configure(failure_threshold=1)
+        commit = (node.block_store.load_seen_commit(2)
+                  or node.block_store.load_block_commit(2))
+        blk3 = node.block_store.load_block(3)
+        st2 = node.state_store.load_validators(2)
+        val = st2.validators[0]
+        cs = commit.signatures[0]
+        ok, mask = EK.verify_batch(
+            [val.pub_key.bytes_()],
+            [commit.vote_sign_bytes(blk3.header.chain_id, 0)],
+            [cs.signature])
+        assert ok and all(mask)
+        assert m.fallback_verifies.value("ed25519") == fb0 + 1
+        assert D.supervisor("device").breaker.state == D.OPEN
+    finally:
+        chaos.reset()
+        D.reset_supervision()
+        crypto_batch.set_backend("cpu")
 
 
 def test_restart_with_nonunit_initial_height(tmp_path):

@@ -58,6 +58,28 @@ def test_validation_rejects_bad_manifests():
         m.validate()
 
 
+def test_device_fault_perturbations_are_legal_and_roundtrip():
+    """device-kill / device-flap (runner.py: restart with a CBFT_CHAOS
+    schedule armed) are first-class matrix cells."""
+    m = Manifest(nodes={
+        "a": NodeManifest(perturb=["device-kill"]),
+        "b": NodeManifest(perturb=["device-flap"]),
+        "c": NodeManifest(),
+        "d": NodeManifest(),
+    })
+    m.validate()
+    assert Manifest.from_toml(m.to_toml()) == m
+    from cometbft_tpu.e2e.runner import DEVICE_FLAP_CHAOS, DEVICE_KILL_CHAOS
+    from cometbft_tpu.libs import chaos
+
+    # the runner's schedules must parse against the live chaos registry
+    for spec in (DEVICE_KILL_CHAOS, DEVICE_FLAP_CHAOS):
+        for part in spec.split(","):
+            site, _, fault = part.partition("=")
+            assert site in chaos.SITES, site
+            assert fault.partition(":")[0] in chaos.KINDS
+
+
 def test_runner_setup_materializes_manifest(tmp_path):
     from cometbft_tpu.config import Config
     from cometbft_tpu.e2e.runner import setup
@@ -107,5 +129,7 @@ def test_killed_nodes_get_persistent_storage():
     memdb+pause stays a legal matrix cell)."""
     for m in generate_manifests(42, 60):
         for nd in m.nodes.values():
-            if set(nd.perturb) & {"kill", "restart"}:
+            # device-kill/device-flap restart the OS process too
+            if set(nd.perturb) & {"kill", "restart",
+                                  "device-kill", "device-flap"}:
                 assert nd.database == "sqlite", m.name

@@ -111,11 +111,12 @@ class TestDialectGuards:
             assert "INSERT OR IGNORE" not in up, name  # sqlite-only
             assert "OR REPLACE" not in up, name
             assert "AUTOINCREMENT" not in up, name
-        # inserts rely on RETURNING (portable), never cursor.lastrowid
-        import inspect
-
-        src = inspect.getsource(sink)
-        assert ".lastrowid" not in src
+        # the portable statement set relies on RETURNING; cursor.lastrowid
+        # appears only in the explicitly gated sqlite<3.35 compat branch
+        # (never on the postgres dialect path)
+        for name in ("upsert_block", "insert_event", "insert_tx"):
+            assert "RETURNING rowid" in pg[name], name
+            assert "RETURNING" not in sink._STMTS_NO_RETURNING[name], name
 
     def test_unknown_dialect_rejected(self):
         import pytest

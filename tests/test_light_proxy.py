@@ -132,6 +132,20 @@ def test_light_proxy_serves_verified_data(tmp_path):
     asyncio.run(main())
 
 
+def test_upstream_ws_refuses_tls_primary():
+    """_UpstreamWS speaks plaintext only: an https:// primary must fail
+    loudly instead of silently opening a clear socket on port 80."""
+    import pytest
+
+    from cometbft_tpu.light.proxy import _UpstreamWS
+
+    with pytest.raises(ValueError, match="TLS"):
+        _UpstreamWS("https://rpc.example.com:26657")
+    # plaintext primaries still construct
+    ws = _UpstreamWS("http://127.0.0.1:26657")
+    assert ws.host == "127.0.0.1" and ws.port == 26657
+
+
 def test_light_proxy_rejects_forged_primary():
     """The primary serves a forked chain; the witness is honest. A query
     through the proxy triggers the divergence check: the proxy must surface

@@ -190,6 +190,7 @@ def test_node_boot_commit_rpc_restart(tmp_path):
 def test_pprof_endpoint(tmp_path):
     """rpc.pprof_laddr serves live CPU profile, heap, and stacks
     (node/node.go:868-882 analog)."""
+    import urllib.error
     import urllib.request
 
     from cometbft_tpu.node import init_files, Node
@@ -223,6 +224,19 @@ def test_pprof_endpoint(tmp_path):
             assert b"tracemalloc started" in first
             second = await asyncio.to_thread(get, "/debug/pprof/heap")
             assert b"heap:" in second
+
+            # hostile seconds params: non-finite is a 400, negatives clamp
+            # to 0 (never reach asyncio.sleep)
+            for bad in ("nan", "inf", "-inf"):
+                try:
+                    await asyncio.to_thread(
+                        get, f"/debug/pprof/profile?seconds={bad}")
+                    raise AssertionError(f"seconds={bad} accepted")
+                except urllib.error.HTTPError as e:
+                    assert e.code == 400
+            neg = await asyncio.to_thread(
+                get, "/debug/pprof/profile?seconds=-3&format=text")
+            assert b"cumulative" in neg
         finally:
             await node.stop()
 

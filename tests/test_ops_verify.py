@@ -113,8 +113,8 @@ def test_injected_mask_echo_corruption_detected():
     items = _sign_n(5)
     pubs, msgs, sigs = map(list, zip(*items))
     thunk = K.verify_batch_async(pubs, msgs, sigs)
-    payload, n, pre_ok, ok_a, rows, info, _redo = thunk.device_parts()
-    payload = np.asarray(payload).copy()
+    acquire, n, pre_ok, ok_a, rows, info, _redo = thunk.device_parts()
+    payload = np.asarray(acquire()).copy()
     payload[2] = not payload[2]  # corrupt one mask lane; echo now disagrees
     mask = K.decode_payload(payload, n, pre_ok, ok_a, rows, info, redo=None)
     assert mask.tolist() == [True] * 5  # host oracle restored the truth
@@ -129,8 +129,8 @@ def test_injected_staging_corruption_retries_then_recovers():
     items = _sign_n(4)
     pubs, msgs, sigs = map(list, zip(*items))
     thunk = K.verify_batch_async(pubs, msgs, sigs)
-    payload, n, pre_ok, ok_a, rows, info, redo = thunk.device_parts()
-    bad = np.asarray(payload).copy()
+    acquire, n, pre_ok, ok_a, rows, info, redo = thunk.device_parts()
+    bad = np.asarray(acquire()).copy()
     bad[-1] = False  # device says the staged bytes didn't checksum
     calls = {"n": 0}
 
