@@ -160,19 +160,52 @@ class P2PConfig:
     addr_book_strict: bool = True
     handshake_timeout: float = 20.0
     dial_timeout: float = 3.0
-    # fault injection for soak testing (config.go:739-740 TestFuzz; knobs
-    # flattened instead of a subtable)
+    # fault injection for soak testing (config.go:739-740 TestFuzz +
+    # FuzzConnConfig; knobs flattened instead of a subtable). Mode "drop"
+    # mirrors the reference FuzzModeDrop (drops + conn kills + delays);
+    # "delay" is latency-only (FuzzModeDelay)
     test_fuzz: bool = False
+    test_fuzz_mode: str = "drop"  # "drop" | "delay"
     test_fuzz_prob_drop_rw: float = 0.01
     test_fuzz_prob_drop_conn: float = 0.003
     test_fuzz_prob_sleep: float = 0.01
     test_fuzz_max_delay: float = 0.05
+    # deterministic-ish network-fault schedule armed at boot
+    # (p2p/netchaos.py syntax: latency/jitter/drop/dup/reorder/bandwidth/
+    # partition); test/e2e only — CBFT_NET_CHAOS overlays this
+    chaos: str = ""
+    # misbehavior scoring / ban ledger (p2p/switch.py PeerScorer):
+    # misbehavior score that triggers a ban, the first-offense ban window,
+    # its cap as repeat offenses double it, and the score decay half-life
+    ban_score_threshold: float = 3.0
+    ban_duration: float = 60.0
+    ban_max_duration: float = 3600.0
+    ban_score_half_life: float = 120.0
 
     def validate_basic(self) -> None:
         if self.max_num_inbound_peers < 0 or self.max_num_outbound_peers < 0:
             raise ValueError("peer limits cannot be negative")
         if self.send_rate < 0 or self.recv_rate < 0:
             raise ValueError("rates cannot be negative")
+        if self.test_fuzz_mode not in ("drop", "delay"):
+            raise ValueError(f"unknown test_fuzz_mode {self.test_fuzz_mode!r}")
+        for name in ("test_fuzz_prob_drop_rw", "test_fuzz_prob_drop_conn",
+                     "test_fuzz_prob_sleep"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name} must be a probability, got {v}")
+        if self.test_fuzz_max_delay < 0:
+            raise ValueError("test_fuzz_max_delay cannot be negative")
+        if self.ban_score_threshold <= 0:
+            raise ValueError("ban_score_threshold must be positive")
+        if self.ban_duration < 0 or self.ban_max_duration < 0:
+            raise ValueError("ban durations cannot be negative")
+        if self.ban_score_half_life <= 0:
+            raise ValueError("ban_score_half_life must be positive")
+        if self.chaos:
+            from cometbft_tpu.p2p import netchaos as _netchaos
+
+            _netchaos.parse_spec(self.chaos)  # raises ValueError on any part
 
     def persistent_peer_list(self) -> list[str]:
         return [p.strip() for p in self.persistent_peers.split(",") if p.strip()]

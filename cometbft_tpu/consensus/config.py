@@ -31,6 +31,10 @@ class ConsensusConfig:
     # flush a staged batch once it reaches this many votes (flushes also
     # happen at speculative quorum boundaries and on timeouts)
     vote_batch_flush_size: int = 128
+    # TEST/E2E ONLY: run this validator adversarially (consensus/byzantine.py
+    # behaviors: equivocation | amnesia | silence | flood). The node swaps
+    # its privval for an unguarded signer — never set this in production.
+    byzantine: str = ""
 
     def propose_timeout(self, round_: int) -> float:
         return self.timeout_propose + self.timeout_propose_delta * round_

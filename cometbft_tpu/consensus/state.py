@@ -45,6 +45,7 @@ from cometbft_tpu.types.proposal import Proposal
 from cometbft_tpu.types.vote import Vote
 from cometbft_tpu.types.vote_set import (
     ErrVoteConflictingVotes,
+    ErrVoteInvalidSignature,
     VoteSet,
     commit_to_vote_set,
     extended_commit_to_vote_set,
@@ -52,6 +53,12 @@ from cometbft_tpu.types.vote_set import (
 from cometbft_tpu.utils import cmttime
 
 BLOCK_PART_SIZE = 65536
+
+
+def _vote_key(v: Vote) -> tuple:
+    """Identity of a staged vote for batched-path peer attribution."""
+    return (v.height, v.round_, int(v.type_), v.validator_index,
+            v.block_id.key())
 
 
 class _TaggedQueue:
@@ -116,6 +123,16 @@ class ConsensusState(BaseService):
         self.decide_proposal: Callable = self._default_decide_proposal
         self.do_prevote: Callable = self._default_do_prevote
         self.set_proposal_fn: Callable = self._default_set_proposal
+
+        # misbehavior tap: (peer_id, reason) -> None, wired to
+        # Switch.report_misbehavior by the node. A vote with a forged
+        # signature is unforgeable proof the SENDER misbehaves (honest
+        # peers only relay verified votes), so consensus reports it here
+        # instead of silently dropping it.
+        self.misbehavior_hook: Optional[Callable] = None
+        # batched-path attribution: staged vote -> staging peer, so a
+        # FLUSH_INVALID result can still be pinned on its sender
+        self._staged_peer: dict[tuple, str] = {}
 
         self.sync_to_state(state)
 
@@ -189,6 +206,7 @@ class ConsensusState(BaseService):
             commit_round=-1,
         )
         self.state = state
+        self._staged_peer.clear()  # stale attribution dies with the height
         if self.event_switch is not None:
             # announce the height transition (reference updateToState ->
             # newStep -> EventNewRoundStep) so peers learn we moved on
@@ -764,9 +782,22 @@ class ConsensusState(BaseService):
                 raise
             self._conflicts_to_evidence(getattr(e, "conflicts", None) or [e])
             return False
+        except ErrVoteInvalidSignature as e:
+            self._report_misbehavior(peer_id, "invalid-vote-signature")
+            self.logger.info("rejected vote with invalid signature",
+                             err=str(e), peer=peer_id)
+            return False
         except Exception as e:  # noqa: BLE001 - bad votes are logged, not fatal
             self.logger.info("failed attempting to add vote", err=str(e))
             return False
+
+    def _report_misbehavior(self, peer_id: str, reason: str) -> None:
+        if not peer_id or self.misbehavior_hook is None:
+            return
+        try:
+            self.misbehavior_hook(peer_id, reason)
+        except Exception as e:  # noqa: BLE001 - scoring must not kill consensus
+            self.logger.error("misbehavior hook failed", err=str(e))
 
     def _conflicts_to_evidence(self, conflicts) -> None:
         """Equivocations -> the pool's consensus buffer (state.go:2117-2146
@@ -865,6 +896,7 @@ class ConsensusState(BaseService):
         staged = rs.votes.add_pending(vote, peer_id)
         if not staged:
             return False
+        self._staged_peer[_vote_key(vote)] = peer_id
         vs = (
             rs.votes.prevotes(vote.round_)
             if vote.type_ == SignedMsgType.PREVOTE
@@ -899,10 +931,13 @@ class ConsensusState(BaseService):
         from cometbft_tpu.types import vote_set as VS
 
         for v, status in results:
+            staging_peer = self._staged_peer.pop(_vote_key(v), "")
             if status == VS.FLUSH_ADDED:
                 added_any = True
                 if self.event_switch is not None:
                     self.event_switch.fire("Vote", v)
+            elif status == VS.FLUSH_INVALID:
+                self._report_misbehavior(staging_peer, "invalid-vote-signature")
         if added_any:
             if vs.signed_msg_type == SignedMsgType.PREVOTE:
                 await self._on_prevote_added(vs.round_)

@@ -24,8 +24,16 @@ VOTE_EXT_HEIGHT_OFFSETS = (0, 2)  # 0 = disabled
 # perturbation matrix, tests/test_e2e_perturb.py, covers it). device-kill /
 # device-flap restart a node with a CBFT_CHAOS schedule armed (runner.py):
 # the accelerator dies or flaps and the verify ladder must keep committing.
+# partition splits the net 2-2 at runtime (unsafe_net_chaos route);
+# byzantine/flood restart the node adversarially (consensus/byzantine.py)
+# and assert detection via evidence_committed / peer_bans metrics.
 PERTURBATIONS = {"kill": 0.1, "pause": 0.1, "restart": 0.1,
-                 "device-kill": 0.05, "device-flap": 0.05}
+                 "device-kill": 0.05, "device-flap": 0.05,
+                 "partition": 0.05, "byzantine": 0.05, "flood": 0.05}
+# perturbations that kill + respawn the OS process (a memdb node would
+# lose its stores while its out-of-process app keeps state)
+RESPAWN_PERTURBATIONS = {"kill", "restart", "device-kill", "device-flap",
+                         "byzantine", "flood"}
 
 
 def generate_manifest(rng: random.Random, index: int) -> Manifest:
@@ -51,6 +59,11 @@ def generate_manifest(rng: random.Random, index: int) -> Manifest:
             for p, prob in PERTURBATIONS.items():
                 if rng.random() < prob:
                     node.perturb.append(p)
+            # occasional always-on stream fuzzing rides alongside
+            # (reference generator testFuzz); latency-only so a fuzzed
+            # node never costs the quorum its liveness margin
+            if rng.random() < 0.05:
+                node.fuzz = "delay"
         m.nodes[f"node{i}"] = node
     # at most one perturbed node per net: +2/3 of 4 must stay live while a
     # perturbation is in flight
@@ -64,9 +77,7 @@ def generate_manifest(rng: random.Random, index: int) -> Manifest:
     # pause never loses the process, so memdb+pause stays in the matrix.
     if perturbed:
         nd = m.nodes[perturbed[0]]
-        # device-kill/device-flap also kill + respawn the OS process
-        if nd.database == "memdb" and set(nd.perturb) & {
-                "kill", "restart", "device-kill", "device-flap"}:
+        if nd.database == "memdb" and set(nd.perturb) & RESPAWN_PERTURBATIONS:
             nd.database = "sqlite"
     m.validate()
     return m

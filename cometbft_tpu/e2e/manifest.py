@@ -20,21 +20,32 @@ class NodeManifest:
     privval_protocol: str = "file"  # file (remote-signer nets use tests')
     persist_interval: int = 1
     retain_blocks: int = 0
-    # process faults: kill | pause | restart (perturb.go:44-100) and
+    # p2p stream fuzzing (p2p/fuzz.py FuzzConnConfig via config test_fuzz):
+    # "" disabled, else "drop" | "delay"
+    fuzz: str = ""
+    # process faults: kill | pause | restart (perturb.go:44-100);
     # device faults: device-kill (restart with the accelerator permanently
     # dead via a CBFT_CHAOS schedule — the node must keep committing on
     # the CPU ladder), device-flap (restart with a transient-fault
-    # schedule — the supervisor must retry/re-probe back onto the device)
+    # schedule — the supervisor must retry/re-probe back onto the device);
+    # network/byzantine faults: partition (runtime 2-2 split through the
+    # unsafe_net_chaos route — no progress while split, heal resumes),
+    # byzantine (restart equivocating — honest nodes must commit
+    # DuplicateVoteEvidence), flood (restart invalid-signature flooding —
+    # honest nodes must ban the peer)
     perturb: list[str] = field(default_factory=list)
 
     PERTURBATIONS = ("kill", "pause", "restart", "disconnect",
-                     "device-kill", "device-flap")
+                     "device-kill", "device-flap",
+                     "partition", "byzantine", "flood")
 
     def validate(self) -> None:
         if self.database not in ("sqlite", "memdb"):
             raise ValueError(f"unknown database {self.database!r}")
         if self.abci_protocol not in ("builtin", "tcp", "unix", "grpc"):
             raise ValueError(f"unknown abci protocol {self.abci_protocol!r}")
+        if self.fuzz not in ("", "drop", "delay"):
+            raise ValueError(f"unknown fuzz mode {self.fuzz!r}")
         for p in self.perturb:
             if p not in self.PERTURBATIONS:
                 raise ValueError(f"unknown perturbation {p!r}")
@@ -86,6 +97,7 @@ class Manifest:
             out.append(f"privval_protocol = {q(n.privval_protocol)}")
             out.append(f"persist_interval = {n.persist_interval}")
             out.append(f"retain_blocks = {n.retain_blocks}")
+            out.append(f"fuzz = {q(n.fuzz)}")
             out.append(
                 "perturb = [" + ", ".join(q(p) for p in n.perturb) + "]")
         return "\n".join(out) + "\n"
@@ -109,6 +121,7 @@ class Manifest:
                 privval_protocol=nd.get("privval_protocol", "file"),
                 persist_interval=int(nd.get("persist_interval", 1)),
                 retain_blocks=int(nd.get("retain_blocks", 0)),
+                fuzz=str(nd.get("fuzz", "")),
                 perturb=list(nd.get("perturb", [])),
             )
         m.validate()

@@ -15,6 +15,7 @@ import signal
 import subprocess
 import sys
 import time
+import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
 
@@ -95,6 +96,15 @@ def setup(manifest: Manifest, out_dir: str, base_port: int) -> _Net:
             a for j, a in enumerate(peer_addrs) if j != i)
         cfg.crypto.backend = "cpu"  # N processes cannot share one chip
         cfg.consensus.timeout_commit = 0.1
+        # perturbations drive the runtime control routes (partition arm/
+        # heal); test-scale ban windows so a flood perturbation's bans
+        # decay before the final catch-up deadline
+        cfg.rpc.unsafe = True
+        cfg.p2p.ban_duration = 5.0
+        cfg.p2p.ban_max_duration = 30.0
+        if nm.fuzz:
+            cfg.p2p.test_fuzz = True
+            cfg.p2p.test_fuzz_mode = nm.fuzz
         if nm.abci_protocol == "builtin":
             cfg.base.proxy_app = "kvstore"
         elif nm.abci_protocol == "tcp":
@@ -141,6 +151,47 @@ def _arm_device_chaos(home: str, spec: str) -> None:
     # a dead device should sideline fast in a liveness test
     cfg.crypto.breaker_failure_threshold = 1
     cfg.save()
+
+
+def _arm_byzantine(home: str, behavior: str) -> None:
+    """Point the node's on-disk config at an adversarial consensus mode
+    (consensus/byzantine.py); empty behavior disarms."""
+    from cometbft_tpu.config import Config
+
+    cfg = Config.load(home)
+    cfg.consensus.byzantine = behavior
+    cfg.save()
+
+
+def _metrics_text(net: _Net, i: int, timeout=3.0) -> str:
+    url = f"http://127.0.0.1:{net.rpc_port(i)}/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode()
+    except Exception:  # noqa: BLE001 - node not up / metrics not ready
+        return ""
+
+
+def _metric_value(text: str, name: str) -> float:
+    """Sum every series of a metric in a Prometheus exposition."""
+    total, seen = 0.0, False
+    for line in text.splitlines():
+        if line.startswith(name) and (len(line) == len(name)
+                                      or line[len(name)] in " {"):
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+                seen = True
+            except (ValueError, IndexError):
+                continue
+    return total if seen else 0.0
+
+
+def _node_ids(net: _Net) -> list[str]:
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.p2p.key import NodeKey
+
+    return [NodeKey.load_or_gen(Config(home=h).node_key_path()).id()
+            for h in net.homes]
 
 
 def _spawn_app(addr: str):
@@ -254,6 +305,57 @@ def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
                     else:
                         time.sleep(2.0)
                     os.killpg(net.node_procs[i].pid, signal.SIGCONT)
+                elif p == "partition":
+                    # 2-2 split through the runtime control route: no side
+                    # has quorum, so NO progress until the heal — then the
+                    # heal must be observable on /metrics
+                    ids = _node_ids(net)
+                    side = {i, (i + 1) % n}
+                    spec = ("partition="
+                            + ".".join(ids[j] for j in sorted(side)) + "|"
+                            + ".".join(ids[j] for j in range(n)
+                                       if j not in side))
+                    log(f"[{manifest.name}] partition {sorted(side)} vs rest")
+                    arg = urllib.parse.quote(f'"{spec}"')
+                    for j in range(n):
+                        _rpc(net, j, f"unsafe_net_chaos?spec={arg}")
+                    time.sleep(2.0)  # in-flight commits land
+                    hp = max(_height(net, j) for j in range(n))
+                    time.sleep(6.0)
+                    hq = max(_height(net, j) for j in range(n))
+                    if hq > hp + 1:
+                        raise RunError(
+                            f"progress during a 2-2 partition: {hp} -> {hq}")
+                    for j in range(n):
+                        _rpc(net, j, "unsafe_net_chaos?heal=true")
+                    _wait(lambda: min(_height(net, j) for j in range(n))
+                          >= hq + 2, 150, "the net resuming after the heal")
+                    if not any(_metric_value(
+                            _metrics_text(net, j),
+                            "cometbft_p2p_partition_heal_seconds") > 0
+                            for j in range(n)):
+                        raise RunError("partition_heal_seconds not recorded")
+                elif p in ("byzantine", "flood"):
+                    # restart the node adversarially; the honest majority
+                    # must DETECT it: equivocation -> DuplicateVoteEvidence
+                    # committed (evidence_committed), invalid-signature
+                    # flooding -> the peer is banned (peer_bans)
+                    behavior = "equivocation" if p == "byzantine" else "flood"
+                    log(f"[{manifest.name}] {p} {name} ({behavior})")
+                    _kill(net.node_procs[i])
+                    _arm_byzantine(net.homes[i], behavior)
+                    net.node_procs[i] = _spawn_node(net.homes[i])
+                    metric = ("cometbft_evidence_committed"
+                              if p == "byzantine" else "cometbft_p2p_peer_bans")
+                    _wait(lambda: any(
+                        _metric_value(_metrics_text(net, j), metric) >= 1
+                        for j in others), 180,
+                        f"honest nodes recording {metric} >= 1")
+                    # reform the node so the final agreement checks run
+                    # against an honest net
+                    _kill(net.node_procs[i])
+                    _arm_byzantine(net.homes[i], "")
+                    net.node_procs[i] = _spawn_node(net.homes[i])
                 # the perturbed node must rejoin the live head (generous
                 # deadline: CI shares the host with whatever else runs,
                 # and a device perturbation pays cold kernel compiles)

@@ -12,6 +12,14 @@ fallback -> re-probe). Sites live on the device-dispatch seams:
   pallas.trace       inside the Pallas gate, before the fused-kernel call
   mixed.resolve      the coalesced multi-batch fetch (resolve_batches)
 
+plus the transport seams (the network plane's deterministic faults; the
+probabilistic link faults — latency/drop/dup/reorder/partitions — live in
+p2p/netchaos.py):
+
+  net.dial           p2p outbound TCP dial (transport.dial)
+  net.accept         p2p inbound connection intake (before upgrade)
+  net.handshake      the secret-connection + node-info upgrade
+
 Arming, via env (`CBFT_CHAOS`) or `arm()`/`arm_spec()`:
 
   CBFT_CHAOS="ed25519.dispatch=transient:3,pallas.trace=permanent"
@@ -42,6 +50,9 @@ SITES = (
     "sr25519.fetch",
     "pallas.trace",
     "mixed.resolve",
+    "net.dial",
+    "net.accept",
+    "net.handshake",
 )
 
 KINDS = ("timeout", "transient", "permanent", "corrupt")

@@ -233,6 +233,27 @@ class P2PMetrics:
             "p2p", "message_send_bytes_total", "Bytes sent", labels=("chID",))
         self.message_receive_bytes = reg.counter(
             "p2p", "message_receive_bytes_total", "Bytes received", labels=("chID",))
+        # misbehavior-scoring plane (p2p/switch.py PeerScorer): byzantine
+        # peers must lose their connection slot, not just their messages
+        self.peer_misbehavior = reg.counter(
+            "p2p", "peer_misbehavior",
+            "Misbehavior reports scored against peers", labels=("reason",))
+        self.peer_bans = reg.counter(
+            "p2p", "peer_bans",
+            "Peers banned after repeated misbehavior")
+
+
+class EvidenceMetrics:
+    """Evidence-pool observability (no dedicated reference struct; the
+    reference folds this into consensus metrics — split out here so the
+    byzantine-resilience tests can assert detection end-to-end)."""
+
+    def __init__(self, reg: Registry):
+        self.evidence_committed = reg.counter(
+            "evidence", "committed",
+            "Byzantine-behavior proofs committed into blocks")
+        self.evidence_pending = reg.gauge(
+            "evidence", "pending", "Verified evidence awaiting commitment")
 
 
 class StateMetrics:
@@ -302,6 +323,20 @@ def global_registry() -> Registry:
     return _global
 
 
+class NetChaosMetrics:
+    """Injected network-fault observability (p2p/netchaos.py). Process-
+    global like CryptoMetrics: the netchaos registry is one per process."""
+
+    def __init__(self, reg: Registry):
+        self.partition_heal_seconds = reg.gauge(
+            "p2p", "partition_heal_seconds",
+            "Seconds from partition heal to first traffic across a "
+            "formerly-cut link")
+        self.net_faults = reg.counter(
+            "p2p", "net_chaos_faults",
+            "Injected network faults by kind", labels=("kind",))
+
+
 _crypto: Optional[CryptoMetrics] = None
 _crypto_lock = threading.Lock()
 
@@ -317,3 +352,17 @@ def crypto_metrics() -> CryptoMetrics:
             if _crypto is None:
                 _crypto = CryptoMetrics(global_registry())
     return _crypto
+
+
+_netchaos: Optional[NetChaosMetrics] = None
+
+
+def netchaos_metrics() -> NetChaosMetrics:
+    """Process-global NetChaosMetrics on the global registry (same
+    double-checked init discipline as crypto_metrics)."""
+    global _netchaos
+    if _netchaos is None:
+        with _crypto_lock:
+            if _netchaos is None:
+                _netchaos = NetChaosMetrics(global_registry())
+    return _netchaos

@@ -114,6 +114,12 @@ class Node(BaseService):
         # (BASELINE: --crypto.backend flag; ops/dispatch.py supervisor)
         crypto_batch.configure(config.crypto)
 
+        # network-fault schedule (p2p/netchaos.py; CBFT_NET_CHAOS overlays)
+        if config.p2p.chaos:
+            from cometbft_tpu.p2p import netchaos
+
+            netchaos.arm_spec(config.p2p.chaos)
+
         # ---- genesis + identity (node.go:274-300)
         if genesis_doc is None:
             with open(config.genesis_path()) as f:
@@ -193,7 +199,9 @@ class Node(BaseService):
         self.consensus_metrics = cmtmetrics.ConsensusMetrics(self.metrics_registry)
         self.mempool_metrics = cmtmetrics.MempoolMetrics(self.metrics_registry)
         self.p2p_metrics = cmtmetrics.P2PMetrics(self.metrics_registry)
+        self.evidence_metrics = cmtmetrics.EvidenceMetrics(self.metrics_registry)
         self.mempool.metrics = self.mempool_metrics
+        self.evidence_pool.metrics = self.evidence_metrics
 
         # background pruning honoring app/companion retain heights
         # (node.go:263-524 createPruner; state/pruner.go)
@@ -304,6 +312,7 @@ class Node(BaseService):
             from cometbft_tpu.p2p.fuzz import FuzzConnConfig
 
             fuzz_cfg = FuzzConnConfig(
+                mode=config.p2p.test_fuzz_mode,
                 prob_drop_rw=config.p2p.test_fuzz_prob_drop_rw,
                 prob_drop_conn=config.p2p.test_fuzz_prob_drop_conn,
                 prob_sleep=config.p2p.test_fuzz_prob_sleep,
@@ -314,6 +323,8 @@ class Node(BaseService):
             logger=self.logger.with_fields(module="p2p"),
             fuzz_config=fuzz_cfg,
         )
+        from cometbft_tpu.p2p.switch import PeerScorer
+
         self.switch = Switch(
             self.transport,
             mconn_config=MConnConfig(
@@ -323,8 +334,17 @@ class Node(BaseService):
                 flush_throttle=config.p2p.flush_throttle_timeout,
             ),
             logger=self.logger.with_fields(module="p2p"),
+            scorer=PeerScorer(
+                ban_threshold=config.p2p.ban_score_threshold,
+                ban_base=config.p2p.ban_duration,
+                ban_max=config.p2p.ban_max_duration,
+                half_life=config.p2p.ban_score_half_life,
+            ),
         )
         self.switch.metrics = self.p2p_metrics
+        # consensus-detected offenses (forged vote signatures) feed the
+        # same ban ledger as transport-level errors
+        self.consensus_state.misbehavior_hook = self.switch.report_misbehavior
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
         self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
         self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
@@ -352,6 +372,24 @@ class Node(BaseService):
                 logger=self.logger.with_fields(module="pex"),
             )
             self.switch.add_reactor("PEX", self.pex_reactor)
+            # a switch ban also marks the address book so PEX neither
+            # offers nor dials the peer until the ban decays
+            self.switch.on_ban = self.addr_book.mark_bad
+
+        # TEST/E2E ONLY: adversarial validator mode (consensus/byzantine.py)
+        self._byzantine = None
+        if config.consensus.byzantine:
+            from cometbft_tpu.consensus.byzantine import (
+                make_byzantine,
+                switch_vote_sender,
+            )
+
+            self._byzantine = make_byzantine(
+                self.consensus_state, config.consensus.byzantine,
+                send=switch_vote_sender(self.switch),
+            )
+            self.logger.info("BYZANTINE MODE ARMED",
+                             behavior=config.consensus.byzantine)
 
         self.rpc_server = None  # attached on start when rpc.laddr set
         self.pprof_server = None
@@ -403,6 +441,8 @@ class Node(BaseService):
         addr = await self.transport.listen(_strip_tcp(self.config.p2p.laddr))
         self.node_info.listen_addr = addr
         await self.switch.start()
+        if self._byzantine is not None:
+            await self._byzantine.start()
         peers = self.config.p2p.persistent_peer_list()
         if peers:
             await self.switch.dial_peers_async(peers, persistent=True)
@@ -483,6 +523,8 @@ class Node(BaseService):
                 from cometbft_tpu.rpc.grpc_services import wait_closed
 
                 await wait_closed(srv, grace=0.5)
+        if self._byzantine is not None:
+            await self._byzantine.stop()
         await self.switch.stop()
         await self.proxy_app.stop()
         if self.pruner.is_running:

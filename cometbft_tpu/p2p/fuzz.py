@@ -29,13 +29,19 @@ from dataclasses import dataclass
 
 @dataclass
 class FuzzConnConfig:
-    """config.go FuzzConnConfig (FuzzModeDrop)."""
+    """config.go FuzzConnConfig. mode="drop" is the reference FuzzModeDrop
+    (drops + conn kills + delays); mode="delay" is FuzzModeDelay — latency
+    only, the soak profile that must NEVER cost liveness."""
 
+    mode: str = "drop"  # "drop" | "delay"
     prob_drop_rw: float = 0.01
     prob_drop_conn: float = 0.003
     prob_sleep: float = 0.01
     max_delay: float = 0.05  # seconds
     arm_after: float = 3.0   # handshake grace (transport.go:223 uses 10 s)
+
+    def drops_enabled(self) -> bool:
+        return self.mode != "delay"
 
 
 class _FuzzState:
@@ -69,11 +75,12 @@ class FuzzedWriter:
         if st.active():
             r = st.rng.random()
             cfg = st.cfg
-            if r <= cfg.prob_drop_rw:
-                return  # bytes vanish
-            if r < cfg.prob_drop_rw + cfg.prob_drop_conn:
-                st.kill()
-                return
+            if cfg.drops_enabled():
+                if r <= cfg.prob_drop_rw:
+                    return  # bytes vanish
+                if r < cfg.prob_drop_rw + cfg.prob_drop_conn:
+                    st.kill()
+                    return
             if r < cfg.prob_drop_rw + cfg.prob_drop_conn + cfg.prob_sleep:
                 # write() is sync; the delay lands in the next drain()
                 self._pending_sleep = st.rng.uniform(0, cfg.max_delay)
@@ -100,7 +107,7 @@ class FuzzedReader:
             return
         r = st.rng.random()
         cfg = st.cfg
-        if r < cfg.prob_drop_conn:
+        if cfg.drops_enabled() and r < cfg.prob_drop_conn:
             st.kill()
         elif r < cfg.prob_drop_conn + cfg.prob_sleep:
             await asyncio.sleep(st.rng.uniform(0, cfg.max_delay))

@@ -1,0 +1,433 @@
+"""Network-fault injection registry (the transport analog of libs/chaos.py).
+
+chaos.py breaks the DEVICE at named call sites; netchaos breaks the WIRE.
+One process-global registry (the network plane, like the device plane, is a
+process-global resource) drives two fault families:
+
+  link faults   latency / jitter / drop / duplication / reordering /
+                bandwidth caps, applied by a ChaosConn wrapped around every
+                peer connection between the MConnection and the
+                SecretConnection — frames are already encrypted plaintext
+                packets at that seam, so a duplicated or reordered write is
+                a duplicated or reordered packet batch on the wire, exactly
+                what a lossy network delivers;
+  partitions    a partition map keyed by node id: a write across a group
+                boundary errors the connection (the RST/timeout a real
+                partitioned route eventually produces — silently eating
+                bytes would violate the delivered-or-dead contract the
+                gossip bookkeeping relies on), and new dials/accepts
+                across the boundary are refused until the map is cleared.
+                Directed single-link blocks (`block_link`) express
+                asymmetric partitions.
+
+Arming, via env (`CBFT_NET_CHAOS`), config (`p2p.chaos`), `arm_spec()`, or
+the `unsafe_net_chaos` RPC control route:
+
+  CBFT_NET_CHAOS="latency=0.05,drop=0.01,dup=0.02,reorder=0.05,bandwidth=65536"
+  CBFT_NET_CHAOS="partition=<idA>.<idB>|<idC>.<idD>"
+
+`partition=` groups are separated by `|`, members by `.`; node ids are hex
+so neither collides. Probabilistic faults use a seeded RNG per connection
+(`seed=` in the spec), so a fault schedule replays deterministically like a
+fuzz seed. Partition healing is observable: `clear_partition()` starts a
+clock that stops at the first write crossing a formerly-blocked link, and
+the elapsed seconds land on the process-global
+`cometbft_p2p_partition_heal_seconds` gauge (libs/metrics.NetChaosMetrics).
+
+Partition enforcement is write-side: each node's own wrapper drops its own
+outbound bytes. In-process nets share this registry so one `set_partition`
+cuts every direction at once; OS-process nets must arm the map on every
+node that should stop transmitting (the e2e runner arms all of them).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import threading
+import time
+
+_ENV = "CBFT_NET_CHAOS"
+
+# spec keys that arm link faults (all floats except bandwidth/seed)
+_LINK_KEYS = ("latency", "jitter", "drop", "dup", "reorder", "bandwidth", "seed")
+
+
+class NetChaosConfig:
+    """Link-fault knobs; all zero means the wire is clean."""
+
+    __slots__ = ("latency", "jitter", "drop", "dup", "reorder", "bandwidth",
+                 "seed")
+
+    def __init__(self, latency: float = 0.0, jitter: float = 0.0,
+                 drop: float = 0.0, dup: float = 0.0, reorder: float = 0.0,
+                 bandwidth: int = 0, seed: int = 0):
+        self.latency = latency
+        self.jitter = jitter
+        self.drop = drop
+        self.dup = dup
+        self.reorder = reorder
+        self.bandwidth = bandwidth
+        self.seed = seed
+
+    def any_active(self) -> bool:
+        return bool(self.latency or self.jitter or self.drop or self.dup
+                    or self.reorder or self.bandwidth)
+
+
+_lock = threading.Lock()
+_cfg: NetChaosConfig | None = None
+_groups: dict[str, str] = {}          # node_id -> partition group label
+_blocked_links: set[tuple[str, str]] = set()  # directed (src, dst) blocks
+_env_loaded = False
+# heal observability: set when a partition is cleared, consumed by the first
+# write that crosses a formerly-blocked link
+_heal_pending = False
+_heal_t0 = 0.0
+_heal_links: set[tuple[str, str]] = set()
+_last_heal_seconds: float | None = None
+_stats = {"blocked_writes": 0, "dropped": 0, "duplicated": 0,
+          "reordered": 0, "delayed": 0, "blocked_dials": 0}
+# fast path: True only while some fault is armed (checked lock-free per write)
+_active = False
+
+
+def parse_spec(spec: str) -> tuple[NetChaosConfig | None, dict[str, str],
+                                   set[tuple[str, str]]]:
+    """Parse a CBFT_NET_CHAOS schedule into (link config, partition groups,
+    directed blocks), raising ValueError on any malformed part — config
+    validation uses this so a typo'd schedule fails at boot."""
+    cfg_kwargs: dict[str, float | int] = {}
+    groups: dict[str, str] = {}
+    blocks: set[tuple[str, str]] = set()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, value = part.partition("=")
+        key, value = key.strip(), value.strip()
+        if not sep or not value:
+            raise ValueError(f"malformed net-chaos part {part!r}")
+        if key == "partition":
+            for gi, group in enumerate(value.split("|")):
+                members = [m for m in group.split(".") if m]
+                if not members:
+                    raise ValueError(f"empty partition group in {part!r}")
+                for m in members:
+                    groups[m] = f"g{gi}"
+        elif key == "block":
+            src, sep2, dst = value.partition(">")
+            if not sep2 or not src or not dst:
+                raise ValueError(f"malformed directed block {part!r} "
+                                 "(want block=src>dst)")
+            blocks.add((src, dst))
+        elif key in _LINK_KEYS:
+            try:
+                cfg_kwargs[key] = (int(value) if key in ("bandwidth", "seed")
+                                   else float(value))
+            except ValueError:
+                raise ValueError(
+                    f"bad net-chaos value {value!r} in {part!r}") from None
+            if cfg_kwargs[key] < 0:
+                raise ValueError(f"negative net-chaos value in {part!r}")
+        else:
+            raise ValueError(
+                f"unknown net-chaos key {key!r} (keys: "
+                f"{_LINK_KEYS + ('partition', 'block')})")
+    cfg = NetChaosConfig(**cfg_kwargs) if cfg_kwargs else None
+    return cfg, groups, blocks
+
+
+def _recompute_active_locked() -> None:
+    global _active
+    _active = bool((_cfg is not None and _cfg.any_active()) or _groups
+                   or _blocked_links or _heal_pending)
+
+
+def _load_env_locked() -> None:
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    spec = os.environ.get(_ENV, "")
+    if not spec:
+        return
+    try:
+        _arm_spec_locked(spec)
+    except ValueError as e:
+        # same floor as libs/chaos: a malformed env schedule surfacing as a
+        # phantom network fault inside a send routine would be undebuggable
+        from cometbft_tpu.libs import log as _log
+
+        _log.default().error(
+            "ignoring malformed CBFT_NET_CHAOS schedule", spec=spec, err=str(e))
+
+
+def _arm_spec_locked(spec: str) -> None:
+    global _cfg
+    cfg, groups, blocks = parse_spec(spec)
+    if cfg is not None:
+        _cfg = cfg
+    if groups:
+        _set_partition_locked(groups)
+    for link in blocks:
+        _blocked_links.add(link)
+    _recompute_active_locked()
+
+
+def arm(cfg: NetChaosConfig) -> None:
+    global _cfg
+    with _lock:
+        _load_env_locked()
+        _cfg = cfg
+        _recompute_active_locked()
+
+
+def arm_spec(spec: str) -> None:
+    with _lock:
+        _load_env_locked()
+        _arm_spec_locked(spec)
+
+
+def disarm() -> None:
+    """Drop the link-fault config; partitions stay (clear_partition heals)."""
+    global _cfg
+    with _lock:
+        _cfg = None
+        _recompute_active_locked()
+
+
+def reset() -> None:
+    """Back to a clean wire; forgets the env schedule (tests re-arm)."""
+    global _cfg, _env_loaded, _heal_pending, _last_heal_seconds
+    with _lock:
+        _cfg = None
+        _groups.clear()
+        _blocked_links.clear()
+        _heal_pending = False
+        _heal_links.clear()
+        _last_heal_seconds = None
+        _env_loaded = True
+        for k in _stats:
+            _stats[k] = 0
+        _recompute_active_locked()
+
+
+# ------------------------------------------------------------- partitions
+
+
+def _set_partition_locked(groups: dict[str, str]) -> None:
+    global _heal_pending
+    _groups.clear()
+    _groups.update({k: str(v) for k, v in groups.items()})
+    _heal_pending = False
+    _heal_links.clear()
+
+
+def set_partition(groups: dict[str, str]) -> None:
+    """Install a partition map: node_id -> group label. Two known ids in
+    different groups cannot exchange traffic; an id absent from the map is
+    unrestricted (so a map only needs the nodes it isolates)."""
+    with _lock:
+        _load_env_locked()
+        _set_partition_locked(groups)
+        _recompute_active_locked()
+
+
+def block_link(src: str, dst: str) -> None:
+    """Asymmetric partition primitive: src's messages never reach dst."""
+    with _lock:
+        _load_env_locked()
+        _blocked_links.add((src, dst))
+        _recompute_active_locked()
+
+
+def unblock_link(src: str, dst: str) -> None:
+    with _lock:
+        _blocked_links.discard((src, dst))
+        _recompute_active_locked()
+
+
+def clear_partition() -> None:
+    """Heal: drop the partition map and directed blocks, and start the
+    heal clock — stopped by the first write across a formerly-cut link."""
+    global _heal_pending, _heal_t0
+    with _lock:
+        cut: set[tuple[str, str]] = set(_blocked_links)
+        ids = list(_groups)
+        for a in ids:
+            for b in ids:
+                if a != b and _groups[a] != _groups[b]:
+                    cut.add((a, b))
+        _groups.clear()
+        _blocked_links.clear()
+        if cut:
+            _heal_pending = True
+            _heal_t0 = time.monotonic()
+            _heal_links.clear()
+            _heal_links.update(cut)
+        _recompute_active_locked()
+
+
+def link_blocked(src: str, dst: str) -> bool:
+    """True when traffic src -> dst is cut (directed block or group split)."""
+    if not _active:
+        if _env_loaded:
+            return False
+        # a node armed ONLY via CBFT_NET_CHAOS must enforce the partition
+        # on its very first boot-time dial, before any conn was wrapped
+        with _lock:
+            _load_env_locked()
+        if not _active:
+            return False
+    with _lock:
+        if (src, dst) in _blocked_links:
+            return True
+        ga, gb = _groups.get(src), _groups.get(dst)
+        return ga is not None and gb is not None and ga != gb
+
+
+def dial_blocked(a: str, b: str) -> bool:
+    """A dial needs both directions; blocked if either is cut."""
+    return link_blocked(a, b) or link_blocked(b, a)
+
+
+def _note_delivery(src: str, dst: str) -> None:
+    """Called on every non-blocked write while a heal is pending; the first
+    one across a formerly-cut link records partition_heal_seconds."""
+    global _heal_pending, _last_heal_seconds
+    with _lock:
+        if not _heal_pending or (src, dst) not in _heal_links:
+            return
+        _heal_pending = False
+        _heal_links.clear()
+        _last_heal_seconds = time.monotonic() - _heal_t0
+        _recompute_active_locked()
+        secs = _last_heal_seconds
+    from cometbft_tpu.libs import metrics as cmtmetrics
+
+    cmtmetrics.netchaos_metrics().partition_heal_seconds.set(secs)
+
+
+def last_heal_seconds() -> float | None:
+    with _lock:
+        return _last_heal_seconds
+
+
+def snapshot() -> dict:
+    """Armed faults + fire counts (surfaced by the unsafe_net_chaos route)."""
+    with _lock:
+        _load_env_locked()
+        cfg = None
+        if _cfg is not None:
+            cfg = {k: getattr(_cfg, k) for k in _LINK_KEYS}
+        return {
+            "config": cfg,
+            "partition": dict(_groups),
+            "blocked_links": sorted(f"{a}>{b}" for a, b in _blocked_links),
+            "heal_pending": _heal_pending,
+            "last_heal_seconds": _last_heal_seconds,
+            "stats": dict(_stats),
+        }
+
+
+def _count(kind: str) -> None:
+    with _lock:
+        _stats[kind] += 1
+    from cometbft_tpu.libs import metrics as cmtmetrics
+
+    cmtmetrics.netchaos_metrics().net_faults.labels(kind).inc()
+
+
+# ------------------------------------------------------------ conn wrapper
+
+
+class ChaosConn:
+    """Wraps a SecretConnection between the MConnection and the socket.
+    Reads the registry on every write, so faults armed mid-connection (the
+    runtime partition route) apply to live conns. A held reordered frame is
+    flushed by the next write; if the conn goes quiet first the frame is
+    lost — indistinguishable from a drop, which is the point."""
+
+    __slots__ = ("_conn", "local_id", "remote_id", "_rng", "_held")
+
+    def __init__(self, conn, local_id: str, remote_id: str):
+        self._conn = conn
+        self.local_id = local_id
+        self.remote_id = remote_id
+        self._rng: random.Random | None = None
+        self._held: bytes | None = None
+
+    def _link_rng(self, seed: int) -> random.Random:
+        if self._rng is None:
+            if seed:
+                # per-link deterministic stream (hashlib, not hash(): str
+                # hashing is salted per process): the same seed + id pair
+                # replays the same fault schedule
+                import hashlib
+
+                digest = hashlib.sha256(
+                    f"{seed}|{self.local_id}|{self.remote_id}".encode()
+                ).digest()
+                self._rng = random.Random(int.from_bytes(digest[:8], "big"))
+            else:
+                self._rng = random.Random()
+        return self._rng
+
+    async def write(self, data: bytes) -> None:
+        if not _active:
+            await self._conn.write(data)
+            return
+        if link_blocked(self.local_id, self.remote_id):
+            # a partitioned route must KILL the conn, not silently eat
+            # bytes: mconn/reactor bookkeeping assumes TCP's delivered-or-
+            # dead contract (PeerState marks gossiped votes as delivered
+            # at send time), so a silent black hole wedges gossip forever.
+            # The error tears the peer down; redial is then refused at the
+            # transport until the partition heals — the TCP-reset analog.
+            _count("blocked_writes")
+            raise ConnectionResetError(
+                f"net chaos: partitioned from {self.remote_id[:10]}")
+        cfg = _cfg
+        if cfg is not None and cfg.any_active():
+            rng = self._link_rng(cfg.seed)
+            if cfg.bandwidth:
+                await asyncio.sleep(len(data) / cfg.bandwidth)
+            if cfg.latency or cfg.jitter:
+                _count("delayed")
+                await asyncio.sleep(cfg.latency + cfg.jitter * rng.random())
+            r = rng.random()
+            if r < cfg.drop:
+                _count("dropped")
+                return
+            if r < cfg.drop + cfg.dup:
+                _count("duplicated")
+                await self._conn.write(data)
+            elif r < cfg.drop + cfg.dup + cfg.reorder and self._held is None:
+                _count("reordered")
+                self._held = data
+                return
+        held, self._held = self._held, None
+        await self._conn.write(data)
+        if held is not None:
+            await self._conn.write(held)
+        if _heal_pending:
+            _note_delivery(self.local_id, self.remote_id)
+
+    async def readexactly(self, n: int) -> bytes:
+        return await self._conn.readexactly(n)
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def __getattr__(self, name):
+        return getattr(self._conn, name)
+
+
+def wrap(conn, local_id: str, remote_id: str) -> ChaosConn:
+    """Wrap a peer connection; cheap when nothing is armed (one flag test
+    per write). Always wrapped so faults armed later reach live conns."""
+    with _lock:
+        _load_env_locked()
+    return ChaosConn(conn, local_id, remote_id)

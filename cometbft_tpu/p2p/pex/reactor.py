@@ -89,6 +89,10 @@ class PEXReactor(Reactor):
         self.ensure_interval = ensure_interval
         self._last_request: dict[str, float] = {}
         self._requested: set[str] = set()
+        # outbound throttle: we must respect the SAME per-peer rate limit
+        # we enforce inbound, or a thin address book makes ensure-peers
+        # spam requests that the peer rightfully scores as a pex flood
+        self._last_sent: dict[str, float] = {}
         self._task: asyncio.Task | None = None
 
     def get_channels(self) -> list[ChannelDescriptor]:
@@ -101,6 +105,11 @@ class PEXReactor(Reactor):
     async def on_stop(self) -> None:
         if self._task is not None:
             self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
         self.book.save()
 
     # ------------------------------------------------------------ lifecycle
@@ -117,6 +126,7 @@ class PEXReactor(Reactor):
 
     async def remove_peer(self, peer, reason) -> None:
         self._last_request.pop(peer.id, None)
+        self._last_sent.pop(peer.id, None)
         self._requested.discard(peer.id)
 
     def _peer_net_address(self, peer) -> NetAddress | None:
@@ -132,6 +142,10 @@ class PEXReactor(Reactor):
     # -------------------------------------------------------------- wire
 
     async def _request_addrs(self, peer) -> None:
+        now = time.time()
+        if now - self._last_sent.get(peer.id, 0.0) < MIN_REQUEST_INTERVAL:
+            return
+        self._last_sent[peer.id] = now
         self._requested.add(peer.id)
         await peer.send(PEX_CHANNEL, encode_request())
 
@@ -150,19 +164,22 @@ class PEXReactor(Reactor):
                 self.logger.info("pex request too soon; disconnecting",
                                  peer=peer.id)
                 if self.switch is not None:
-                    await self.switch.stop_peer_for_error(peer, "pex flood")
+                    await self.switch.stop_peer_for_error(peer, "pex flood",
+                                                          score=1.0)
                 return
             self._last_request[peer.id] = now
             await peer.send(PEX_CHANNEL, encode_addrs(self.book.selection()))
             if self.seed_mode and self.switch is not None:
-                # seed: serve and hang up (pex_reactor.go:205)
-                await self.switch.stop_peer_for_error(peer, "seed served")
+                # seed: serve and hang up (pex_reactor.go:205) — our own
+                # doing, so it must not score against the client
+                await self.switch.stop_peer_for_error(peer, "seed served",
+                                                      score=0.0)
         else:  # addrs
             if peer.id not in self._requested:
                 # unsolicited PexAddrs is protocol abuse (pex_reactor.go:260)
                 if self.switch is not None:
                     await self.switch.stop_peer_for_error(
-                        peer, "unsolicited pex addrs")
+                        peer, "unsolicited pex addrs", score=1.0)
                 return
             self._requested.discard(peer.id)
             for a in payload or []:

@@ -5,16 +5,26 @@ switch accepts/dials connections, wraps them in Peers, and dispatches every
 received message to the reactor owning that channel. Persistent peers are
 redialed with exponential backoff (switch.go:398 reconnectToPeer);
 StopPeerForError tears a peer down and triggers the redial.
+
+Misbehavior scoring (framework extension; the reference only disconnects):
+every stop-for-error and every reactor-reported offense (invalid vote
+signatures, pex floods, bad evidence) adds to a per-peer score with
+exponential time decay. Crossing the threshold bans the peer for a window
+that doubles on repeat offenses — while banned, inbound conns are refused
+and the persistent-peer redial loop waits instead of redialing a byzantine
+peer forever.
 """
 
 from __future__ import annotations
 
 import asyncio
 import random
+import time
 from typing import Optional
 
 from cometbft_tpu.libs import log as cmtlog
 from cometbft_tpu.libs.service import BaseService, TaskRunner
+from cometbft_tpu.p2p import netchaos
 from cometbft_tpu.p2p.base_reactor import Envelope, Reactor
 from cometbft_tpu.p2p.conn.connection import ChannelDescriptor, MConnConfig
 from cometbft_tpu.p2p.peer import Peer
@@ -29,12 +39,90 @@ class ErrDuplicatePeer(Exception):
     pass
 
 
+class ErrBannedPeer(Exception):
+    pass
+
+
+class _PeerRecord:
+    __slots__ = ("score", "updated", "banned_until", "ban_count", "last_ban")
+
+    def __init__(self):
+        self.score = 0.0
+        self.updated = None  # None until the first report (0.0 is a valid time)
+        self.banned_until = 0.0
+        self.ban_count = 0
+        self.last_ban = 0.0
+
+
+class PeerScorer:
+    """Misbehavior score + ban ledger, one record per node id.
+
+    Scores decay exponentially (half_life), so a peer must misbehave
+    FASTER than the decay to get banned — a one-off glitch ages out. Ban
+    windows double per repeat offense up to ban_max, and the repeat
+    counter itself resets after a clean stretch (10x the base window), so
+    a long-reformed peer earns back the short first-offense window."""
+
+    def __init__(self, ban_threshold: float = 3.0, ban_base: float = 60.0,
+                 ban_max: float = 3600.0, half_life: float = 120.0):
+        self.ban_threshold = ban_threshold
+        self.ban_base = ban_base
+        self.ban_max = ban_max
+        self.half_life = half_life
+        self._records: dict[str, _PeerRecord] = {}
+
+    def record(self, node_id: str, weight: float = 1.0,
+               now: float | None = None) -> bool:
+        """Score a misbehavior; returns True when this report trips a ban."""
+        now = time.monotonic() if now is None else now
+        rec = self._records.setdefault(node_id, _PeerRecord())
+        if rec.updated is not None and self.half_life > 0:
+            rec.score *= 0.5 ** ((now - rec.updated) / self.half_life)
+        rec.updated = now
+        rec.score += weight
+        if rec.score < self.ban_threshold or now < rec.banned_until:
+            return False
+        if rec.banned_until and now - rec.banned_until > 10 * self.ban_base:
+            # clean stretch measured from ban END, not start: a banned
+            # peer can't offend while refused, so measuring from the start
+            # would forgive any ban longer than the stretch itself
+            rec.ban_count = 0
+        window = min(self.ban_base * (2 ** rec.ban_count), self.ban_max)
+        rec.banned_until = now + window
+        rec.ban_count += 1
+        rec.last_ban = now
+        rec.score = 0.0
+        return True
+
+    def is_banned(self, node_id: str, now: float | None = None) -> bool:
+        rec = self._records.get(node_id)
+        if rec is None:
+            return False
+        return (time.monotonic() if now is None else now) < rec.banned_until
+
+    def ban_remaining(self, node_id: str, now: float | None = None) -> float:
+        rec = self._records.get(node_id)
+        if rec is None:
+            return 0.0
+        return max(0.0, rec.banned_until - (time.monotonic() if now is None else now))
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        return {
+            nid: {"score": round(rec.score, 3),
+                  "banned_for": max(0.0, rec.banned_until - now),
+                  "bans": rec.ban_count}
+            for nid, rec in self._records.items()
+        }
+
+
 class Switch(BaseService):
     def __init__(
         self,
         transport: Transport,
         mconn_config: MConnConfig | None = None,
         logger: cmtlog.Logger | None = None,
+        scorer: PeerScorer | None = None,
     ):
         super().__init__("P2P Switch", logger)
         self.transport = transport
@@ -47,6 +135,12 @@ class Switch(BaseService):
         self.persistent_addrs: dict[str, str] = {}  # node_id -> addr
         self._reconnecting: set[str] = set()
         self._tasks = TaskRunner("switch")
+        self.scorer = scorer or PeerScorer()
+        self.transport.is_banned = self.scorer.is_banned
+        self._closing = False
+        # ban observer (the node points this at addr_book.mark_bad so PEX
+        # stops offering/dialing a banned peer too): (node_id, seconds)
+        self.on_ban: Optional[callable] = None
 
     # ------------------------------------------------------------ reactors
 
@@ -67,11 +161,15 @@ class Switch(BaseService):
     # ------------------------------------------------------------ lifecycle
 
     async def on_start(self) -> None:
+        self._closing = False
         for reactor in self.reactors.values():
             await reactor.on_start()
         self._tasks.spawn(self._accept_routine(), name="switch-accept")
 
     async def on_stop(self) -> None:
+        # peer-error callbacks racing the teardown must not spawn fresh
+        # reconnect tasks after cancel_all has already run
+        self._closing = True
         await self._tasks.cancel_all()
         for peer in list(self.peers.values()):
             await self._stop_peer(peer, "switch stopping")
@@ -113,9 +211,20 @@ class Switch(BaseService):
         node_id, _, _ = parse_addr(addr)
         attempts = RECONNECT_ATTEMPTS if persistent else 1
         delay = RECONNECT_BASE_DELAY
-        for i in range(attempts):
+        i = 0
+        while i < attempts:
             if node_id and node_id in self.peers:
                 return
+            if node_id and self.scorer.is_banned(node_id):
+                # a banned peer is not redialed — wait out the (finite)
+                # ban window WITHOUT consuming dial attempts, or a long
+                # ban would permanently abandon a persistent peer
+                if not persistent:
+                    return
+                await asyncio.sleep(
+                    min(self.scorer.ban_remaining(node_id), RECONNECT_MAX_DELAY)
+                    + RECONNECT_BASE_DELAY)
+                continue
             try:
                 up = await self.transport.dial(addr)
                 await self._add_peer(up, persistent=persistent)
@@ -124,6 +233,7 @@ class Switch(BaseService):
                 raise
             except Exception as e:  # noqa: BLE001
                 self.logger.info("dial failed", addr=addr, attempt=i, err=str(e))
+                i += 1
                 # exponential backoff + jitter (switch.go:398)
                 await asyncio.sleep(delay * (0.5 + random.random()))
                 delay = min(delay * 2, RECONNECT_MAX_DELAY)
@@ -132,6 +242,11 @@ class Switch(BaseService):
 
     async def _add_peer(self, up: UpgradedConn, persistent: bool = False) -> Peer:
         node_id = up.node_info.node_id
+        if self.scorer.is_banned(node_id):
+            up.conn.close()
+            raise ErrBannedPeer(
+                f"peer {node_id[:10]} is banned for another "
+                f"{self.scorer.ban_remaining(node_id):.1f}s")
         existing = self.peers.get(node_id)
         if existing is not None:
             # Simultaneous-dial tie-break: both sides keep ONLY the
@@ -147,7 +262,9 @@ class Switch(BaseService):
             await self._stop_peer(existing, "replaced by canonical duplicate conn")
         persistent = persistent or node_id in self.persistent_addrs
         peer = Peer(
-            conn=up.conn,
+            # every peer conn rides through the net-chaos seam; a clean
+            # wire is one flag test per write (p2p/netchaos.py)
+            conn=netchaos.wrap(up.conn, self.transport.node_key.id(), node_id),
             node_info=up.node_info,
             channels=self._channels,
             on_receive=self._on_peer_receive,
@@ -183,15 +300,56 @@ class Switch(BaseService):
     async def _on_peer_error(self, peer: Peer, err: Exception) -> None:
         await self.stop_peer_for_error(peer, err)
 
-    async def stop_peer_for_error(self, peer: Peer, reason: object) -> None:
-        """switch.go:335: drop the peer; redial if persistent."""
+    def report_misbehavior(self, peer_id: str, reason: str,
+                           weight: float = 1.0) -> bool:
+        """Score a peer offense (invalid vote signature, bad evidence, pex
+        flood, ...). Sync so reactors/consensus can call it inline; a ban
+        tears the live conn down on a spawned task. Returns True when this
+        report newly banned the peer."""
+        if not peer_id:
+            return False
+        if self.metrics is not None:
+            self.metrics.peer_misbehavior.labels(reason).inc()
+        banned = self.scorer.record(peer_id, weight)
+        if not banned:
+            return False
+        remaining = self.scorer.ban_remaining(peer_id)
+        self.logger.info("banning misbehaving peer", peer=peer_id[:10],
+                         reason=reason, seconds=round(remaining, 1))
+        if self.metrics is not None:
+            self.metrics.peer_bans.inc()
+        if self.on_ban is not None:
+            try:
+                self.on_ban(peer_id, remaining)
+            except Exception as e:  # noqa: BLE001 - observer must not break bans
+                self.logger.error("on_ban hook failed", err=str(e))
+        peer = self.peers.get(peer_id)
+        if peer is not None:
+            self._tasks.spawn(self.stop_peer_for_error(peer, f"banned: {reason}",
+                                                       score=0.0),
+                              name=f"ban-{peer_id[:10]}")
+        return True
+
+    async def stop_peer_for_error(self, peer: Peer, reason: object,
+                                  score: float = 0.4) -> None:
+        """switch.go:335: drop the peer; redial if persistent (and not
+        banned). `score` feeds the misbehavior ledger — the 0.4 default
+        means ~8 conn errors inside one decay half-life before a ban (a
+        crashing neighbor is not an attacker); pass 0 for stops that are
+        our own doing (seed-mode hangups, operator disconnects, ban
+        enforcement) and 1.0 for protocol offenses."""
         if self.peers.get(peer.id) is not peer:
             # a late error from an already-replaced conn (duplicate
             # tie-break) must not tear down the canonical replacement
             return
         self.logger.info("stopping peer for error", peer=peer.id[:10], err=str(reason))
+        if score > 0:
+            self.report_misbehavior(peer.id, "conn-error", weight=score)
         await self._stop_peer(peer, reason)
-        if peer.is_persistent():
+        if peer.is_persistent() and not self._closing:
+            # banned persistent peers still get a reconnect task — the
+            # dial loop waits out the (decaying) ban window instead of
+            # hammering dials at a peer we just banned
             addr = self.persistent_addrs.get(peer.id)
             if addr and peer.id not in self._reconnecting:
                 self._reconnecting.add(peer.id)

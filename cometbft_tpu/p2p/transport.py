@@ -11,7 +11,9 @@ from __future__ import annotations
 import asyncio
 from dataclasses import dataclass
 
+from cometbft_tpu.libs import chaos
 from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.p2p import netchaos
 from cometbft_tpu.p2p.conn.secret_connection import SecretConnection
 from cometbft_tpu.p2p.key import NodeKey, node_id_from_pubkey
 from cometbft_tpu.p2p.node_info import NodeInfo
@@ -50,8 +52,15 @@ class Transport:
         self.node_key = node_key
         self.node_info = node_info
         self.logger = logger or cmtlog.nop()
+        # optional (node_id) -> bool ban probe, wired by the Switch: a
+        # banned peer is refused at the handshake, so its dialer sees a
+        # clean dial failure instead of an add-then-drop conn churn
+        self.is_banned = None
         self._server: asyncio.Server | None = None
         self._accept_queue: asyncio.Queue[UpgradedConn] = asyncio.Queue(64)
+        # in-flight inbound upgrades: server.close() only stops LISTENING;
+        # handlers mid-handshake must be cancelled at close or they leak
+        self._inbound_tasks: set[asyncio.Task] = set()
         # p2p.FuzzConnConfig | None: wrap every raw conn in the fault
         # injector before upgrade (transport.go:221-223 TestFuzz)
         self.fuzz_config = fuzz_config
@@ -72,16 +81,28 @@ class Transport:
     async def _handle_inbound(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._inbound_tasks.add(task)
+            task.add_done_callback(self._inbound_tasks.discard)
         try:
+            chaos.fire("net.accept")
             up = await asyncio.wait_for(
                 self._upgrade(reader, writer, outbound=False, expect_id=""),
                 HANDSHAKE_TIMEOUT,
             )
+        except asyncio.CancelledError:  # transport closing
+            writer.close()
+            raise
         except Exception as e:  # noqa: BLE001 - a bad dialer must not kill the listener
             self.logger.info("inbound upgrade failed", err=str(e))
             writer.close()
             return
-        await self._accept_queue.put(up)
+        try:
+            await self._accept_queue.put(up)
+        except asyncio.CancelledError:  # cancelled while the queue was full
+            up.conn.close()
+            raise
 
     async def accept(self) -> UpgradedConn:
         """Next fully-upgraded inbound connection (transport.go Accept).
@@ -93,6 +114,9 @@ class Transport:
     async def dial(self, addr: str) -> UpgradedConn:
         """Dial 'id@host:port' and upgrade (transport.go Dial)."""
         expect_id, host, port = parse_addr(addr)
+        chaos.fire("net.dial")
+        if expect_id and netchaos.dial_blocked(self.node_key.id(), expect_id):
+            raise ErrRejected(f"partitioned from {expect_id[:10]} (net chaos)")
         reader, writer = await asyncio.open_connection(host, port)
         try:
             return await asyncio.wait_for(
@@ -116,8 +140,14 @@ class Transport:
             from cometbft_tpu.p2p.fuzz import fuzz_streams
 
             reader, writer = fuzz_streams(reader, writer, self.fuzz_config)
+        chaos.fire("net.handshake")
         sconn = await SecretConnection.make(reader, writer, self.node_key.priv_key)
         authed_id = node_id_from_pubkey(sconn.remote_pubkey)
+        if netchaos.dial_blocked(self.node_key.id(), authed_id):
+            raise ErrRejected(
+                f"partitioned from {authed_id[:10]} (net chaos)")
+        if self.is_banned is not None and self.is_banned(authed_id):
+            raise ErrRejected(f"peer {authed_id[:10]} is banned")
         if expect_id and authed_id != expect_id:
             raise ErrRejected(
                 f"dialed {expect_id[:10]} but authenticated as {authed_id[:10]}"
@@ -136,3 +166,12 @@ class Transport:
     def close(self) -> None:
         if self._server is not None:
             self._server.close()
+        for t in list(self._inbound_tasks):
+            t.cancel()
+        # upgraded conns parked in the accept queue would otherwise leak
+        # their sockets once nothing will ever accept() them
+        while True:
+            try:
+                self._accept_queue.get_nowait().conn.close()
+            except asyncio.QueueEmpty:
+                break

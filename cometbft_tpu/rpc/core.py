@@ -758,8 +758,26 @@ class Environment:
         sw = self.node.switch
         peers = list(sw.peers.values())
         for p in peers:
-            await sw.stop_peer_for_error(p, "unsafe_disconnect_peers")
+            # operator action, not peer misbehavior: never score it
+            await sw.stop_peer_for_error(p, "unsafe_disconnect_peers", score=0.0)
         return {"disconnected": len(peers)}
+
+    async def unsafe_net_chaos(self, params: dict) -> dict:
+        """Framework extension (the e2e 'partition' perturbation): arm or
+        heal the process-global net-chaos registry at runtime. `spec` uses
+        the CBFT_NET_CHAOS syntax (p2p/netchaos.py); `heal` clears the
+        partition map (starting the heal clock); `clear` resets everything."""
+        from cometbft_tpu.p2p import netchaos
+
+        if self._bool_param(params.get("clear", False)):
+            netchaos.reset()
+            return {"net_chaos": netchaos.snapshot()}
+        spec = str(params.get("spec", "") or "")
+        if spec:
+            netchaos.arm_spec(spec)
+        if self._bool_param(params.get("heal", False)):
+            netchaos.clear_partition()
+        return {"net_chaos": netchaos.snapshot()}
 
     # ------------------------------------------------------------ table
 
@@ -773,6 +791,7 @@ class Environment:
                 "dial_peers": self.unsafe_dial_peers,
                 "unsafe_flush_mempool": self.unsafe_flush_mempool,
                 "unsafe_disconnect_peers": self.unsafe_disconnect_peers,
+                "unsafe_net_chaos": self.unsafe_net_chaos,
             })
         return table
 

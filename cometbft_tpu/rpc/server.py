@@ -151,7 +151,8 @@ class RPCServer(BaseService):
 
                 body = reg.render()
                 if reg is not cmtmetrics.global_registry():
-                    cmtmetrics.crypto_metrics()  # ensure series exist
+                    cmtmetrics.crypto_metrics()    # ensure series exist
+                    cmtmetrics.netchaos_metrics()  # (net-chaos plane too)
                     body += cmtmetrics.global_registry().render()
                 return 200, _RawText(body)
             if route == "openapi.yaml":

@@ -98,7 +98,13 @@ class Commit:
     block_id: BlockID
     signatures: list[CommitSig]
     _hash: bytes | None = field(default=None, repr=False, compare=False)
-    _sign_rows: tuple | None = field(default=None, repr=False, compare=False)
+    # chain_id -> rows; a dict (not a single-slot tuple) so alternating-
+    # chain callers (light-client cross-chain paths, tests) don't silently
+    # degrade to zero cache hits (ADVICE round-5). Bounded: a Commit is
+    # only ever verified against a handful of chain ids.
+    _sign_rows: dict | None = field(default=None, repr=False, compare=False)
+
+    _MAX_SIGN_ROW_CHAINS = 4
 
     def size(self) -> int:
         return len(self.signatures)
@@ -135,9 +141,11 @@ class Commit:
         from cometbft_tpu.types import canonical
         from cometbft_tpu.utils.protobuf import encode_uvarint
 
-        cached = self._sign_rows
-        if cached is not None and cached[0] == chain_id:
-            return cached[1]
+        if self._sign_rows is None:
+            self._sign_rows = {}
+        cached = self._sign_rows.get(chain_id)
+        if cached is not None:
+            return cached
         w = pb.Writer()
         w.uvarint(1, int(SignedMsgType.PRECOMMIT))
         w.sfixed64(2, self.height)
@@ -153,7 +161,9 @@ class Commit:
             head = head_commit if cs.block_id_flag == BlockIDFlag.COMMIT else head_nil
             body = head + ts_tag + encode_uvarint(len(ts)) + ts + tail
             rows.append(encode_uvarint(len(body)) + body)
-        self._sign_rows = (chain_id, rows)
+        if len(self._sign_rows) >= self._MAX_SIGN_ROW_CHAINS:
+            self._sign_rows.pop(next(iter(self._sign_rows)))
+        self._sign_rows[chain_id] = rows
         return rows
 
     def hash(self) -> bytes:

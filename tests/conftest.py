@@ -53,3 +53,58 @@ def jax_cpu_devices():
     devs = jax.devices("cpu")
     assert len(devs) >= 8, f"expected 8 virtual cpu devices, got {devs}"
     return devs
+
+
+# --------------------------------------------------------------- task leaks
+#
+# asyncio.run() silently cancels whatever is still pending when the main
+# coroutine returns, which is how the PR-2 class of teardown bugs (services
+# leaving stray tasks behind) survived unnoticed until they wedged a real
+# node. This autouse fixture wraps asyncio.run for the duration of each
+# test and fails the test if its main coroutine returns while tasks it
+# spawned are still pending — teardown must actually tear down.
+# Opt out per-test with @pytest.mark.allow_task_leaks (for tests that
+# deliberately abandon work mid-flight).
+
+import asyncio  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fail_on_leaked_asyncio_tasks(request):
+    if request.node.get_closest_marker("allow_task_leaks"):
+        yield
+        return
+    leaks: list[str] = []
+    orig_run = asyncio.run
+
+    def checked_run(coro, **kwargs):
+        async def _main():
+            try:
+                return await coro
+            finally:
+                stray = [
+                    t for t in asyncio.all_tasks()
+                    if t is not asyncio.current_task() and not t.done()
+                ]
+                if stray:
+                    # grace period: a task cancel()ed during teardown is
+                    # still "pending" until the loop delivers the
+                    # CancelledError — only tasks that survive the grace
+                    # window are leaks
+                    await asyncio.wait(stray, timeout=0.5)
+                leaks.extend(
+                    f"{t.get_name()}: {t.get_coro()!r}"
+                    for t in stray if not t.done()
+                )
+
+        return orig_run(_main(), **kwargs)
+
+    asyncio.run = checked_run
+    try:
+        yield
+    finally:
+        asyncio.run = orig_run
+    if leaks:
+        pytest.fail(
+            "test left pending asyncio tasks behind (stop your services):\n  "
+            + "\n  ".join(sorted(leaks)), pytrace=False)

@@ -21,13 +21,14 @@ from cometbft_tpu.consensus.reactor import ConsensusReactor
 from cometbft_tpu.crypto import ed25519
 from cometbft_tpu.evidence import EvidencePool
 from cometbft_tpu.evidence.reactor import EvidenceReactor
+from cometbft_tpu.libs import metrics as cmtmetrics
 from cometbft_tpu.libs.events import EventSwitch
 from cometbft_tpu.mempool.mempool import CListMempool, MempoolConfig
 from cometbft_tpu.mempool.reactor import MempoolReactor
 from cometbft_tpu.p2p.conn.connection import MConnConfig
 from cometbft_tpu.p2p.key import NodeKey
 from cometbft_tpu.p2p.node_info import NodeInfo
-from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.p2p.switch import PeerScorer, Switch
 from cometbft_tpu.p2p.transport import Transport
 from cometbft_tpu.privval.file_pv import FilePV
 from cometbft_tpu.proxy import AppConns, local_client_creator
@@ -50,6 +51,9 @@ class TcpNode:
     transport: Transport
     node_key: NodeKey
     cons_reactor: ConsensusReactor
+    registry: cmtmetrics.Registry = None
+    p2p_metrics: cmtmetrics.P2PMetrics = None
+    evidence_metrics: cmtmetrics.EvidenceMetrics = None
     addr: str = ""
 
     @property
@@ -98,6 +102,7 @@ async def make_tcp_node(
     gdoc: GenesisDoc,
     config: ConsensusConfig,
     fuzz_config=None,
+    scorer: PeerScorer | None = None,
 ) -> TcpNode:
     state = State.from_genesis(gdoc)
     app = KVStoreApplication()
@@ -131,14 +136,20 @@ async def make_tcp_node(
     # tight mconn config for tests: fast pings, generous rate
     switch = Switch(transport, mconn_config=MConnConfig(
         send_rate=50_000_000, recv_rate=50_000_000, ping_interval=5.0, pong_timeout=10.0,
-    ))
+    ), scorer=scorer)
     switch.add_reactor("CONSENSUS", cons_reactor)
     switch.add_reactor("MEMPOOL", mem_reactor)
     switch.add_reactor("EVIDENCE", ev_reactor)
+    # per-node metrics so byzantine/partition tests can assert detection
+    registry = cmtmetrics.Registry()
+    switch.metrics = cmtmetrics.P2PMetrics(registry)
+    ev_pool.metrics = cmtmetrics.EvidenceMetrics(registry)
+    cs.misbehavior_hook = switch.report_misbehavior
     return TcpNode(
         name=name, cs=cs, conns=conns, mempool=mempool, block_store=block_store,
         evidence_pool=ev_pool, app=app, switch=switch, transport=transport,
-        node_key=node_key, cons_reactor=cons_reactor,
+        node_key=node_key, cons_reactor=cons_reactor, registry=registry,
+        p2p_metrics=switch.metrics, evidence_metrics=ev_pool.metrics,
     )
 
 
@@ -147,6 +158,7 @@ async def make_tcp_net(
     config: ConsensusConfig | None = None,
     chain_id: str = "tcp-test-chain",
     fuzz_config=None,
+    scorer_factory=None,
 ) -> TcpNet:
     privs = [ed25519.gen_priv_key() for _ in range(n_vals)]
     gdoc = GenesisDoc(
@@ -161,7 +173,8 @@ async def make_tcp_net(
     net = TcpNet(privs=privs, chain_id=chain_id)
     cfg = config or make_test_config()
     for i in range(n_vals):
-        node = await make_tcp_node(f"val{i}", privs[i], gdoc, cfg,
-                                   fuzz_config=fuzz_config)
+        node = await make_tcp_node(
+            f"val{i}", privs[i], gdoc, cfg, fuzz_config=fuzz_config,
+            scorer=scorer_factory() if scorer_factory is not None else None)
         net.nodes.append(node)
     return net

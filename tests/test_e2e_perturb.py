@@ -227,3 +227,28 @@ def test_perturbation_matrix_and_evidence_injection(tmp_path):
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 pass
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_runner_partition_byzantine_flood_matrix(tmp_path):
+    """The runner's network/byzantine perturbation matrix on real OS
+    processes: a runtime 2-2 partition (no progress, then heal with
+    partition_heal_seconds recorded), an equivocating restart (honest
+    nodes commit DuplicateVoteEvidence, evidence_committed >= 1), and an
+    invalid-signature flooding restart (peer_bans >= 1) — all on one net,
+    which must still converge fork-free."""
+    from cometbft_tpu.e2e.manifest import Manifest, NodeManifest
+    from cometbft_tpu.e2e.runner import run_manifest
+
+    m = Manifest(
+        name="netchaos-matrix",
+        nodes={
+            "node0": NodeManifest(perturb=["partition", "byzantine", "flood"]),
+            "node1": NodeManifest(),
+            "node2": NodeManifest(),
+            "node3": NodeManifest(),
+        },
+    )
+    m.validate()
+    run_manifest(m, str(tmp_path / "net"), base_port=30500)

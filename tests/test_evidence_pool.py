@@ -1,0 +1,183 @@
+"""Evidence-pool lifecycle tests (ISSUE 3 satellites): expiry/pruning,
+persistence + re-proposal across a restart, the report_conflicting_votes
+consensus buffer (lost on crash, rebuilt by WAL replay re-reporting), and
+the evidence_committed/pending metrics."""
+
+from __future__ import annotations
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.evidence import EvidencePool
+from cometbft_tpu.evidence.verify import ErrInvalidEvidence
+from cometbft_tpu.libs import metrics as cmtmetrics
+from cometbft_tpu.state import State, StateStore
+from cometbft_tpu.store import MemDB
+from cometbft_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.utils import cmttime
+
+CHAIN_ID = "evidence-chain"
+
+
+def _fixture(n_vals: int = 4):
+    """A state at height 1 with a 4-validator set, its store, and signers."""
+    privs = [ed25519.gen_priv_key() for _ in range(n_vals)]
+    gdoc = GenesisDoc(
+        genesis_time=cmttime.canonical_now_ms(),
+        chain_id=CHAIN_ID,
+        validators=[
+            GenesisValidator(address=p.pub_key().address(),
+                             pub_key=p.pub_key(), power=10)
+            for p in privs
+        ],
+    )
+    gdoc.validate_and_complete()
+    state = State.from_genesis(gdoc)
+    state.last_block_height = 1
+    state.last_block_time = cmttime.canonical_now_ms()
+    state.last_validators = state.validators.copy()
+    store = StateStore(MemDB())
+    store.bootstrap(state)  # persists the valset at height 1
+    return state, store, privs
+
+
+def _conflicting_votes(priv, val_set, height: int, ts) -> tuple[Vote, Vote]:
+    addr = priv.pub_key().address()
+    idx, _ = val_set.get_by_address(addr)
+
+    def vote(tag: bytes) -> Vote:
+        v = Vote(
+            type_=SignedMsgType.PRECOMMIT, height=height, round_=0,
+            block_id=BlockID(hash=tag * 32,
+                             part_set_header=PartSetHeader(total=1, hash=tag * 32)),
+            timestamp=ts, validator_address=addr, validator_index=idx,
+        )
+        v.signature = priv.sign(v.sign_bytes(CHAIN_ID))
+        return v
+
+    return vote(b"\xaa"), vote(b"\xbb")
+
+
+def _evidence(state, priv, height: int = 1) -> DuplicateVoteEvidence:
+    a, b = _conflicting_votes(priv, state.last_validators, height,
+                              state.last_block_time)
+    return DuplicateVoteEvidence.new(a, b, state.last_block_time,
+                                     state.last_validators)
+
+
+def _advance(state: State, heights: int, seconds: float) -> State:
+    out = state.copy()
+    out.last_block_height = state.last_block_height + heights
+    out.last_block_time = cmttime.Timestamp(
+        state.last_block_time.seconds + int(seconds),
+        state.last_block_time.nanos)
+    return out
+
+
+class TestExpiryPruning:
+    def test_expired_evidence_pruned_from_memory_and_db(self):
+        state, store, privs = _fixture()
+        state.consensus_params.evidence.max_age_num_blocks = 5
+        state.consensus_params.evidence.max_age_duration_ns = int(10e9)
+        store.save(state)
+        pool = EvidencePool(MemDB(), store)
+        ev = _evidence(state, privs[0])
+        assert pool.add_evidence(ev)
+        assert pool.size() == 1
+
+        # aged in blocks but not in time: both conditions must hold to prune
+        pool.update(_advance(state, 6, 1), [])
+        assert pool.size() == 1
+
+        # aged in blocks AND time: pruned, including the DB row
+        pool.update(_advance(state, 6, 60), [])
+        assert pool.size() == 0
+        assert list(pool.db.iterate(b"\x00", b"\x00" + b"\xff" * 40)) == []
+
+    def test_expired_evidence_rejected_at_intake(self):
+        state, store, privs = _fixture()
+        state.consensus_params.evidence.max_age_num_blocks = 5
+        state.consensus_params.evidence.max_age_duration_ns = int(10e9)
+        aged = _advance(state, 10, 60)
+        store.save(aged)
+        pool = EvidencePool(MemDB(), store)
+        with pytest.raises(ErrInvalidEvidence):
+            pool.add_evidence(_evidence(state, privs[0]))
+
+
+class TestRestartPersistence:
+    def test_pending_evidence_survives_restart_and_is_reproposed(self):
+        state, store, privs = _fixture()
+        db = MemDB()
+        pool = EvidencePool(db, store)
+        ev = _evidence(state, privs[0])
+        assert pool.add_evidence(ev)
+
+        # "restart": a fresh pool over the same DB recovers the pending set
+        pool2 = EvidencePool(db, store)
+        assert pool2.size() == 1
+        proposed, _ = pool2.pending_evidence(1 << 20)
+        assert [e.hash() for e in proposed] == [ev.hash()]
+
+        # commit it; a third incarnation must refuse to re-commit
+        reg = cmtmetrics.Registry()
+        pool2.metrics = cmtmetrics.EvidenceMetrics(reg)
+        pool2.update(_advance(state, 1, 1), [ev])
+        assert pool2.size() == 0
+        assert pool2.metrics.evidence_committed.value() == 1
+        assert pool2.metrics.evidence_pending.value() == 0
+
+        pool3 = EvidencePool(db, store)
+        assert pool3.size() == 0
+        with pytest.raises(ErrInvalidEvidence, match="already committed"):
+            pool3.check_evidence([ev])
+
+    def test_consensus_buffer_rebuilt_by_replay_after_crash(self):
+        """The report_conflicting_votes buffer is memory-only — a crash
+        before the next update() loses it. WAL replay re-feeds the votes,
+        consensus re-reports the conflict, and the materialized evidence
+        lands in the DB this time (the designed recovery path)."""
+        state, store, privs = _fixture()
+        db = MemDB()
+        pool = EvidencePool(db, store)
+        a, b = _conflicting_votes(privs[1], state.last_validators, 1,
+                                  state.last_block_time)
+        pool.report_conflicting_votes(a, b)
+        assert pool.size() == 0  # buffered, not yet materialized
+
+        # crash: buffer gone, DB has nothing
+        pool2 = EvidencePool(db, store)
+        assert pool2.size() == 0
+
+        # WAL replay re-delivers the conflicting votes -> re-reported;
+        # the next update materializes with the BLOCK time of the height
+        pool2.report_conflicting_votes(a, b)
+        pool2.update(state, [])
+        assert pool2.size() == 1
+        (ev,) = pool2.pending_evidence(1 << 20)[0]
+        assert isinstance(ev, DuplicateVoteEvidence)
+        assert ev.timestamp.unix_ns() == state.last_block_time.unix_ns()
+
+        # and the materialized evidence is durable across another restart
+        pool3 = EvidencePool(db, store)
+        assert pool3.size() == 1
+
+    def test_buffered_votes_above_committed_height_retry(self):
+        """pool.go:459-520: conflicting votes above last_block_height stay
+        buffered until their height commits."""
+        state, store, privs = _fixture()
+        pool = EvidencePool(MemDB(), store)
+        a, b = _conflicting_votes(privs[2], state.last_validators, 3,
+                                  state.last_block_time)
+        pool.report_conflicting_votes(a, b)
+        pool.update(state, [])  # height 1 < vote height 3: kept buffered
+        assert pool.size() == 0
+
+        st3 = _advance(state, 2, 2)
+        st3.last_validators = state.last_validators
+        store.save_validators(3, state.last_validators)
+        pool.update(st3, [])
+        assert pool.size() == 1

@@ -110,3 +110,81 @@ class TestDecoderFuzz:
         for _ in range(100):
             srm.parse_signature(rng.randbytes(64))
             srm.parse_signature(rng.randbytes(rng.randrange(0, 80)))
+
+
+class TestFuzzConnConfigWiring:
+    """The p2p fuzz injector is reachable from config and the testnet
+    manifest (ISSUE 3 satellite): knobs round-trip through config.toml,
+    and FuzzModeDelay never drops."""
+
+    def test_config_round_trip(self, tmp_path):
+        from cometbft_tpu.config import Config
+
+        cfg = Config(home=str(tmp_path))
+        cfg.p2p.test_fuzz = True
+        cfg.p2p.test_fuzz_mode = "delay"
+        cfg.p2p.test_fuzz_prob_drop_rw = 0.25
+        cfg.p2p.test_fuzz_prob_drop_conn = 0.125
+        cfg.p2p.test_fuzz_prob_sleep = 0.5
+        cfg.p2p.test_fuzz_max_delay = 0.75
+        cfg.validate_basic()
+        cfg.save()
+
+        loaded = Config.load(str(tmp_path))
+        assert loaded.p2p.test_fuzz is True
+        assert loaded.p2p.test_fuzz_mode == "delay"
+        assert loaded.p2p.test_fuzz_prob_drop_rw == 0.25
+        assert loaded.p2p.test_fuzz_prob_drop_conn == 0.125
+        assert loaded.p2p.test_fuzz_prob_sleep == 0.5
+        assert loaded.p2p.test_fuzz_max_delay == 0.75
+
+    def test_bad_mode_and_probabilities_rejected(self):
+        from cometbft_tpu.config import Config
+
+        cfg = Config()
+        cfg.p2p.test_fuzz_mode = "chaos-monkey"
+        with pytest.raises(ValueError):
+            cfg.validate_basic()
+        cfg.p2p.test_fuzz_mode = "drop"
+        cfg.p2p.test_fuzz_prob_sleep = 1.5
+        with pytest.raises(ValueError):
+            cfg.validate_basic()
+
+    def test_manifest_round_trip_carries_fuzz(self):
+        from cometbft_tpu.e2e.manifest import Manifest, NodeManifest
+
+        m = Manifest(name="fuzznet",
+                     nodes={"node0": NodeManifest(fuzz="delay")})
+        m2 = Manifest.from_toml(m.to_toml())
+        assert m2.nodes["node0"].fuzz == "delay"
+        with pytest.raises(ValueError):
+            NodeManifest(fuzz="bogus").validate()
+
+    def test_delay_mode_never_drops(self):
+        import asyncio
+
+        from cometbft_tpu.p2p.fuzz import FuzzConnConfig, fuzz_streams
+
+        class _W:
+            def __init__(self):
+                self.data = []
+
+            def write(self, b):
+                self.data.append(b)
+
+            async def drain(self):
+                pass
+
+        inner = _W()
+        cfg = FuzzConnConfig(mode="delay", prob_drop_rw=1.0,
+                             prob_drop_conn=1.0, prob_sleep=1.0,
+                             max_delay=0.0, arm_after=0.0)
+        _, writer = fuzz_streams(None, inner, cfg, seed=SEED)
+
+        async def main():
+            for i in range(50):
+                writer.write(bytes([i]))
+                await writer.drain()
+
+        asyncio.run(main())
+        assert len(inner.data) == 50, "FuzzModeDelay must never drop bytes"

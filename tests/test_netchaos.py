@@ -1,0 +1,217 @@
+"""Net-chaos registry unit tests + the partition acceptance test: a 4-node
+TCP net under an injected 2-2 partition makes NO progress (and no fork),
+then resumes committing after the heal, with partition_heal_seconds
+recorded (ISSUE 3 acceptance)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.libs import metrics as cmtmetrics
+from cometbft_tpu.p2p import netchaos
+
+from tests.tcp_net_harness import make_tcp_net
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    netchaos.reset()
+    yield
+    netchaos.reset()
+
+
+# ---------------------------------------------------------------- parsing
+
+
+class TestParseSpec:
+    def test_link_faults(self):
+        cfg, groups, blocks = netchaos.parse_spec(
+            "latency=0.05,jitter=0.01,drop=0.1,dup=0.2,reorder=0.3,"
+            "bandwidth=65536,seed=7")
+        assert cfg.latency == 0.05 and cfg.jitter == 0.01
+        assert cfg.drop == 0.1 and cfg.dup == 0.2 and cfg.reorder == 0.3
+        assert cfg.bandwidth == 65536 and cfg.seed == 7
+        assert groups == {} and blocks == set()
+
+    def test_partition_and_blocks(self):
+        _, groups, blocks = netchaos.parse_spec(
+            "partition=aa.bb|cc.dd,block=ee>ff")
+        assert groups["aa"] == groups["bb"] != groups["cc"] == groups["dd"]
+        assert blocks == {("ee", "ff")}
+
+    @pytest.mark.parametrize("bad", [
+        "latency", "latency=", "latency=x", "latency=-1", "nope=1",
+        "partition=", "block=aa", "block=>bb",
+    ])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            netchaos.parse_spec(bad)
+
+    def test_p2p_config_validates_chaos_spec(self):
+        from cometbft_tpu.config import Config
+
+        cfg = Config()
+        cfg.p2p.chaos = "drop=0.5,partition=aa|bb"
+        cfg.validate_basic()
+        cfg.p2p.chaos = "drop=oops"
+        with pytest.raises(ValueError):
+            cfg.validate_basic()
+
+
+# ------------------------------------------------------------- partitions
+
+
+class TestPartitionMap:
+    def test_group_split_blocks_both_directions(self):
+        netchaos.set_partition({"a": "g1", "b": "g1", "c": "g2"})
+        assert netchaos.link_blocked("a", "c")
+        assert netchaos.link_blocked("c", "a")
+        assert not netchaos.link_blocked("a", "b")
+        # an id absent from the map is unrestricted
+        assert not netchaos.link_blocked("a", "zz")
+        assert netchaos.dial_blocked("b", "c")
+
+    def test_directed_block_is_asymmetric(self):
+        netchaos.block_link("a", "b")
+        assert netchaos.link_blocked("a", "b")
+        assert not netchaos.link_blocked("b", "a")
+        netchaos.unblock_link("a", "b")
+        assert not netchaos.link_blocked("a", "b")
+
+    def test_clear_partition_starts_heal_clock(self):
+        netchaos.set_partition({"a": "g1", "b": "g2"})
+        netchaos.clear_partition()
+        assert not netchaos.link_blocked("a", "b")
+        snap = netchaos.snapshot()
+        assert snap["heal_pending"] is True
+
+
+class _FakeConn:
+    def __init__(self):
+        self.writes: list[bytes] = []
+        self.closed = False
+
+    async def write(self, data: bytes) -> None:
+        self.writes.append(data)
+
+    async def readexactly(self, n: int) -> bytes:
+        return b"\x00" * n
+
+    def close(self) -> None:
+        self.closed = True
+
+
+class TestChaosConn:
+    def test_passthrough_when_disarmed(self):
+        inner = _FakeConn()
+        conn = netchaos.wrap(inner, "me", "you")
+
+        async def main():
+            await conn.write(b"hello")
+
+        asyncio.run(main())
+        assert inner.writes == [b"hello"]
+
+    def test_partition_kills_cross_group_writes(self):
+        inner = _FakeConn()
+        conn = netchaos.wrap(inner, "me", "you")
+        netchaos.set_partition({"me": "g1", "you": "g2"})
+
+        async def main():
+            with pytest.raises(ConnectionResetError):
+                await conn.write(b"lost")
+            netchaos.clear_partition()
+            await conn.write(b"delivered")
+
+        asyncio.run(main())
+        assert inner.writes == [b"delivered"]
+        assert netchaos.snapshot()["stats"]["blocked_writes"] == 1
+        # the first post-heal write across the formerly-cut link stopped
+        # the heal clock and recorded the gauge
+        assert netchaos.last_heal_seconds() is not None
+        assert (cmtmetrics.netchaos_metrics()
+                .partition_heal_seconds.value() >= 0.0)
+
+    def test_drop_and_dup_deterministic_with_seed(self):
+        def run_once() -> list[bytes]:
+            netchaos.reset()
+            netchaos.arm(netchaos.NetChaosConfig(drop=0.3, dup=0.3, seed=42))
+            inner = _FakeConn()
+            conn = netchaos.wrap(inner, "me", "you")
+
+            async def main():
+                for i in range(40):
+                    await conn.write(bytes([i]))
+
+            asyncio.run(main())
+            return inner.writes
+
+        first, second = run_once(), run_once()
+        assert first == second, "seeded fault schedule must replay"
+        assert len(first) != 40, "some frames must be dropped or duplicated"
+
+    def test_reorder_swaps_adjacent_writes(self):
+        netchaos.arm(netchaos.NetChaosConfig(reorder=1.0, seed=1))
+        inner = _FakeConn()
+        conn = netchaos.wrap(inner, "me", "you")
+
+        async def main():
+            await conn.write(b"first")   # held
+            await conn.write(b"second")  # flushes: second then first
+
+        asyncio.run(main())
+        assert inner.writes == [b"second", b"first"]
+
+
+class TestTransportSeamSites:
+    def test_net_dial_site_fires(self):
+        from cometbft_tpu.libs import chaos
+
+        chaos.reset()
+        chaos.arm("net.dial", "transient", 1)
+        with pytest.raises(chaos.ChaosTransientError):
+            chaos.fire("net.dial")
+        chaos.fire("net.dial")  # healed after one firing
+        chaos.reset()
+
+
+# ------------------------------------------------- 2-2 partition over TCP
+
+
+@pytest.mark.chaos
+def test_partition_2_2_no_progress_then_heal():
+    """ISSUE 3 acceptance: a 4-node net under a 2-2 partition commits
+    nothing and forks nowhere; clearing the map resumes commits within a
+    bounded time and records partition_heal_seconds."""
+
+    async def main():
+        net = await make_tcp_net(4)
+        await net.start()
+        try:
+            await net.wait_for_height(3, timeout=60)
+            ids = [n.node_key.id() for n in net.nodes]
+            netchaos.set_partition({ids[0]: "a", ids[1]: "a",
+                                    ids[2]: "b", ids[3]: "b"})
+            await asyncio.sleep(0.7)  # in-flight commits land
+            h0 = max(n.block_store.height() for n in net.nodes)
+            await asyncio.sleep(2.0)
+            h1 = max(n.block_store.height() for n in net.nodes)
+            assert h1 <= h0 + 1, f"progress during a 2-2 partition: {h0}->{h1}"
+            # no fork: every committed height agrees across the split
+            hmin = min(n.block_store.height() for n in net.nodes)
+            for h in range(1, hmin + 1):
+                hashes = {n.block_store.load_block(h).hash() for n in net.nodes}
+                assert len(hashes) == 1, f"fork at height {h}"
+
+            netchaos.clear_partition()
+            await net.wait_for_height(h1 + 3, timeout=60)
+            healed = netchaos.last_heal_seconds()
+            assert healed is not None and healed >= 0.0
+            assert (cmtmetrics.netchaos_metrics()
+                    .partition_heal_seconds.value() == healed)
+        finally:
+            await net.stop()
+
+    asyncio.run(main())

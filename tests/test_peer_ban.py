@@ -1,0 +1,151 @@
+"""Peer misbehavior scoring and ban-ledger tests: score decay, ban windows
+that double on repeat offenses, banned peers refused + not redialed, and
+the pex/addrbook churn behavior — banned addresses are excluded from dials
+and selections until the ban decays."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from cometbft_tpu.p2p.pex.addrbook import AddrBook, NetAddress
+from cometbft_tpu.p2p.pex.reactor import PEXReactor
+from cometbft_tpu.p2p.switch import PeerScorer
+
+from tests.tcp_net_harness import make_tcp_net
+
+
+class TestPeerScorer:
+    def test_threshold_trips_ban(self):
+        s = PeerScorer(ban_threshold=2.5, ban_base=10.0, half_life=100.0)
+        assert not s.record("p1", 1.0, now=0.0)
+        assert not s.record("p1", 1.0, now=1.0)
+        assert s.record("p1", 1.0, now=2.0)  # third strike bans
+        assert s.is_banned("p1", now=5.0)
+        assert not s.is_banned("p1", now=13.0)  # window elapsed
+        assert not s.is_banned("p2", now=2.0)
+
+    def test_score_decays(self):
+        s = PeerScorer(ban_threshold=3.0, ban_base=10.0, half_life=10.0)
+        s.record("p1", 2.0, now=0.0)
+        # two half-lives later the old 2.0 is worth 0.5: 0.5+2.0 < 3
+        assert not s.record("p1", 2.0, now=20.0)
+        # but a fast follow-up trips it
+        assert s.record("p1", 1.0, now=21.0)
+
+    def test_ban_window_doubles_then_resets(self):
+        s = PeerScorer(ban_threshold=1.0, ban_base=10.0, ban_max=30.0,
+                       half_life=1000.0)
+        s.record("p1", 1.0, now=0.0)
+        assert s.ban_remaining("p1", now=0.0) == 10.0
+        s.record("p1", 1.0, now=20.0)       # second offense: 20s window
+        assert s.ban_remaining("p1", now=20.0) == 20.0
+        s.record("p1", 1.0, now=50.0)       # third: 40 -> capped at 30
+        assert s.ban_remaining("p1", now=50.0) == 30.0
+        # a clean stretch (>10x base) forgives the history
+        s.record("p1", 1.0, now=500.0)
+        assert s.ban_remaining("p1", now=500.0) == 10.0
+
+    def test_no_ban_while_already_banned(self):
+        s = PeerScorer(ban_threshold=1.0, ban_base=10.0, half_life=1000.0)
+        assert s.record("p1", 1.0, now=0.0)
+        # reports during the ban don't extend/stack it
+        assert not s.record("p1", 5.0, now=1.0)
+        assert s.ban_remaining("p1", now=1.0) == 9.0
+
+
+class TestSwitchBanEnforcement:
+    def test_banned_peer_dropped_and_not_redialed_until_decay(self):
+        """Over a real 2-node TCP net: banning a peer tears the conn down,
+        inbound/outbound are refused while banned, and the persistent
+        redial reconnects only after the window decays."""
+
+        async def main():
+            net = await make_tcp_net(
+                2, scorer_factory=lambda: PeerScorer(
+                    ban_threshold=1.0, ban_base=1.5, half_life=30.0))
+            a, b = net.nodes
+            await net.start()
+            try:
+                async def wait_peers(node, want, timeout=15.0):
+                    async def poll():
+                        while len(node.switch.peers) != want:
+                            await asyncio.sleep(0.02)
+                    await asyncio.wait_for(poll(), timeout)
+
+                await wait_peers(a, 1)
+                assert a.switch.report_misbehavior(b.node_key.id(),
+                                                   "test-offense")
+                await wait_peers(a, 0)
+                assert a.p2p_metrics.peer_bans.value() == 1
+                assert a.p2p_metrics.peer_misbehavior.value("test-offense") == 1
+                # still banned moments later: no reconnection
+                await asyncio.sleep(0.5)
+                assert b.node_key.id() not in a.switch.peers
+                # after the window decays the persistent redial (from
+                # either side) restores the conn
+                await wait_peers(a, 1, timeout=20.0)
+            finally:
+                await net.stop()
+
+        asyncio.run(main())
+
+
+class TestAddrBookBanChurn:
+    def _book(self):
+        book = AddrBook(our_id="self")
+        for i in range(6):
+            book.add_address(NetAddress(node_id=f"peer{i}", host="127.0.0.1",
+                                        port=1000 + i))
+        return book
+
+    def test_banned_addrs_excluded_until_decay(self):
+        book = self._book()
+        book.mark_bad("peer0", ban_seconds=3600)
+        now = time.time()
+        for _ in range(50):
+            picked = book.pick_address()
+            assert picked.node_id != "peer0"
+        assert all(a.node_id != "peer0" for a in book.selection())
+        # the ban decays: rewind the clock instead of sleeping
+        book._addrs["peer0"].banned_until = now - 1
+        assert any(book.pick_address().node_id == "peer0" for _ in range(200))
+
+    def test_churn_under_rolling_bans(self):
+        """Ban/unban churn never leaves the book empty-handed while any
+        usable address remains, and bans never leak into selections."""
+        book = self._book()
+        for i in range(5):
+            book.mark_bad(f"peer{i}", ban_seconds=3600)
+            usable = {a.node_id for a in book.selection()}
+            assert all(not a.startswith(tuple(f"peer{j}" for j in range(i + 1)))
+                       for a in usable)
+            assert book.pick_address() is not None  # peer5 still usable
+        book.mark_bad("peer5", ban_seconds=3600)
+        assert book.pick_address() is None
+        assert book.selection() == []
+
+    def test_pex_ensure_peers_skips_banned(self):
+        """The ensure-peers dial loop never dials a banned address; after
+        the ban decays it does."""
+        book = AddrBook(our_id="self")
+        book.add_address(NetAddress(node_id="bad", host="127.0.0.1", port=1))
+        book.mark_bad("bad", ban_seconds=3600)
+
+        dialed: list[str] = []
+
+        class _StubSwitch:
+            peers: dict = {}
+
+            async def dial_peers_async(self, addrs, persistent=False):
+                dialed.extend(addrs)
+
+        pex = PEXReactor(book, max_outbound=2)
+        pex.set_switch(_StubSwitch())
+
+        asyncio.run(pex._ensure_peers())
+        assert dialed == []
+
+        book._addrs["bad"].banned_until = time.time() - 1
+        asyncio.run(pex._ensure_peers())
+        assert dialed and dialed[0].startswith("bad@")

@@ -59,9 +59,22 @@ def test_fresh_node_statesyncs_from_live_peer(tmp_path):
             node_b = Node(cfg_b, app=app_b, genesis_doc=node_a.genesis_doc)
             await node_b.start()
             try:
-                # B restores the snapshot and then block-syncs past it
+                # B restores the snapshot and then block-syncs past it.
+                # Poll the STATE store, not the block store: blocks land
+                # one ahead of their application, and the asserts below
+                # read applied state (the test_crash_recovery race)
                 deadline = asyncio.get_running_loop().time() + 60
-                while node_b.block_store.height() < snap_height + 2:
+
+                def _applied_enough() -> bool:
+                    st = node_b.state_store.load()
+                    if st is None:
+                        return False
+                    # a newer snapshot than the pinned one may have been
+                    # restored; wait past whichever base B actually has
+                    return st.last_block_height >= max(
+                        snap_height + 2, node_b.block_store.base() + 1)
+
+                while not _applied_enough():
                     await asyncio.sleep(0.1)
                     assert asyncio.get_running_loop().time() < deadline, (
                         f"B stuck at {node_b.block_store.height()} "

@@ -266,6 +266,17 @@ class TestVoteSetAndCommit:
             assert rows is commit.vote_sign_bytes_all(chain_id)  # memoized
             for i in range(len(commit.signatures)):
                 assert rows[i] == commit.vote_sign_bytes(chain_id, i), i
+        # ALTERNATING chains stay cached (chain_id-keyed dict, ADVICE r5):
+        # neither call evicts the other
+        a = commit.vote_sign_bytes_all("test-chain")
+        b = commit.vote_sign_bytes_all("other-chain")
+        assert commit.vote_sign_bytes_all("test-chain") is a
+        assert commit.vote_sign_bytes_all("other-chain") is b
+        # ...and the cache is bounded: flooding chain ids cannot grow it
+        # without limit
+        for i in range(10):
+            commit.vote_sign_bytes_all(f"chain-{i}")
+        assert len(commit._sign_rows) <= commit._MAX_SIGN_ROW_CHAINS
 
 
 class TestBlockAndParts:
