@@ -557,6 +557,179 @@ def bench_consensus_tpu(detail: dict) -> None:
             "(each flush pays the dev-box tunnel RTT)")
 
 
+def bench_scheduler(detail: dict) -> None:
+    """Global verify scheduler under a mixed offered load (ISSUE 4
+    acceptance): a 4-validator in-process net committing with batched
+    vote verification (consensus class) while mempool-admission
+    signature rows pump concurrently (mempool class, deadline-flushed or
+    riding consensus flushes as filler) and blocksync-shaped commit
+    windows verify (sync class). Reports:
+
+      sched_fill_ratio_mean       rows/lanes over every dispatched batch
+      sched_fragmented_fill_mean  the SAME groups dispatched one-batch-
+                                  per-producer (the pre-scheduler
+                                  architecture), measured on this load
+      sched_latency_per_class     submit->dispatch p50/p99 ms
+      sched_direct_flush_*        consensus flush-sized batches through
+                                  the scheduler (with filler queued) vs
+                                  the direct fragmented verifier path —
+                                  the no-regression check for consensus
+                                  flush latency
+    """
+    import asyncio
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from light_harness import LightChain
+    from net_harness import make_net
+
+    from cometbft_tpu import sched
+    from cometbft_tpu.consensus.config import test_consensus_config
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.crypto import ed25519
+    from cometbft_tpu.types import validation
+
+    sched.reset()
+    sched.configure(enabled=True)
+    out: dict = {}
+
+    # ---- live mixed load: 4-val net + mempool pump + sync windows
+    chain = LightChain("bench-sched", 12, n_vals=32)
+    svals = chain.valsets[1]
+
+    def _mempool_rows(n):
+        rows = []
+        for i in range(n):
+            p = ed25519.gen_priv_key()
+            m = b"bench-sched-tx-%d" % i
+            rows.append((p.pub_key(), m, p.sign(m)))
+        return rows
+
+    pump_rows = _mempool_rows(8)
+
+    async def run_net():
+        cfg = test_consensus_config()
+        cfg.batch_vote_verification = True
+        net = await make_net(4, config=cfg, chain_id="bench-sched-net")
+        submitted = rejected = 0
+        await net.start()
+        try:
+            deadline = time.monotonic() + 60
+            height_goal = 8
+            sync_h = 1
+
+            async def pump():
+                # one row per submit — the real admission shape: every
+                # check_tx stages a single signature row, which pre-PR
+                # would have been its own (8-lane-padded) device batch
+                nonlocal submitted, rejected
+                while time.monotonic() < deadline:
+                    for row in pump_rows:
+                        try:
+                            sched.get().submit([row], klass=sched.MEMPOOL)
+                            submitted += 1
+                        except sched.SchedulerSaturated:
+                            rejected += 1
+                    await asyncio.sleep(0.004)
+                    if min(n.block_store.height() for n in net.nodes) >= height_goal:
+                        return
+
+            async def sync_windows():
+                nonlocal sync_h
+                while time.monotonic() < deadline:
+                    staged = []
+                    for h in range(sync_h, min(sync_h + 3, 12)):
+                        lb = chain.blocks[h]
+                        staged.append(validation.stage_verify_commit(
+                            "bench-sched", svals, lb.commit.block_id, h,
+                            lb.commit))
+                    sync_h = sync_h + 3 if sync_h + 3 < 12 else 1
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, validation.prefetch_staged, staged, "sync")
+                    for s in staged:
+                        s.finish()
+                    await asyncio.sleep(0.02)
+                    if min(n.block_store.height() for n in net.nodes) >= height_goal:
+                        return
+
+            tasks = [asyncio.create_task(pump()),
+                     asyncio.create_task(sync_windows())]
+            while time.monotonic() < deadline:
+                if min(n.block_store.height() for n in net.nodes) >= height_goal:
+                    break
+                await asyncio.sleep(0.01)
+            for t in tasks:
+                await t
+        finally:
+            await net.stop()
+        return (min(n.block_store.height() for n in net.nodes),
+                submitted, rejected)
+
+    height, submitted, rejected = asyncio.run(run_net())
+    sched.get().flush()
+    snap = sched.get().health()
+    out["net_height"] = height
+    out["mempool_rows_offered"] = submitted
+    out["mempool_rows_rejected_backpressure"] = rejected
+    out["fill_ratio_mean"] = snap["fill_ratio_mean"]
+    out["fragmented_fill_ratio_mean"] = snap["fragmented_fill_ratio_mean"]
+    out["fill_gain"] = (
+        round(snap["fill_ratio_mean"] / snap["fragmented_fill_ratio_mean"], 3)
+        if snap["fragmented_fill_ratio_mean"] else None)
+    out["batches"] = snap["batches"]
+    out["rows_total"] = snap["rows_total"]
+    out["class_rows"] = snap["class_rows"]
+    out["deadline_misses"] = snap["deadline_misses"]
+    out["dispatch_shapes"] = snap["dispatch_shapes"]
+    out["latency_per_class"] = sched.get().latency_quantiles()
+
+    # ---- direct-flush no-regression check: flush-sized (128-row)
+    # consensus batches through the scheduler (mempool filler queued)
+    # vs the pre-scheduler fragmented verifier on identical rows
+    privs = [ed25519.gen_priv_key() for _ in range(128)]
+    rows = []
+    for i, p in enumerate(privs):
+        m = b"bench-flush-%d" % i
+        rows.append((p.pub_key(), m, p.sign(m)))
+
+    def p50p99(ts):
+        ts = sorted(ts)
+        return (round(ts[len(ts) // 2] * 1e3, 3),
+                round(ts[min(len(ts) - 1, int(len(ts) * 0.99))] * 1e3, 3))
+
+    sched_ts = []
+    for _ in range(20):
+        for row in pump_rows:
+            try:
+                sched.get().submit([row], klass=sched.MEMPOOL)
+            except sched.SchedulerSaturated:
+                pass
+        t0 = time.perf_counter()
+        mask = sched.get().verify_now(rows, sched.CONSENSUS)
+        sched_ts.append(time.perf_counter() - t0)
+        assert all(mask)
+    direct_ts = []
+    sched.configure(enabled=False)
+    try:
+        for _ in range(20):
+            bv = crypto_batch.create_mixed_batch_verifier()
+            for pk, m, s in rows:
+                bv.add(pk, m, s)
+            t0 = time.perf_counter()
+            ok, _ = bv.verify()
+            direct_ts.append(time.perf_counter() - t0)
+            assert ok
+    finally:
+        sched.configure(enabled=True)
+    out["direct_flush_sched_p50_ms"], out["direct_flush_sched_p99_ms"] = p50p99(sched_ts)
+    out["direct_flush_frag_p50_ms"], out["direct_flush_frag_p99_ms"] = p50p99(direct_ts)
+    out["note"] = (
+        "fill_ratio_mean vs fragmented_fill_ratio_mean measures the SAME "
+        "live load batched by the scheduler vs one-batch-per-producer; "
+        "direct_flush_* is the consensus-flush latency no-regression pair "
+        "(scheduler with filler vs pre-scheduler fragmented verifier)")
+    detail["sched"] = out
+
+
 def main() -> None:
     import jax
 
@@ -701,7 +874,7 @@ def main() -> None:
 
     # -- subsystem benches (each guarded: a failure reports, not aborts)
     for fn in (bench_blocksync, bench_mixed_megacommit, bench_light_client,
-               bench_consensus_tpu):
+               bench_consensus_tpu, bench_scheduler):
         try:
             _progress(fn.__name__)
             fn(detail)

@@ -210,9 +210,12 @@ class BlocksyncReactor(Reactor):
             # device->host mask fetch must not stall the p2p event loop;
             # timing runs INSIDE the worker so device_busy_s measures the
             # fetch alone, not the overlapped staging below
+            # sync-class: the window yields the device to consensus-
+            # critical flushes in the global verify scheduler, and queued
+            # mempool-admission rows ride the window batch as filler
             def _timed_prefetch(batch=[e[-1] for e in entries]):
                 t0 = time.monotonic()
-                validation.prefetch_staged(batch)
+                validation.prefetch_staged(batch, klass="sync")
                 return time.monotonic() - t0
 
             fetch = asyncio.get_running_loop().run_in_executor(

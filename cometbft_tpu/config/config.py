@@ -76,6 +76,32 @@ class CryptoConfig:
     backend: str = "auto"  # "cpu" | "tpu" | "auto"
     # coalesce at most this many signatures into one device batch
     max_batch_size: int = 16384
+    # --- global verify scheduler (sched/scheduler.py) ---
+    # route ALL batch verification through the node-wide scheduler
+    # (continuous batching: consensus flushes drain immediately and
+    # coalesce queued sync/mempool work as filler). Off = the pre-
+    # scheduler fragmented dispatch (each producer its own batch).
+    scheduler: bool = True
+    # cap on rows coalesced into one scheduler batch (groups never split)
+    sched_max_lanes: int = 16384
+    # flush deadlines per class: consensus is always 0 (inline drain);
+    # sync/mempool work waits at most this long for a ride before the
+    # deadline worker flushes it
+    sched_sync_deadline: float = 0.002
+    sched_mempool_deadline: float = 0.010
+    # mempool-class admission rejected past this many queued rows (also
+    # rejected while consensus/sync backlog alone exceeds it)
+    sched_queue_limit: int = 16384
+    # any queued group older than this rides the next batch regardless
+    # of class priority (starvation guard)
+    sched_starvation_limit: float = 0.25
+    # pre-trace the device bucket ladder at node boot (TPU backend only;
+    # a cold Mosaic compile must not land mid-consensus-round). Rungs are
+    # traced up to sched_warmup_max_lanes — each rung pays one compile
+    # (tens of seconds cold on Mosaic), so the cap bounds boot time;
+    # raise it toward sched_max_lanes on nodes serving huge valsets
+    sched_warmup: bool = False
+    sched_warmup_max_lanes: int = 2048
     # --- device-fault supervision (ops/dispatch.py DeviceSupervisor) ---
     # transient failures: retries per dispatch, with backoff doubling from
     # retry_backoff_base up to retry_backoff_cap (plus jitter)
@@ -110,6 +136,16 @@ class CryptoConfig:
             raise ValueError("breaker_cooldown cannot be negative")
         if self.watchdog_timeout <= 0:
             raise ValueError("watchdog_timeout must be positive")
+        if self.sched_max_lanes < 8:
+            raise ValueError("sched_max_lanes must be >= 8")
+        if self.sched_sync_deadline < 0 or self.sched_mempool_deadline < 0:
+            raise ValueError("scheduler deadlines cannot be negative")
+        if self.sched_queue_limit < 1:
+            raise ValueError("sched_queue_limit must be >= 1")
+        if self.sched_starvation_limit < 0:
+            raise ValueError("sched_starvation_limit cannot be negative")
+        if self.sched_warmup_max_lanes < 8:
+            raise ValueError("sched_warmup_max_lanes must be >= 8")
         if self.chaos:
             from cometbft_tpu.libs import chaos as _chaos
 
@@ -322,7 +358,8 @@ class Config:
     def validate_basic(self) -> None:
         """config.go:318 ValidateBasic: every section that defines one."""
         for section in (self.base, self.crypto, self.rpc, self.p2p,
-                        self.block_sync, self.state_sync, self.tx_index):
+                        self.mempool, self.block_sync, self.state_sync,
+                        self.tx_index):
             section.validate_basic()
 
     # ------------------------------------------------------------ paths

@@ -908,13 +908,21 @@ class ConsensusState(BaseService):
 
     async def _flush_vote_set(self, vs: VoteSet) -> None:
         """One device batch for a VoteSet's staged votes; then events +
-        threshold hooks for what got added, evidence for equivocations."""
+        threshold hooks for what got added, evidence for equivocations.
+        The flush runs consensus-class through the global verify
+        scheduler: it drains immediately (never queued behind sync or
+        mempool work) and coalesces whatever compatible queued rows fit
+        the bucket as filler — the device sees one fuller batch instead
+        of a fragment."""
+        from cometbft_tpu import sched
+
         n_pending = len(vs._pending)
         if self.metrics is not None and n_pending > 0:
             self.metrics.batch_flushes.inc()
             self.metrics.batch_lanes.inc(n_pending)
         try:
-            results = vs.flush_pending()
+            with sched.work_class(sched.CONSENSUS):
+                results = vs.flush_pending()
         except ErrVoteConflictingVotes as e:
             results = getattr(e, "results", [])
             own_addr = (

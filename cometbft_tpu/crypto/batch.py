@@ -80,7 +80,8 @@ def _publish_active(backend: str) -> None:
 
 def configure(crypto_cfg) -> None:
     """Apply config.crypto at node boot: backend selection, supervision
-    knobs (retry/backoff/breaker/watchdog), and any chaos schedule."""
+    knobs (retry/backoff/breaker/watchdog), verify-scheduler knobs, and
+    any chaos schedule."""
     set_backend(crypto_cfg.backend)
     from cometbft_tpu.ops import dispatch
 
@@ -91,6 +92,16 @@ def configure(crypto_cfg) -> None:
         retry_base=crypto_cfg.retry_backoff_base,
         retry_cap=crypto_cfg.retry_backoff_cap,
         watchdog_timeout=crypto_cfg.watchdog_timeout,
+    )
+    from cometbft_tpu import sched
+
+    sched.configure(
+        enabled=crypto_cfg.scheduler,
+        max_lanes=crypto_cfg.sched_max_lanes,
+        sync_deadline=crypto_cfg.sched_sync_deadline,
+        mempool_deadline=crypto_cfg.sched_mempool_deadline,
+        queue_limit=crypto_cfg.sched_queue_limit,
+        starvation_limit=crypto_cfg.sched_starvation_limit,
     )
     if crypto_cfg.chaos:
         from cometbft_tpu.libs import chaos
@@ -106,11 +117,22 @@ def supports_batch_verifier(pub_key: crypto.PubKey | None) -> bool:
 def create_batch_verifier(pub_key: crypto.PubKey) -> crypto.BatchVerifier:
     """Create a verifier for this key type on the configured backend.
     Raises ErrInvalidKey for unbatchable key types (caller falls back to
-    serial verification, as the reference does)."""
+    serial verification, as the reference does).
+
+    With the global verify scheduler enabled (the default) the returned
+    verifier is a CLIENT of the node-wide scheduler: verify() drains as
+    one inline batch that coalesces whatever compatible queued work fits
+    the bucket (sched/scheduler.py). The producer no longer owns device
+    dispatch — that inversion is what keeps the device running few full
+    batches instead of many fragmented ones."""
     backends = _REGISTRY.get(pub_key.type_())
     if not backends:
         raise crypto.ErrInvalidKey(
             f"key type {pub_key.type_()!r} has no batch verifier")
+    from cometbft_tpu import sched
+
+    if sched.enabled():
+        return ScheduledBatchVerifier()
     backend = resolve_backend()
     factory = backends.get(backend) or backends["cpu"]
     try:
@@ -168,7 +190,49 @@ class MixedBatchVerifier(crypto.BatchVerifier):
         return len(self._route)
 
 
+class ScheduledBatchVerifier(crypto.BatchVerifier):
+    """The scheduler-client face of crypto.BatchVerifier: add() stages
+    rows host-side (cheap structural checks, same contract as the CPU/TPU
+    verifiers); verify() submits the rows to the global VerifyScheduler
+    as ONE group under the caller's ambient priority class
+    (sched.work_class) and drains inline, coalescing queued filler.
+    Mixed key types are accepted — the scheduler groups rows per scheme
+    into per-scheme device sub-batches resolved with one fetch."""
+
+    SIGNATURE_SIZE = 64
+
+    def __init__(self, klass: str | None = None):
+        from cometbft_tpu import sched
+
+        self._klass = klass or sched.current_class()
+        self._rows: list[tuple[crypto.PubKey, bytes, bytes]] = []
+
+    def add(self, pub_key: crypto.PubKey, msg: bytes, sig: bytes) -> None:
+        if pub_key.type_() not in _REGISTRY:
+            raise crypto.ErrInvalidKey(
+                f"key type {pub_key.type_()!r} has no batch verifier")
+        if len(sig) != self.SIGNATURE_SIZE:
+            raise crypto.ErrInvalidSignature("bad signature length")
+        self._rows.append((pub_key, bytes(msg), bytes(sig)))
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        if not self._rows:
+            return True, []
+        from cometbft_tpu import sched
+
+        mask = sched.get().verify_now(self._rows, self._klass)
+        out = [bool(x) for x in mask]
+        return all(out), out
+
+    def count(self) -> int:
+        return len(self._rows)
+
+
 def create_mixed_batch_verifier() -> crypto.BatchVerifier:
+    from cometbft_tpu import sched
+
+    if sched.enabled():
+        return ScheduledBatchVerifier()
     return MixedBatchVerifier()
 
 

@@ -63,12 +63,18 @@ def verify_evidence(ev: Evidence, state: State, get_validators,
     if val_set is None:
         raise ErrInvalidEvidence(f"no validator set at evidence height {ev.height()}")
 
-    if isinstance(ev, DuplicateVoteEvidence):
-        verify_duplicate_vote(ev, state.chain_id, val_set)
-    elif isinstance(ev, LightClientAttackEvidence):
-        verify_light_client_attack(ev, state, val_set, block_store)
-    else:
-        raise ErrInvalidEvidence(f"unknown evidence type {type(ev).__name__}")
+    # sync class: evidence intake must not preempt consensus-critical
+    # flushes in the global verify scheduler; its tiny batches (2 sigs
+    # for an equivocation) coalesce with whatever else is in flight
+    from cometbft_tpu import sched
+
+    with sched.work_class(sched.SYNC):
+        if isinstance(ev, DuplicateVoteEvidence):
+            verify_duplicate_vote(ev, state.chain_id, val_set)
+        elif isinstance(ev, LightClientAttackEvidence):
+            verify_light_client_attack(ev, state, val_set, block_store)
+        else:
+            raise ErrInvalidEvidence(f"unknown evidence type {type(ev).__name__}")
 
 
 def verify_duplicate_vote(

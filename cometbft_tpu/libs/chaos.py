@@ -11,6 +11,10 @@ fallback -> re-probe). Sites live on the device-dispatch seams:
   sr25519.fetch      the sr25519 device->host payload fetch
   pallas.trace       inside the Pallas gate, before the fused-kernel call
   mixed.resolve      the coalesced multi-batch fetch (resolve_batches)
+  sched.flush        the verify scheduler's batch-formation seam
+                     (sched/scheduler.py _dispatch): an injected fault
+                     degrades to per-group fragmented dispatch, never
+                     failed verification
 
 plus the transport seams (the network plane's deterministic faults; the
 probabilistic link faults — latency/drop/dup/reorder/partitions — live in
@@ -50,6 +54,7 @@ SITES = (
     "sr25519.fetch",
     "pallas.trace",
     "mixed.resolve",
+    "sched.flush",
     "net.dial",
     "net.accept",
     "net.handshake",

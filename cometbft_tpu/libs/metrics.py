@@ -313,6 +313,36 @@ class CryptoMetrics:
             "failure", labels=("scheme",))
 
 
+class SchedMetrics:
+    """Verify-scheduler observability (sched/scheduler.py — no reference
+    analog): how full the continuously-batched device batches run, how
+    deep each priority class queues, and whether deadline flushing keeps
+    up. Process-global like CryptoMetrics — one scheduler per process."""
+
+    def __init__(self, reg: Registry):
+        self.batch_lanes = reg.histogram(
+            "verify_sched", "batch_lanes",
+            "Padded lane count of each dispatched verify batch",
+            buckets=(8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096,
+                     8192, 16384))
+        self.fill_ratio = reg.histogram(
+            "verify_sched", "fill_ratio",
+            "Rows / padded lanes per dispatched verify batch",
+            buckets=(0.1, 0.25, 0.5, 0.625, 0.75, 0.875, 0.95, 1.0))
+        self.queue_depth = reg.gauge(
+            "verify_sched", "queue_depth",
+            "Signature rows queued per priority class", labels=("class",))
+        self.flush_deadline_misses = reg.counter(
+            "verify_sched", "flush_deadline_misses",
+            "Groups flushed past their deadline (plus slack)")
+        self.flush_latency = reg.histogram(
+            "verify_sched", "flush_latency_seconds",
+            "Submit-to-dispatch latency per priority class",
+            labels=("class",),
+            buckets=(0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 1.0))
+
+
 _global: Optional[Registry] = None
 
 
@@ -352,6 +382,20 @@ def crypto_metrics() -> CryptoMetrics:
             if _crypto is None:
                 _crypto = CryptoMetrics(global_registry())
     return _crypto
+
+
+_sched: Optional[SchedMetrics] = None
+
+
+def sched_metrics() -> SchedMetrics:
+    """Process-global SchedMetrics on the global registry (same
+    double-checked init discipline as crypto_metrics)."""
+    global _sched
+    if _sched is None:
+        with _crypto_lock:
+            if _sched is None:
+                _sched = SchedMetrics(global_registry())
+    return _sched
 
 
 _netchaos: Optional[NetChaosMetrics] = None

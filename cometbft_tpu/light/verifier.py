@@ -117,13 +117,18 @@ def verify_adjacent(
             f"those from new header ({untrusted_header.header.validators_hash.hex()})"
         )
     try:
-        verify_commit_light(
-            trusted_header.chain_id,
-            untrusted_vals,
-            untrusted_header.commit.block_id,
-            untrusted_header.height,
-            untrusted_header.commit,
-        )
+        # sync class: a light hop must not preempt consensus flushes in
+        # the global verify scheduler
+        from cometbft_tpu import sched
+
+        with sched.work_class(sched.SYNC):
+            verify_commit_light(
+                trusted_header.chain_id,
+                untrusted_vals,
+                untrusted_header.commit.block_id,
+                untrusted_header.height,
+                untrusted_header.commit,
+            )
     except Exception as e:  # noqa: BLE001 — uniform ErrInvalidHeader wrapping
         raise ErrInvalidHeader(e) from e
 
@@ -180,7 +185,7 @@ def verify_non_adjacent(
         )
     except Exception as e:  # noqa: BLE001 - verifier.go:69-72 wrapping
         raise ErrInvalidHeader(e) from e
-    prefetch_staged([staged_trust, staged_new])
+    prefetch_staged([staged_trust, staged_new], klass="sync")
     try:
         staged_trust.finish()
     except ErrNotEnoughVotingPowerSigned as e:

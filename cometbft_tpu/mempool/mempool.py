@@ -35,6 +35,17 @@ class ErrTxTooLarge(Exception):
     pass
 
 
+class ErrTxBadSignature(Exception):
+    """Admission-time signature gate rejected the tx (mempool tx_verify):
+    either structurally unparseable or the signature failed the batched
+    verification — the tx never buys an ABCI round-trip."""
+
+
+# tx wire layout under tx_verify="ed25519": pub(32) || sig(64) || payload
+TX_SIG_PUB = 32
+TX_SIG_OVERHEAD = 96
+
+
 class TxCache:
     """LRU of tx hashes (reference: mempool/cache.go LRUTxCache)."""
 
@@ -80,6 +91,18 @@ class MempoolConfig:
     max_tx_bytes: int = 1048576
     recheck: bool = True
     keep_invalid_txs_in_cache: bool = False
+    # admission-time signature gate: "" = off (reference behavior);
+    # "ed25519" = txs are `pub(32) || sig(64) || payload`, the signature
+    # (over payload) verifies through the global verify scheduler's
+    # mempool class BEFORE the ABCI round-trip — concurrent admissions
+    # coalesce into one device batch or ride a consensus flush as filler
+    tx_verify: str = ""
+
+    def validate_basic(self) -> None:
+        if self.tx_verify not in ("", "ed25519"):
+            raise ValueError(f"unknown mempool tx_verify {self.tx_verify!r}")
+        if self.size < 0 or self.max_txs_bytes < 0 or self.cache_size < 0:
+            raise ValueError("mempool sizes cannot be negative")
 
 
 class CListMempool:
@@ -100,6 +123,10 @@ class CListMempool:
         self._tx_available = asyncio.Event()
         self.notify_available = True
         self.metrics = None  # libs.metrics.MempoolMetrics | None (node wires it)
+        # in-flight CheckTx dedup: tx hash -> future of the FIRST
+        # submission's result; concurrent duplicates await it instead of
+        # paying a second ABCI round-trip (or racing the cache)
+        self._inflight: dict[bytes, asyncio.Future] = {}
 
     def _update_metrics(self) -> None:
         if self.metrics is not None:
@@ -125,21 +152,67 @@ class CListMempool:
     async def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
         """Gate a tx into the pool (clist_mempool.go:251-300 CheckTx +
         resCbFirstTime). Raises for structural rejects; returns the app
-        response (which may be a rejection) otherwise."""
+        response (which may be a rejection) otherwise.
+
+        A duplicate submitted while the first copy's CheckTx is still in
+        flight resolves from the FIRST result — same response object, no
+        second ABCI round-trip (the reference rejects such duplicates via
+        the cache; resolving is strictly more useful to the submitter and
+        costs nothing)."""
         if len(tx) > self.config.max_tx_bytes:
             raise ErrTxTooLarge(f"tx size {len(tx)} > max {self.config.max_tx_bytes}")
         if self.is_full(len(tx)):
             raise ErrMempoolIsFull(
                 f"{len(self._txs)} txs, {self._txs_bytes} bytes"
             )
+        h = tx_hash(tx)
+        first = self._inflight.get(h)
+        if first is not None:
+            try:
+                res = await asyncio.shield(first)
+            except asyncio.CancelledError:
+                if not first.cancelled():
+                    raise  # WE were cancelled, not the first submitter
+                # the first submitter was cancelled mid-flight: its result
+                # is unknown; fall through to the normal path (typically
+                # ErrTxInCache — the pre-dedup behavior) instead of
+                # propagating a foreign cancellation into this caller
+                first = None
+            else:
+                async with self._lock:
+                    if h in self._txs and sender and not self._txs[h].sender:
+                        self._txs[h].sender = sender
+                return res
         if not self.cache.push(tx):
             # Record the extra sender, as the reference does, then reject.
-            h = tx_hash(tx)
             async with self._lock:
                 if h in self._txs and sender and not self._txs[h].sender:
                     self._txs[h].sender = sender
             raise ErrTxInCache()
 
+        fut = asyncio.get_running_loop().create_future()
+        self._inflight[h] = fut
+        try:
+            res = await self._check_tx_new(tx, sender)
+        except BaseException as e:
+            if not fut.done():
+                if isinstance(e, Exception):
+                    fut.set_exception(e)
+                    fut.exception()  # consumed: no never-retrieved warning
+                else:  # CancelledError: waiters retry on their own
+                    fut.cancel()
+            raise
+        else:
+            fut.set_result(res)
+            return res
+        finally:
+            self._inflight.pop(h, None)
+
+    async def _check_tx_new(self, tx: bytes, sender: str) -> abci.ResponseCheckTx:
+        """First-copy admission: optional batched signature gate, the app
+        CheckTx round-trip, then pool insertion."""
+        if self.config.tx_verify:
+            await self._verify_tx_signature(tx)
         res = await self.app_conn.check_tx(abci.RequestCheckTx(tx=tx, type_=abci.CheckTxType.NEW))
         if res.is_ok():
             async with self._lock:
@@ -158,6 +231,48 @@ class CListMempool:
             if not self.config.keep_invalid_txs_in_cache:
                 self.cache.remove(tx)
         return res
+
+    async def _verify_tx_signature(self, tx: bytes) -> None:
+        """The batched mempool-admission path (tx_verify="ed25519"): the
+        tx's signature row goes to the global verify scheduler as
+        MEMPOOL-class work — it rides the next consensus/sync flush as
+        filler or the deadline worker flushes it within
+        sched_mempool_deadline. Scheduler backpressure (saturated queues
+        while consensus is busy) surfaces as ErrMempoolIsFull: admission
+        sheds load instead of queuing unboundedly."""
+        from cometbft_tpu import sched
+        from cometbft_tpu.crypto import ed25519 as _ed
+
+        if len(tx) < TX_SIG_OVERHEAD + 1:
+            self.cache.remove(tx)
+            raise ErrTxBadSignature(
+                f"tx of {len(tx)} bytes cannot carry pub+sig+payload")
+        pub, sig = tx[:TX_SIG_PUB], tx[TX_SIG_PUB:TX_SIG_OVERHEAD]
+        payload = tx[TX_SIG_OVERHEAD:]
+        try:
+            futs = sched.get().submit(
+                [(_ed.PubKey(pub), payload, sig)], klass=sched.MEMPOOL)
+        except sched.SchedulerSaturated as e:
+            self.cache.remove(tx)
+            raise ErrMempoolIsFull(f"verify scheduler saturated: {e}") from e
+        # bounded wait: the scheduler resolves within its deadline plus,
+        # worst case, one device-watchdog window (hang -> supervisor ->
+        # host oracle). A timeout here means something is deeply wrong —
+        # shed the tx rather than wedging this RPC coroutine forever.
+        from cometbft_tpu.ops import dispatch as _dispatch
+
+        try:
+            ok = await asyncio.wait_for(
+                asyncio.wrap_future(futs[0]),
+                timeout=_dispatch.watchdog_timeout() + 5.0)
+        except asyncio.TimeoutError:
+            self.cache.remove(tx)
+            raise ErrMempoolIsFull("verify scheduler timed out") from None
+        if not ok:
+            if self.metrics is not None:
+                self.metrics.failed_txs.inc()
+            self.cache.remove(tx)
+            raise ErrTxBadSignature("tx signature failed batched verification")
 
     async def wait_for_txs(self) -> None:
         """Block until the pool is non-empty (consensus txNotifier +

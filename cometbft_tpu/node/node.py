@@ -438,6 +438,20 @@ class Node(BaseService):
         if self.statesync_reactor.syncer is not None:
             self.statesync_reactor.syncer.conn = self.proxy_app.snapshot
 
+        # pre-trace the verify scheduler's bucket ladder so the first
+        # real consensus flush doesn't pay a cold device compile
+        # mid-round (no-op off the TPU backend)
+        if self.config.crypto.sched_warmup:
+            from cometbft_tpu import sched as _sched
+
+            import asyncio as _aio
+
+            cap = self.config.crypto.sched_warmup_max_lanes
+            traced = await _aio.get_running_loop().run_in_executor(
+                None, lambda: _sched.get().warmup(cap))
+            if traced:
+                self.logger.info("verify scheduler warmup", shapes=str(traced))
+
         addr = await self.transport.listen(_strip_tcp(self.config.p2p.laddr))
         self.node_info.listen_addr = addr
         await self.switch.start()

@@ -415,6 +415,7 @@ def reset_supervision() -> None:
 
 def health_snapshot() -> dict:
     """The RPC-visible crypto-health snapshot (rpc crypto_health route)."""
+    from cometbft_tpu import sched
     from cometbft_tpu.crypto import batch as crypto_batch
     from cometbft_tpu.libs import chaos
 
@@ -426,6 +427,9 @@ def health_snapshot() -> dict:
         "watchdog_timeout_seconds": _config["watchdog_timeout"],
         "supervisors": {name: sup.health() for name, sup in sups.items()},
         "chaos": chaos.snapshot(),
+        # the verify plane's batching layer: producers feed the global
+        # scheduler, the scheduler feeds these supervisors
+        "verify_sched": sched.health_snapshot(),
     }
 
 

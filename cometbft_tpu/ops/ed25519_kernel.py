@@ -259,9 +259,25 @@ from cometbft_tpu.ops.dispatch import PallasGate
 _pallas_gate = PallasGate("pallas.ed25519")
 
 
+# Device trace-count instrumentation: every lane count dispatched this
+# process is a shape XLA/Pallas compiled a program for. The scheduler's
+# bucket soak asserts len(dispatched_shapes()) stays <= the bucket-ladder
+# length — continuous batching must bound compilation, not multiply it.
+_dispatched_shapes: set[int] = set()
+
+
+def dispatched_shapes() -> list[int]:
+    return sorted(_dispatched_shapes)
+
+
+def reset_shape_log() -> None:
+    _dispatched_shapes.clear()
+
+
 def _dispatch_verify(a_dev, r_words, s_words, k_words):
     from cometbft_tpu.ops import pallas_verify as PV
 
+    _dispatched_shapes.add(int(r_words.shape[1]))
     with _dispatch_lock:
         return _pallas_gate.run(
             PV.verify_pallas, _verify_kernel,

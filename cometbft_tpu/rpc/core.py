@@ -91,7 +91,9 @@ class Environment:
 
     async def crypto_health(self, _params: dict) -> dict:
         """The device-fault resilience snapshot (no reference analog):
-        active verify backend, breaker states, retry/failure counters and
+        active verify backend, breaker states, retry/failure counters,
+        the verify scheduler's `verify_sched` section (batch fill,
+        per-class queue depth, deadline misses — sched/scheduler.py) and
         any armed chaos schedule (ops/dispatch.py health_snapshot). Served
         in inspect mode too — a crashed node's disk plus the process-global
         device state remain examinable."""

@@ -153,6 +153,7 @@ class RPCServer(BaseService):
                 if reg is not cmtmetrics.global_registry():
                     cmtmetrics.crypto_metrics()    # ensure series exist
                     cmtmetrics.netchaos_metrics()  # (net-chaos plane too)
+                    cmtmetrics.sched_metrics()     # (verify scheduler)
                     body += cmtmetrics.global_registry().render()
                 return 200, _RawText(body)
             if route == "openapi.yaml":

@@ -408,13 +408,25 @@ def stage_verify_commit_light_trusting(
     return _stage_rows(commit, rows)
 
 
-def prefetch_staged(staged: list[StagedCommitVerification]) -> None:
+def prefetch_staged(staged: list[StagedCommitVerification],
+                    klass: str | None = None) -> None:
     """Resolve every staged commit in the window with ONE device batch:
     the window's rows concatenate into a single transfer + kernel dispatch +
     device->host fetch, then the combined mask is sliced back per commit.
     Subsequent finish() calls are pure host work (per-commit error isolation
     stays with the caller). Pre-dispatched device_thunk items are resolved
-    alongside with the same single fetch."""
+    alongside with the same single fetch.
+
+    With the global verify scheduler enabled (the default) the window is
+    submitted to it instead — one group per commit, so each keeps its own
+    host-oracle recheck budget — under `klass` (default SYNC: blocksync
+    and light-client windows yield the device to consensus flushes), and
+    queued mempool-admission work rides the same batch as filler."""
+    from cometbft_tpu import sched
+
+    if sched.enabled():
+        _prefetch_via_scheduler(staged, klass or sched.SYNC)
+        return
     from cometbft_tpu.ops import ed25519_kernel
 
     rows = [s for s in staged
@@ -464,6 +476,46 @@ def prefetch_staged(staged: list[StagedCommitVerification]) -> None:
             off += n
     for s, m in zip(pre, resolved[:n_pre]):
         s._mask = m
+
+
+def _prefetch_via_scheduler(staged: list[StagedCommitVerification],
+                            klass: str) -> None:
+    """Scheduler-side window resolution: every unresolved staged commit
+    (device-staged ed rows AND host-staged cpu rows — the scheduler picks
+    the backend per dispatch, so a CPU-backend window still coalesces)
+    becomes one scheduler group; pre-dispatched device thunks resolve
+    alongside through the kernel fetch path as before."""
+    from cometbft_tpu import sched
+    from cometbft_tpu.ops import ed25519_kernel
+
+    pre = [s for s in staged
+           if s.device_thunk is not None and s._mask is None and not s._passed]
+    todo: list[StagedCommitVerification] = []
+    rowlists: list[list] = []
+    for s in staged:
+        if s._passed or s._mask is not None or s.device_thunk is not None:
+            continue
+        if s._ed_rows is not None:
+            from cometbft_tpu.crypto import ed25519 as _ed
+
+            pubs_b, msgs, sigs = s._ed_rows
+            rows = [(_ed.PubKey(p), m, g)
+                    for p, m, g in zip(pubs_b, msgs, sigs)]
+        elif s._cpu_rows is not None:
+            pubs, msgs, sigs = s._cpu_rows
+            rows = list(zip(pubs, msgs, sigs))
+        else:
+            continue
+        todo.append(s)
+        rowlists.append(rows)
+    if rowlists:
+        masks = sched.get().verify_many(rowlists, klass)
+        for s, mask in zip(todo, masks):
+            s._mask = mask
+    if pre:
+        resolved = ed25519_kernel.resolve_batches([s.device_thunk for s in pre])
+        for s, m in zip(pre, resolved):
+            s._mask = m
 
 
 def resolve_staged(staged: list[StagedCommitVerification]) -> None:

@@ -48,6 +48,20 @@ def _pinned_set_backend(backend: str) -> None:
 crypto_batch.set_backend = _pinned_set_backend
 
 
+@pytest.fixture
+def sched_rng(request):
+    """xdist-safe deterministic RNG for scheduler tests: seeded from the
+    test's nodeid alone, so every worker (and every rerun) of a given
+    test sees the same stream, no worker shares mutable global random
+    state, and two different tests never correlate."""
+    import hashlib
+    import random
+
+    seed = int.from_bytes(
+        hashlib.sha256(request.node.nodeid.encode()).digest()[:8], "big")
+    return random.Random(seed)
+
+
 @pytest.fixture(scope="session")
 def jax_cpu_devices():
     devs = jax.devices("cpu")

@@ -1,0 +1,320 @@
+"""CListMempool unit coverage (mempool/mempool.py).
+
+The mempool had no dedicated test file: TxCache push/evict/remove, the
+structural-reject paths (ErrMempoolIsFull / ErrTxTooLarge), reap budget
+bounds, update()-triggered recheck, the in-flight duplicate-CheckTx dedup
+(one ABCI round-trip for concurrent identical submissions), and the
+scheduler-batched tx_verify admission gate.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.mempool.mempool import (
+    CListMempool,
+    ErrMempoolIsFull,
+    ErrTxBadSignature,
+    ErrTxInCache,
+    ErrTxTooLarge,
+    MempoolConfig,
+    TxCache,
+)
+from cometbft_tpu.types.block import tx_hash
+
+
+class StubApp:
+    """Minimal async ABCI mempool connection: programmable verdicts, a
+    call counter, and an optional gate to hold CheckTx in flight."""
+
+    def __init__(self):
+        self.calls: list[tuple[bytes, abci.CheckTxType]] = []
+        self.reject: set[bytes] = set()  # txs to reject
+        self.gas: int = 1
+        self.gate: asyncio.Event | None = None
+
+    async def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        self.calls.append((req.tx, req.type_))
+        if self.gate is not None:
+            await self.gate.wait()
+        code = 1 if req.tx in self.reject else abci.CODE_TYPE_OK
+        return abci.ResponseCheckTx(code=code, gas_wanted=self.gas)
+
+
+def _mk(config: MempoolConfig | None = None) -> tuple[CListMempool, StubApp]:
+    app = StubApp()
+    return CListMempool(config or MempoolConfig(), app), app
+
+
+# ------------------------------------------------------------------ cache
+
+
+class TestTxCache:
+    def test_push_dedup_and_remove(self):
+        c = TxCache(4)
+        assert c.push(b"a") and not c.push(b"a")
+        assert c.has(b"a")
+        c.remove(b"a")
+        assert not c.has(b"a")
+        assert c.push(b"a")
+
+    def test_lru_eviction_order(self):
+        c = TxCache(2)
+        c.push(b"a")
+        c.push(b"b")
+        c.push(b"a")  # refresh: "a" now most recent
+        c.push(b"c")  # evicts "b", the least recent
+        assert c.has(b"a") and c.has(b"c") and not c.has(b"b")
+
+    def test_reset(self):
+        c = TxCache(2)
+        c.push(b"a")
+        c.reset()
+        assert not c.has(b"a")
+
+
+# ---------------------------------------------------------------- checktx
+
+
+class TestCheckTx:
+    def test_admit_and_duplicate_rejected(self):
+        async def run():
+            mp, app = _mk()
+            res = await mp.check_tx(b"tx-1", sender="p1")
+            assert res.is_ok() and mp.size() == 1
+            with pytest.raises(ErrTxInCache):
+                await mp.check_tx(b"tx-1")
+            assert len(app.calls) == 1
+
+        asyncio.run(run())
+
+    def test_too_large(self):
+        async def run():
+            mp, app = _mk(MempoolConfig(max_tx_bytes=4))
+            with pytest.raises(ErrTxTooLarge):
+                await mp.check_tx(b"12345")
+            assert not app.calls and mp.size() == 0
+
+        asyncio.run(run())
+
+    def test_full_by_count_and_bytes(self):
+        async def run():
+            mp, _ = _mk(MempoolConfig(size=1))
+            await mp.check_tx(b"tx-1")
+            with pytest.raises(ErrMempoolIsFull):
+                await mp.check_tx(b"tx-2")
+            mp2, _ = _mk(MempoolConfig(max_txs_bytes=6))
+            await mp2.check_tx(b"1234")
+            with pytest.raises(ErrMempoolIsFull):
+                await mp2.check_tx(b"5678")
+
+        asyncio.run(run())
+
+    def test_app_reject_leaves_cache_unless_configured(self):
+        async def run():
+            mp, app = _mk()
+            app.reject.add(b"bad")
+            res = await mp.check_tx(b"bad")
+            assert not res.is_ok() and mp.size() == 0
+            assert not mp.cache.has(b"bad")  # resubmittable
+            mp2, app2 = _mk(MempoolConfig(keep_invalid_txs_in_cache=True))
+            app2.reject.add(b"bad")
+            await mp2.check_tx(b"bad")
+            assert mp2.cache.has(b"bad")
+            with pytest.raises(ErrTxInCache):
+                await mp2.check_tx(b"bad")
+
+        asyncio.run(run())
+
+
+class TestInflightDedup:
+    def test_concurrent_duplicate_resolves_from_first(self):
+        """A duplicate submitted while the first CheckTx is in flight gets
+        the FIRST result — one ABCI round-trip total, not two and not an
+        ErrTxInCache race."""
+
+        async def run():
+            mp, app = _mk()
+            app.gate = asyncio.Event()
+            t1 = asyncio.create_task(mp.check_tx(b"tx-dup", sender="p1"))
+            await asyncio.sleep(0.01)  # t1 is parked inside the app call
+            t2 = asyncio.create_task(mp.check_tx(b"tx-dup", sender="p2"))
+            await asyncio.sleep(0.01)
+            app.gate.set()
+            r1, r2 = await asyncio.gather(t1, t2)
+            assert r1 is r2 and r1.is_ok()
+            assert len(app.calls) == 1
+            assert mp.size() == 1
+            assert not mp._inflight
+
+        asyncio.run(run())
+
+    def test_first_cancelled_does_not_poison_duplicate(self):
+        """Cancelling the first submitter must not surface a foreign
+        CancelledError in a healthy duplicate waiter — the dup falls back
+        to the normal path (ErrTxInCache, the pre-dedup behavior)."""
+
+        async def run():
+            mp, app = _mk()
+            app.gate = asyncio.Event()
+            t1 = asyncio.create_task(mp.check_tx(b"tx-can"))
+            await asyncio.sleep(0.01)
+            t2 = asyncio.create_task(mp.check_tx(b"tx-can"))
+            await asyncio.sleep(0.01)
+            t1.cancel()
+            r1, r2 = await asyncio.gather(t1, t2, return_exceptions=True)
+            assert isinstance(r1, asyncio.CancelledError)
+            assert isinstance(r2, ErrTxInCache)
+            assert not mp._inflight
+
+        asyncio.run(run())
+
+    def test_error_from_first_propagates_to_duplicate(self):
+        async def run():
+            mp, app = _mk()
+            app.gate = asyncio.Event()
+
+            async def boom(req):
+                app.calls.append((req.tx, req.type_))
+                await app.gate.wait()
+                raise RuntimeError("app conn died")
+
+            app.check_tx = boom
+            t1 = asyncio.create_task(mp.check_tx(b"tx-err"))
+            await asyncio.sleep(0.01)
+            t2 = asyncio.create_task(mp.check_tx(b"tx-err"))
+            await asyncio.sleep(0.01)
+            app.gate.set()
+            r = await asyncio.gather(t1, t2, return_exceptions=True)
+            assert all(isinstance(x, RuntimeError) for x in r)
+            assert len(app.calls) == 1
+            assert not mp._inflight
+
+        asyncio.run(run())
+
+
+class TestTxVerifyGate:
+    """The batched mempool-admission path: tx signatures verify through
+    the global verify scheduler BEFORE the ABCI round-trip."""
+
+    @staticmethod
+    def _signed_tx(payload: bytes, priv=None, forge: bool = False) -> bytes:
+        priv = priv or ed25519.gen_priv_key()
+        sig = priv.sign(payload if not forge else payload + b"!")
+        return priv.pub_key().bytes_() + sig + payload
+
+    def test_valid_signature_admitted(self):
+        async def run():
+            mp, app = _mk(MempoolConfig(tx_verify="ed25519"))
+            res = await mp.check_tx(self._signed_tx(b"pay-1"))
+            assert res.is_ok() and mp.size() == 1 and len(app.calls) == 1
+
+        asyncio.run(run())
+
+    def test_bad_signature_rejected_before_abci(self):
+        async def run():
+            mp, app = _mk(MempoolConfig(tx_verify="ed25519"))
+            tx = self._signed_tx(b"pay-2", forge=True)
+            with pytest.raises(ErrTxBadSignature):
+                await mp.check_tx(tx)
+            assert not app.calls  # never bought an ABCI round-trip
+            assert not mp.cache.has(tx)  # resubmittable after a fix
+
+        asyncio.run(run())
+
+    def test_structurally_short_tx_rejected(self):
+        async def run():
+            mp, app = _mk(MempoolConfig(tx_verify="ed25519"))
+            with pytest.raises(ErrTxBadSignature):
+                await mp.check_tx(b"way-too-short")
+            assert not app.calls
+
+        asyncio.run(run())
+
+    def test_config_validates_scheme(self):
+        with pytest.raises(ValueError):
+            MempoolConfig(tx_verify="rsa").validate_basic()
+        MempoolConfig(tx_verify="ed25519").validate_basic()
+
+
+# ------------------------------------------------------------------- reap
+
+
+class TestReap:
+    def _filled(self):
+        async def run():
+            mp, app = _mk()
+            app.gas = 2
+            for i in range(5):
+                await mp.check_tx(b"tx-%d" % i)  # 4 bytes each, gas 2
+            return mp
+
+        return asyncio.run(run())
+
+    def test_reap_byte_budget(self):
+        mp = self._filled()
+        out = mp.reap_max_bytes_max_gas(9, -1)  # 2 txs of 4 bytes fit
+        assert out == [b"tx-0", b"tx-1"]
+
+    def test_reap_gas_budget(self):
+        mp = self._filled()
+        out = mp.reap_max_bytes_max_gas(-1, 5)  # 2 txs of gas 2 fit
+        assert out == [b"tx-0", b"tx-1"]
+
+    def test_reap_unlimited_and_max_txs(self):
+        mp = self._filled()
+        assert len(mp.reap_max_bytes_max_gas(-1, -1)) == 5
+        assert mp.reap_max_txs(2) == [b"tx-0", b"tx-1"]
+        assert len(mp.reap_max_txs(-1)) == 5
+
+
+# ----------------------------------------------------------------- update
+
+
+class TestUpdate:
+    def test_update_removes_committed_and_rechecks(self):
+        async def run():
+            mp, app = _mk()
+            for i in range(3):
+                await mp.check_tx(b"tx-%d" % i)
+            app.calls.clear()
+            # tx-1 committed OK; tx-2 will fail its RECHECK
+            app.reject.add(b"tx-2")
+            await mp.update(
+                2, [b"tx-1"], [abci.ExecTxResult(code=abci.CODE_TYPE_OK)])
+            assert mp.height == 2
+            # committed tx gone; recheck dropped the now-invalid one
+            assert [m.tx for m in mp.iter_txs()] == [b"tx-0"]
+            recheck = [c for c in app.calls if c[1] == abci.CheckTxType.RECHECK]
+            assert {c[0] for c in recheck} == {b"tx-0", b"tx-2"}
+            assert mp.size_bytes() == 4
+            # committed-valid stays cached for dedup
+            with pytest.raises(ErrTxInCache):
+                await mp.check_tx(b"tx-1")
+
+        asyncio.run(run())
+
+    def test_update_failed_tx_leaves_cache(self):
+        async def run():
+            mp, _ = _mk(MempoolConfig(recheck=False))
+            await mp.check_tx(b"tx-f")
+            await mp.update(2, [b"tx-f"], [abci.ExecTxResult(code=7)])
+            # failed on commit: uncached so it can be resubmitted
+            assert not mp.cache.has(b"tx-f")
+            assert mp.size() == 0
+
+        asyncio.run(run())
+
+    def test_flush(self):
+        async def run():
+            mp, _ = _mk()
+            await mp.check_tx(b"tx-0")
+            await mp.flush()
+            assert mp.size() == 0 and mp.size_bytes() == 0
+            assert not mp.cache.has(b"tx-0")
+
+        asyncio.run(run())
