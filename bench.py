@@ -280,6 +280,17 @@ def bench_mixed_megacommit(detail: dict) -> None:
     run()  # warm both kernels' compiles
     detail["mixed_megacommit_ms"] = round(min(run() for _ in range(3)) * 1e3, 2)
     detail["mixed_megacommit_shape"] = f"{n_half} ed25519 + {n_half} sr25519"
+    # reduced-fetch accounting: a happy window resolves from the 8-byte
+    # headers; the full per-lane masks cross the tunnel only on failure
+    from cometbft_tpu.ops import ed25519_kernel as _EK
+
+    _EK.reset_fetch_stats()
+    run()
+    _fs = _EK.fetch_stats()
+    if _fs["happy_fetches"]:
+        detail["fetch_bytes_happy_path"] = (
+            _fs["happy_bytes"] // _fs["happy_fetches"])
+    detail["fetch_stats"] = _fs
     # decomposition: host staging (pure host work, measured directly) vs
     # device compute (rep-differenced below) vs the ~89 ms tunnel RTT the
     # synchronous mask fetch pays on this dev box. staging+device is the
@@ -314,6 +325,14 @@ def bench_mixed_megacommit(detail: dict) -> None:
         "ed25519": round(t_ed_stage * 1e3, 1),
         "sr25519": round(t_sr_stage * 1e3, 1),
     }
+    detail["staging_us_per_row"] = {
+        "ed25519": round(t_ed_stage / n_half * 1e6, 2),
+        "sr25519": round(t_sr_stage / n_half * 1e6, 2),
+    }
+    from cometbft_tpu.ops import hashvec as _hv
+
+    detail["hashvec_native"] = _hv.native_available()
+    detail["hashvec_rows"] = _hv.stats()
     # per-row Merlin challenge cost (native batch path), for comparison
     # with r4's 0.03 ms/row ctypes-per-op number
     t0 = time.perf_counter()

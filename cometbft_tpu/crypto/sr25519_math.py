@@ -32,6 +32,7 @@ cross-check transcript bytes end-to-end.
 from __future__ import annotations
 
 import hashlib
+import os
 import secrets as _secrets
 
 from cometbft_tpu.crypto import ed25519_math as ed
@@ -230,6 +231,134 @@ class Strobe128:
             self.pos += 1
             if self.pos == _STROBE_R:
                 self._run_f()
+
+
+class BatchStrobe128:
+    """N STROBE-128 sponges advancing in lockstep — the batch-axis analog
+    of Strobe128 for transcripts whose OP SEQUENCE is identical across
+    rows (every verification challenge of a commit runs the same Merlin
+    ops; only the absorbed bytes differ per row). State is an (N, 200)
+    uint8 array whose (N, 25)-uint64 view advances under ONE batched
+    Keccak-f[1600] permutation (ops/hashvec.py: native SIMD when
+    available, else the numpy batch rung). pos/pos_begin/cur_flags stay
+    scalars because the op sequence — and therefore every duplex
+    position — is shared by construction.
+
+    Bit-for-bit equal to Strobe128 on every row (tests/test_hashvec.py
+    fuzzes arbitrary op sequences against the serial class)."""
+
+    __slots__ = ("n", "state", "pos", "pos_begin", "cur_flags")
+
+    def __init__(self, n: int, protocol_label: bytes):
+        import numpy as np
+
+        self.n = n
+        self.state = np.zeros((n, 200), dtype=np.uint8)
+        seed = (b"\x01" + bytes([_STROBE_R + 2]) + b"\x01\x00\x01\x60"
+                + b"STROBEv1.0.2")
+        self.state[:, :len(seed)] = np.frombuffer(seed, dtype=np.uint8)
+        self._perm()
+        self.pos = 0
+        self.pos_begin = 0
+        self.cur_flags = 0
+        self.meta_ad(protocol_label, False)
+
+    @classmethod
+    def from_snapshot(cls, n: int, snap: tuple) -> "BatchStrobe128":
+        """Broadcast a single-row snapshot (shared transcript prefix) to
+        N lockstep rows."""
+        import numpy as np
+
+        bs = cls.__new__(cls)
+        state_row, bs.pos, bs.pos_begin, bs.cur_flags = snap
+        bs.n = n
+        bs.state = np.broadcast_to(state_row, (n, 200)).copy()
+        return bs
+
+    def snapshot(self) -> tuple:
+        """Row-0 state + duplex position (rows are identical until
+        row-dependent data is absorbed)."""
+        return (self.state[0].copy(), self.pos, self.pos_begin,
+                self.cur_flags)
+
+    # --- duplex plumbing (mirrors Strobe128 exactly)
+
+    def _perm(self) -> None:
+        from cometbft_tpu.ops import hashvec
+
+        hashvec.keccak_f1600_many(self.state.view("<u8"))
+
+    def _run_f(self) -> None:
+        self.state[:, self.pos] ^= self.pos_begin
+        self.state[:, self.pos + 1] ^= 0x04
+        self.state[:, _STROBE_R + 1] ^= 0x80
+        self._perm()
+        self.pos = 0
+        self.pos_begin = 0
+
+    def _chunks(self, m: int):
+        """Yield (offset, count) absorb/squeeze spans between permutation
+        boundaries — the batched replacement for the per-byte loop."""
+        off = 0
+        while off < m:
+            c = min(_STROBE_R - self.pos, m - off)
+            yield off, c
+            self.pos += c
+            off += c
+            if self.pos == _STROBE_R:
+                self._run_f()
+
+    def _as_rows(self, data):
+        """bytes (broadcast to all rows) or (N, m) uint8 array."""
+        import numpy as np
+
+        if isinstance(data, (bytes, bytearray)):
+            return np.frombuffer(bytes(data), dtype=np.uint8)[None, :], len(data)
+        assert data.shape[0] == self.n and data.dtype == np.uint8
+        return data, data.shape[1]
+
+    def _absorb(self, data) -> None:
+        rows, m = self._as_rows(data)
+        for off, c in self._chunks(m):
+            self.state[:, self.pos:self.pos + c] ^= rows[:, off:off + c]
+
+    def _begin_op(self, flags: int, more: bool) -> None:
+        if more:
+            assert self.cur_flags == flags, "STROBE: inconsistent `more` flags"
+            return
+        assert not (flags & _FLAG_T), "STROBE: T flag not implemented"
+        old_begin = self.pos_begin
+        self.pos_begin = self.pos + 1
+        self.cur_flags = flags
+        self._absorb(bytes([old_begin, flags]))
+        if (flags & (_FLAG_C | _FLAG_K)) and self.pos != 0:
+            self._run_f()
+
+    # --- merlin's ops
+
+    def meta_ad(self, data, more: bool) -> None:
+        self._begin_op(_FLAG_M | _FLAG_A, more)
+        self._absorb(data)
+
+    def ad(self, data, more: bool) -> None:
+        self._begin_op(_FLAG_A, more)
+        self._absorb(data)
+
+    def prf(self, n: int, more: bool = False):
+        import numpy as np
+
+        self._begin_op(_FLAG_I | _FLAG_A | _FLAG_C, more)
+        out = np.empty((self.n, n), dtype=np.uint8)
+        for off, c in self._chunks(n):
+            out[:, off:off + c] = self.state[:, self.pos:self.pos + c]
+            self.state[:, self.pos:self.pos + c] = 0
+        return out
+
+    def key(self, data, more: bool = False) -> None:
+        self._begin_op(_FLAG_A | _FLAG_C, more)
+        rows, m = self._as_rows(data)
+        for off, c in self._chunks(m):
+            self.state[:, self.pos:self.pos + c] = rows[:, off:off + c]
 
 
 class Transcript:
@@ -451,14 +580,126 @@ def compute_challenge(pub: bytes, r_bytes: bytes, msg: bytes) -> int:
     return t.challenge_scalar(b"sign:c")
 
 
+# shared transcript prefix per message length: everything up to (and
+# including) the "sign-bytes" length header is row-independent, so it runs
+# once on a 1-row batch sponge and broadcasts (bounded cache; commit
+# sign-bytes lengths are few per chain)
+_PREFIX_CACHE: dict[int, tuple] = {}
+
+
+def _signing_prefix(mlen: int) -> tuple:
+    snap = _PREFIX_CACHE.get(mlen)
+    if snap is None:
+        bs = BatchStrobe128(1, Transcript.MERLIN_LABEL)
+        for label, msg in ((b"dom-sep", b"SigningContext"), (b"", b"")):
+            bs.meta_ad(label, False)
+            bs.meta_ad(len(msg).to_bytes(4, "little"), True)
+            bs.ad(msg, False)
+        bs.meta_ad(b"sign-bytes", False)
+        bs.meta_ad(mlen.to_bytes(4, "little"), True)
+        snap = bs.snapshot()
+        if len(_PREFIX_CACHE) >= 256:
+            _PREFIX_CACHE.pop(next(iter(_PREFIX_CACHE)))
+        _PREFIX_CACHE[mlen] = snap
+    return snap
+
+
+def _batch_challenge_digests(pub_rows, r_rows, msg_rows):
+    """(N, 32)/(N, 32)/(N, mlen) uint8 rows -> (N, 64) uint8 challenge
+    bytes: the whole Merlin verification transcript advanced in lockstep,
+    two batched permutations per row instead of a per-row sponge."""
+    n = pub_rows.shape[0]
+    bs = BatchStrobe128.from_snapshot(n, _signing_prefix(msg_rows.shape[1]))
+    bs.ad(msg_rows, False)
+    for label, msg in ((b"proto-name", b"Schnorr-sig"),):
+        bs.meta_ad(label, False)
+        bs.meta_ad(len(msg).to_bytes(4, "little"), True)
+        bs.ad(msg, False)
+    for label, rows in ((b"sign:pk", pub_rows), (b"sign:R", r_rows)):
+        bs.meta_ad(label, False)
+        bs.meta_ad((32).to_bytes(4, "little"), True)
+        bs.ad(rows, False)
+    bs.meta_ad(b"sign:c", False)
+    bs.meta_ad((64).to_bytes(4, "little"), True)
+    return bs.prf(64)
+
+
+def batch_challenge_words(
+    pubs: list[bytes], r_list: list[bytes], msgs: list[bytes]
+):
+    """All N verification challenges as packed (N, 8) uint32 device words
+    (k mod L, little-endian) — the staging fast path. Rows group by
+    message length; each group of VEC_MIN_ROWS+ advances under the batch
+    STROBE transcript (one permutation call per duplex boundary for the
+    WHOLE group); ragged stragglers fall back to the serial rung
+    (native strobe.c batch, else per-row Python). Bit-for-bit equal to
+    compute_challenge on every row."""
+    import numpy as np
+
+    n = len(pubs)
+    r_rows = (np.frombuffer(b"".join(r_list), dtype=np.uint8).reshape(n, 32)
+              if n else np.zeros((0, 32), dtype=np.uint8))
+    return batch_challenge_words_rows(pubs, r_rows, msgs)
+
+
+def batch_challenge_words_rows(pubs: list[bytes], r_rows, msgs: list[bytes]):
+    """Array-native batch_challenge_words: R as the staged (N, 32) uint8
+    signature halves (no per-row bytes round trip — sr25519_kernel's
+    staging path feeds signature rows straight in)."""
+    import numpy as np
+
+    from cometbft_tpu.ops import hashvec
+
+    n = len(pubs)
+    out = np.zeros((n, 8), dtype=np.uint32)
+    if n == 0:
+        return out
+    by_len: dict[int, list[int]] = {}
+    for i, m in enumerate(msgs):
+        by_len.setdefault(len(m), []).append(i)
+    for mlen, idxs in by_len.items():
+        if (len(idxs) < hashvec.VEC_MIN_ROWS
+                or os.environ.get("CBFT_HASHVEC") == "serial"):
+            ks = _serial_compute_challenges(
+                [pubs[i] for i in idxs], [r_rows[i].tobytes() for i in idxs],
+                [msgs[i] for i in idxs])
+            blob = b"".join(k.to_bytes(32, "little") for k in ks)
+            out[np.asarray(idxs, dtype=np.intp)] = np.frombuffer(
+                blob, dtype=np.uint8).reshape(len(idxs), 32).view("<u4")
+            continue
+        sel = np.asarray(idxs, dtype=np.intp)
+        pub_rows = np.frombuffer(
+            b"".join(pubs[i] for i in idxs), dtype=np.uint8).reshape(-1, 32)
+        msg_rows = np.frombuffer(
+            b"".join(msgs[i] for i in idxs), dtype=np.uint8).reshape(-1, mlen)
+        digests = _batch_challenge_digests(
+            pub_rows, np.ascontiguousarray(r_rows[sel]), msg_rows)
+        out[sel] = hashvec.reduce512_mod_l(digests)
+    return out
+
+
 def batch_compute_challenges(
     pubs: list[bytes], r_list: list[bytes], msgs: list[bytes]
 ) -> list[int]:
-    """All N verification challenges in one native call (strobe.c
-    sr25519_batch_challenge): the whole Merlin transcript per row runs in C,
-    so the per-row cost is keccak-bound, not ctypes-bound. Equivalence with
-    compute_challenge is asserted by tests/test_sr25519.py. Falls back to
-    the per-row path without the native library."""
+    """All N verification challenges as ints. Routed through the batch
+    STROBE transcript (batch_challenge_words) for uniform-length groups;
+    serial rung otherwise. Equivalence with compute_challenge is asserted
+    by tests/test_sr25519.py and tests/test_hashvec.py."""
+    n = len(pubs)
+    if n == 0:
+        return []
+    blob = batch_challenge_words(pubs, r_list, msgs).tobytes()
+    return [int.from_bytes(blob[32 * i: 32 * i + 32], "little")
+            for i in range(n)]
+
+
+def _serial_compute_challenges(
+    pubs: list[bytes], r_list: list[bytes], msgs: list[bytes]
+) -> list[int]:
+    """The serial rung: one native call for the whole batch (strobe.c
+    sr25519_batch_challenge — the whole Merlin transcript per row runs in
+    C, so the per-row cost is keccak-bound, not ctypes-bound), else the
+    per-row Python path."""
     n = len(pubs)
     if n == 0:
         return []

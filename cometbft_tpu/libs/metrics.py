@@ -311,6 +311,23 @@ class CryptoMetrics:
             "crypto", "fallback_verifies",
             "Signature lanes verified on the CPU ladder after a device "
             "failure", labels=("scheme",))
+        # staging plane (ops/hashvec + reduced-fetch protocol): how often
+        # the happy path keeps the mask off the tunnel, and how the
+        # decompressed-pubkey cache is doing
+        self.verify_fetches = reg.counter(
+            "crypto", "verify_fetches",
+            "Device->host verify result fetches by path (happy = 8-byte "
+            "header only; full = header + per-lane payload)",
+            labels=("path",))
+        self.verify_fetch_bytes = reg.counter(
+            "crypto", "verify_fetch_bytes",
+            "Bytes transferred by verify result fetches, by path",
+            labels=("path",))
+        self.pubkey_cache_events = reg.counter(
+            "crypto", "pubkey_cache_events",
+            "Decompressed-pubkey cache hits/misses/evictions per level "
+            "(host bytes->coords FIFO; device-resident digest slots)",
+            labels=("level", "event"))
 
 
 class SchedMetrics:

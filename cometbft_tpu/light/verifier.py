@@ -157,8 +157,11 @@ def verify_non_adjacent(
     # and +2/3 of the NEW set over the same commit — are staged on the
     # device together and resolved with ONE fetch (the sync path paid two
     # sequential round trips per hop; over a high-RTT link that dominated
-    # bisection wall time). Power thresholds still raise synchronously at
-    # staging, with the reference's error mapping preserved.
+    # bisection wall time). With the reduced-fetch protocol that one fetch
+    # is 8 bytes/batch of headers on the happy path — the per-lane masks
+    # cross the tunnel only when a commit actually fails. Power thresholds
+    # still raise synchronously at staging, with the reference's error
+    # mapping preserved.
     #
     # DoS guard (verifier.go:69-72 ordering): untrusted_vals is attacker-
     # chosen, so the coalesced form only runs when the new set is within a

@@ -421,7 +421,7 @@ def health_snapshot() -> dict:
 
     with _registry_lock:
         sups = dict(_supervisors)
-    return {
+    snap = {
         "configured_backend": crypto_batch.get_backend(),
         "active_backend": crypto_batch.resolve_backend(),
         "watchdog_timeout_seconds": _config["watchdog_timeout"],
@@ -431,6 +431,23 @@ def health_snapshot() -> dict:
         # scheduler, the scheduler feeds these supervisors
         "verify_sched": sched.health_snapshot(),
     }
+    try:
+        # staging plane: hash rung usage, reduced-fetch happy/full split,
+        # pubkey cache hit rates, staging-buffer pool reuse
+        from cometbft_tpu.ops import ed25519_kernel as _ek
+        from cometbft_tpu.ops import hashvec as _hv
+        from cometbft_tpu.ops import limbs as _limbs
+
+        snap["staging"] = {
+            "hashvec_native": _hv.native_available(),
+            "hashvec_rows": _hv.stats(),
+            "fetch": _ek.fetch_stats(),
+            "pubkey_cache": _ek.cache_stats(),
+            "staging_pool": _limbs.POOL.stats(),
+        }
+    except Exception:  # noqa: BLE001 - health must render even mid-import
+        pass
+    return snap
 
 
 class PallasGate:

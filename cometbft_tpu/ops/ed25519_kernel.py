@@ -31,6 +31,7 @@ verify as valid and are sliced off.
 from __future__ import annotations
 
 import hashlib
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -91,6 +92,18 @@ def verify_math(ax, ay, az, at, r_words, s_words, k_words) -> jnp.ndarray:
 
 
 _verify_kernel = jax.jit(verify_math)
+
+
+def verify_math_ok(ax, ay, az, at, r_words, s_words, k_words):
+    """verify_math plus the device-side all-ok reduction the reduced-fetch
+    header rides on (padding lanes carry the identity encoding and verify
+    valid, so all() over the padded batch equals all() over the live
+    lanes). XLA counterpart of pallas_verify.verify_pallas_ok."""
+    mask = verify_math(ax, ay, az, at, r_words, s_words, k_words)
+    return mask, mask.all()
+
+
+_verify_kernel_ok = jax.jit(verify_math_ok)
 
 # Pallas path: the fused-VMEM ladder (pallas_verify.py) is ~2.5x the
 # XLA-compiled program on real TPU (HBM-bound vs VMEM-resident). Enabled
@@ -168,12 +181,87 @@ def _device_checksum_expr(arrs) -> jnp.ndarray:
 _device_checksum = jax.jit(_device_checksum_expr)
 
 
+# ---------------------------------------------------------------------------
+# Reduced-fetch protocol. The happy-path mask fetch used to pull the full
+# (2B+1,) payload — ~20 KB and a full tunnel RTT for bytes that are almost
+# always all-true. The kernels now additionally emit a (2,) uint32 HEADER
+# folding the all-ok verdict into the staging checksum:
+#
+#   token = device_checksum ^ (OK_MAGIC if every lane verified AND the
+#           staged bytes checksummed else BAD_MAGIC);   header = [token, ~token]
+#
+# The host knows the expected checksum, so 8 fetched bytes prove "staged
+# bytes arrived intact and every lane verified" — the full per-lane payload
+# is pulled only when the header says otherwise (a failing lane, a staging
+# checksum mismatch, or a mangled header fetch, each distinguished by
+# decode_header). The complement echo gives the header the same
+# corruption-detection plane as the full mask fetch; a corrupted header
+# degrades to the full fetch, never to a wrong verdict.
+# ---------------------------------------------------------------------------
+
+OK_MAGIC = np.uint32(0x600DFA57)
+_BAD_MAGIC = np.uint32(~0x600DFA57 & 0xFFFFFFFF)
+
+
 @jax.jit
-def _integrity_payload(mask, rw, sw, kw, expected):
-    """(2B+1,) bool payload: [mask, ~mask (echo), staging-checksum ok]."""
+def _integrity_parts(mask, allok, rw, sw, kw, expected):
+    """-> ((2,) uint32 reduced-fetch header, (2B+1,) bool full payload
+    [mask, ~mask (echo), staging-checksum ok])."""
     chk = _device_checksum_expr((rw, sw, kw))
-    ok = (chk == expected.astype(jnp.uint32))
-    return jnp.concatenate([mask, ~mask, ok[None]])
+    ok = chk == expected.astype(jnp.uint32)
+    payload = jnp.concatenate([mask, ~mask, ok[None]])
+    tok = chk ^ jnp.where(allok & ok, OK_MAGIC, _BAD_MAGIC)
+    return jnp.stack([tok, ~tok]), payload
+
+
+def decode_header(header: np.ndarray, expected) -> str:
+    """Header verdicts: "happy" (staging intact, every lane valid — the
+    per-lane mask need not cross the tunnel), "full" (device and staging
+    fine, some lane failed: pull the mask), "chk_mismatch" (the device saw
+    different staged bytes than the host sent), "echo_corrupt" (the header
+    itself was mangled on the fetch — its complement disagreed)."""
+    h0, h1 = int(header[0]), int(header[1])
+    if h1 != (~h0 & 0xFFFFFFFF):
+        return "echo_corrupt"
+    exp = int(expected)
+    if h0 == exp ^ int(OK_MAGIC):
+        return "happy"
+    if h0 == exp ^ int(_BAD_MAGIC):
+        return "full"
+    return "chk_mismatch"
+
+
+# happy/full fetch accounting (bench emits fetch_bytes_happy_path from
+# this; crypto_health surfaces it next to the hashvec rung counters)
+_fetch_lock = threading.Lock()
+_fetch_stats = {"happy_fetches": 0, "full_fetches": 0,
+                "happy_bytes": 0, "full_bytes": 0}
+
+
+def _count_fetch(happy: bool, nbytes: int) -> None:
+    key = "happy" if happy else "full"
+    with _fetch_lock:
+        _fetch_stats[key + "_fetches"] += 1
+        _fetch_stats[key + "_bytes"] += nbytes
+    try:
+        from cometbft_tpu.libs import metrics as _metrics
+
+        cm = _metrics.crypto_metrics()
+        cm.verify_fetches.labels(key).inc()
+        cm.verify_fetch_bytes.labels(key).inc(nbytes)
+    except Exception:  # noqa: BLE001 - metrics must never break verification
+        pass
+
+
+def fetch_stats() -> dict:
+    with _fetch_lock:
+        return dict(_fetch_stats)
+
+
+def reset_fetch_stats() -> None:
+    with _fetch_lock:
+        for k in _fetch_stats:
+            _fetch_stats[k] = 0
 
 
 def host_oracle_mask(n, pre_ok, ok_a, rows, info) -> np.ndarray:
@@ -275,12 +363,13 @@ def reset_shape_log() -> None:
 
 
 def _dispatch_verify(a_dev, r_words, s_words, k_words):
+    """-> ((B,) mask, () all-ok scalar), both device-resident."""
     from cometbft_tpu.ops import pallas_verify as PV
 
     _dispatched_shapes.add(int(r_words.shape[1]))
     with _dispatch_lock:
         return _pallas_gate.run(
-            PV.verify_pallas, _verify_kernel,
+            PV.verify_pallas_ok, _verify_kernel_ok,
             (*a_dev, r_words, s_words, k_words), r_words.shape[1])
 
 
@@ -324,16 +413,42 @@ class PubKeyCache:
         self.device_slots = device_slots
         self._map: dict[bytes, tuple[bool, np.ndarray]] = {}
         self._dev: dict[bytes, tuple] = {}
+        # hit/miss/eviction counters per level (host bytes->coords FIFO vs
+        # device-resident digest slots), mirrored onto /metrics
+        # (crypto_pubkey_cache_events) and the crypto_health RPC section
+        self.counters = {
+            "host_hits": 0, "host_misses": 0, "host_evictions": 0,
+            "device_hits": 0, "device_misses": 0, "device_evictions": 0,
+        }
+
+    def _count(self, level: str, event: str, n: int = 1) -> None:
+        self.counters[f"{level}_{event}"] += n
+        try:
+            from cometbft_tpu.libs import metrics as _metrics
+
+            _metrics.crypto_metrics().pubkey_cache_events.labels(
+                level, event).inc(n)
+        except Exception:  # noqa: BLE001 - metrics must never break staging
+            pass
+
+    def stats(self) -> dict:
+        return dict(self.counters,
+                    host_entries=len(self._map), device_slots=len(self._dev))
 
     def lookup_or_decompress(self, pubs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
         """Host-level: (ok (N,) bool, coords (N, 4, 20) int32)."""
-        missing = [p for p in dict.fromkeys(pubs) if p not in self._map]
+        uniq = dict.fromkeys(pubs)
+        missing = [p for p in uniq if p not in self._map]
+        self._count("host", "misses", len(missing))
+        self._count("host", "hits", len(uniq) - len(missing))
         if missing:
             enc = np.frombuffer(b"".join(missing), dtype=np.uint8).reshape(-1, 32)
             ok, coords = self._decompress(enc)
             evict = min(len(self._map), len(self._map) + len(missing) - self.capacity)
             for _ in range(max(0, evict)):
                 self._map.pop(next(iter(self._map)))
+            if evict > 0:
+                self._count("host", "evictions", evict)
             for i, p in enumerate(missing):
                 self._map[p] = (bool(ok[i]), coords[i])
         oks = np.empty(len(pubs), dtype=bool)
@@ -355,7 +470,9 @@ class PubKeyCache:
         )
         hit = self._dev.get(digest)
         if hit is not None:
+            self._count("device", "hits")
             return hit[0], hit[1]
+        self._count("device", "misses")
         ok_a, coords = self.lookup_or_decompress(pubs)
         pad = bucket - len(pubs)
         if pad:
@@ -387,6 +504,7 @@ class PubKeyCache:
                     "cache a poisoned table")
         if len(self._dev) >= self.device_slots:
             self._dev.pop(next(iter(self._dev)))
+            self._count("device", "evictions")
         self._dev[digest] = (ok_a, dev)
         return ok_a, dev
 
@@ -425,63 +543,137 @@ def _stage_gather(cache: "PubKeyCache", pubs: list[bytes], bucket: int,
 _default_cache = PubKeyCache()
 
 
+def cache_stats() -> dict:
+    """Default PubKeyCache counters per scheme — the crypto_health RPC's
+    pubkey_cache section (next to verify_sched)."""
+    out = {"ed25519": _default_cache.stats()}
+    try:
+        from cometbft_tpu.ops import sr25519_kernel as SRK
+
+        out["sr25519"] = SRK._default_cache.stats()
+    except Exception:  # noqa: BLE001 - sr kernel may be unimportable (deps)
+        pass
+    return out
+
+
 def compute_challenges(pubs: list[bytes], msgs: list[bytes], sigs: list[bytes]) -> list[int]:
     """k_i = SHA-512(R_i || A_i || M_i) mod L — host-side (SHA-512 is 64-bit
-    word arithmetic, hostile to the TPU VPU; ~1 us/item via OpenSSL)."""
-    sha = hashlib.sha512
-    ell = oracle.L
-    return [
-        int.from_bytes(sha(sig[:32] + pub + msg).digest(), "little") % ell
-        for pub, msg, sig in zip(pubs, msgs, sigs)
-    ]
+    word arithmetic, hostile to the TPU VPU). Batch-vectorized via
+    ops/hashvec (lane-SIMD native core / batch-axis numpy / hashlib rung
+    ladder, bit-for-bit hashlib); this list[int] entry is the compat shim —
+    the staging path consumes packed words directly (stage_batch)."""
+    from cometbft_tpu.ops import hashvec
+
+    words = hashvec.sha512_mod_l_words(
+        [sig[:32] + pub + msg for pub, msg, sig in zip(pubs, msgs, sigs)])
+    blob = words.tobytes()
+    return [int.from_bytes(blob[32 * i: 32 * i + 32], "little")
+            for i in range(len(sigs))]
+
+
+# L as 4 little-endian 64-bit words, most significant last — the vectorized
+# s < L comparison reads these
+_L_WORDS64 = np.frombuffer(oracle.L.to_bytes(32, "little"), dtype="<u8")
+
+
+def scalars_lt_l(s_rows: np.ndarray) -> np.ndarray:
+    """(N, 32) uint8 little-endian scalars -> (N,) bool of (s < L),
+    vectorized lexicographic compare over the four 64-bit words from the
+    most significant down — replaces the per-row int.from_bytes round trip
+    in staging."""
+    w = np.ascontiguousarray(s_rows).view("<u8")
+    lt = np.zeros(w.shape[0], dtype=bool)
+    decided = np.zeros(w.shape[0], dtype=bool)
+    for i in (3, 2, 1, 0):
+        lt |= ~decided & (w[:, i] < _L_WORDS64[i])
+        decided |= w[:, i] != _L_WORDS64[i]
+    return lt
+
+
+_ID_ROW32 = np.frombuffer(_ID_ENC32, dtype=np.uint8)
+
+
+def _challenge_words(r_rows, pub_rows, msgs, mlens, pre_ok) -> np.ndarray:
+    """(N, 8) uint32 packed challenge words k = SHA-512(R||A||M) mod L.
+    Uniform-length messages (every commit: sign-bytes share one length)
+    hash as ONE (N, 64+mlen) batch call; ragged messages group inside
+    sha512_many. Rows with pre_ok False get k = 0 (their placeholder
+    R/A content is hashed but discarded)."""
+    from cometbft_tpu.ops import hashvec
+
+    n = r_rows.shape[0]
+    if n and (mlens == mlens[0]).all():
+        msg_rows = np.frombuffer(
+            b"".join(msgs), dtype=np.uint8).reshape(n, int(mlens[0]))
+        data = np.concatenate([r_rows, pub_rows, msg_rows], axis=1)
+        digests = hashvec.sha512_rows(data)
+    else:
+        r_blob, p_blob = r_rows.tobytes(), pub_rows.tobytes()
+        digests = hashvec.sha512_many(
+            [r_blob[32 * i:32 * i + 32] + p_blob[32 * i:32 * i + 32] + m
+             for i, m in enumerate(msgs)])
+    k_words = hashvec.reduce512_mod_l(digests)
+    k_words[~pre_ok] = 0
+    return k_words
 
 
 def stage_batch(
-    pubs: list[bytes], msgs: list[bytes], sigs: list[bytes], bucket: int
+    pubs: list[bytes], msgs: list[bytes], sigs: list[bytes], bucket: int,
+    out: np.ndarray | None = None,
 ) -> tuple[np.ndarray, list[bytes], np.ndarray, np.ndarray, np.ndarray]:
     """Host staging shared by the single-chip and mesh paths: structural
     checks (lengths, s < L — never reach the device), SHA-512 challenges,
     packed-word arrays padded to `bucket`, batch-minor (8, bucket) uint32.
-    Returns (pre_ok, safe_pubs, r_words, s_words, k_words)."""
+    Returns (pre_ok, safe_pubs, r_words, s_words, k_words).
+
+    All batch-axis numpy: vectorized length/s<L checks, one hashvec batch
+    call for the challenges, r/s/k packed in place into `out` — a leased
+    (3, 8, bucket) StagingPool block (limbs.POOL) — when given, else fresh
+    arrays (mesh/bench callers that keep the words)."""
     n = len(sigs)
-    pre_ok = np.ones(n, dtype=bool)
-    s_vals = [0] * n
-    for i, (pub, sig) in enumerate(zip(pubs, sigs)):
-        if len(pub) != 32 or len(sig) != 64:
-            pre_ok[i] = False
-            continue
-        s = int.from_bytes(sig[32:], "little")
-        if s >= oracle.L:
-            pre_ok[i] = False
-            continue
-        s_vals[i] = s
+    ok_len = np.fromiter(map(len, sigs), np.int64, n) == 64
+    ok_len &= np.fromiter(map(len, pubs), np.int64, n) == 32
+    if ok_len.all():
+        sig_rows = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(n, 64)
+        pub_rows = np.frombuffer(b"".join(pubs), dtype=np.uint8).reshape(n, 32)
+        safe_pubs = list(pubs)
+    else:  # ragged stragglers: per-row placeholder substitution
+        sig_rows = np.zeros((n, 64), dtype=np.uint8)
+        pub_rows = np.zeros((n, 32), dtype=np.uint8)
+        sig_rows[:, :32] = _ID_ROW32
+        pub_rows[:] = _ID_ROW32
+        safe_pubs = [_ID_ENC32] * n
+        for i in np.flatnonzero(ok_len):
+            sig_rows[i] = np.frombuffer(sigs[i], dtype=np.uint8)
+            pub_rows[i] = np.frombuffer(pubs[i], dtype=np.uint8)
+            safe_pubs[i] = pubs[i]
+    pre_ok = ok_len & scalars_lt_l(sig_rows[:, 32:])
+    bad = np.flatnonzero(ok_len & ~pre_ok)  # s >= L rows need placeholders
+    if bad.size:
+        if not sig_rows.flags.writeable:
+            sig_rows = sig_rows.copy()
+        sig_rows[bad, :32] = _ID_ROW32
+        sig_rows[bad, 32:] = 0
+        safe_pubs = [p if pre_ok[i] else _ID_ENC32
+                     for i, p in enumerate(safe_pubs)]
 
-    safe_pubs = [p if pre_ok[i] else _ID_ENC32 for i, p in enumerate(pubs)]
-    safe_rs = [sigs[i][:32] if pre_ok[i] else _ID_ENC32 for i in range(n)]
-    ks = compute_challenges(safe_pubs, msgs, sigs)
-    for i in range(n):
-        if not pre_ok[i]:
-            ks[i] = 0
+    mlens = np.fromiter(map(len, msgs), np.int64, n)
+    k_rows = _challenge_words(
+        sig_rows[:, :32], pub_rows, msgs, mlens, pre_ok)
 
-    pad = bucket - n
-    r_enc = np.frombuffer(b"".join(safe_rs), dtype=np.uint8).reshape(n, 32)
-    r_words = L.bytes_to_words(r_enc)
-    s_words = L.scalars_to_words(s_vals)
-    k_words = L.scalars_to_words(ks)
-    if pad:
-        id_words = np.zeros((pad, 8), dtype=np.uint32)
-        id_words[:, 0] = 1
-        zwords = np.zeros((pad, 8), dtype=np.uint32)
-        r_words = np.concatenate([r_words, id_words])
-        s_words = np.concatenate([s_words, zwords])
-        k_words = np.concatenate([k_words, zwords])
-    return (
-        pre_ok,
-        safe_pubs,
-        np.ascontiguousarray(r_words.T),
-        np.ascontiguousarray(s_words.T),
-        np.ascontiguousarray(k_words.T),
-    )
+    sig_u4 = sig_rows.view("<u4")  # (n, 16): words 0-7 = R, 8-15 = s
+    if out is None:
+        out = np.empty((3, 8, bucket), dtype=np.uint32)
+    r_words, s_words, k_words = out[0], out[1], out[2]
+    r_words[:, :n] = sig_u4[:, :8].T
+    s_words[:, :n] = sig_u4[:, 8:].T
+    k_words[:, :n] = k_rows.T
+    if bucket > n:  # identity encoding + zero scalars: verifies valid
+        r_words[:, n:] = 0
+        r_words[0, n:] = 1
+        s_words[:, n:] = 0
+        k_words[:, n:] = 0
+    return pre_ok, safe_pubs, r_words, s_words, k_words
 
 
 def verify_batch(
@@ -574,17 +766,33 @@ def make_host_thunk(n, pre_ok, rows, info):
 
 
 def supervised_device_thunk(scheme: str, sup, submit_fn, fetch_site: str,
-                            n, pre_ok, ok_a, rows, info):
+                            n, pre_ok, ok_a, rows, info,
+                            expected=0, lease=None):
     """The shared thunk shape for a supervised device batch (ed25519 and
     sr25519 build their dispatch closure, this builds the rest): dispatch
-    runs on the transfer pool under the supervisor; the payload fetch is
+    runs on the transfer pool under the supervisor; fetches are
     watchdog-bounded; every failure drops the batch onto the host oracle
-    instead of raising into the verify seam."""
+    instead of raising into the verify seam.
+
+    submit_fn returns (header_dev, payload_dev) — the reduced-fetch pair
+    from _integrity_parts. The thunk fetches the 8-byte header first and
+    pulls the full per-lane payload only on a non-happy verdict. `expected`
+    is the host staging checksum the header is decoded against; `lease` is
+    the StagingPool block backing the staged words, returned to the pool
+    once the batch resolves (the _redo retry re-reads it, so release waits
+    for resolution, not dispatch)."""
     fut = _xfer_pool().submit(sup.run, submit_fn)
+    _lease = [lease]
+
+    def _release() -> None:
+        blk, _lease[0] = _lease[0], None
+        if blk is not None:
+            L.POOL.release(blk)
 
     def _acquire():
         """Block until dispatch completes; returns the device-resident
-        payload. Raises DeviceOpFailed/DeviceUnavailable (recorded)."""
+        (header, payload) pair. Raises DeviceOpFailed/DeviceUnavailable
+        (recorded)."""
         try:
             return fut.result(timeout=_dispatch.watchdog_timeout())
         except (_dispatch.DeviceOpFailed, _dispatch.DeviceUnavailable):
@@ -593,15 +801,18 @@ def supervised_device_thunk(scheme: str, sup, submit_fn, fetch_site: str,
             sup.record_op_failure(exc)
             raise _dispatch.DeviceOpFailed(f"{scheme} dispatch wait") from exc
 
-    def _fetch_np(payload_dev) -> np.ndarray:
-        """Device->host payload fetch: chaos site + watchdog + injected
-        lane corruption (the integrity echo plane must catch it)."""
+    _acquire.expected = expected  # resolve_batches decodes headers itself
+
+    def _fetch_np(dev_arr) -> np.ndarray:
+        """Device->host fetch (header or full payload): chaos site +
+        watchdog + injected lane corruption (the integrity echo plane must
+        catch it)."""
         from cometbft_tpu.libs import chaos
 
         try:
             chaos.fire(fetch_site)
             out = _fetch_pool().submit(
-                lambda: np.asarray(payload_dev)).result(
+                lambda: np.asarray(dev_arr)).result(
                     timeout=_dispatch.watchdog_timeout())
         except Exception as exc:  # noqa: BLE001
             sup.record_op_failure(exc)
@@ -609,14 +820,15 @@ def supervised_device_thunk(scheme: str, sup, submit_fn, fetch_site: str,
         return chaos.corrupt_mask(fetch_site, out)
 
     def _redo():
-        """Integrity-retry path: full fresh transfer+dispatch+fetch,
-        supervised AND watchdog-bounded like every other device wait — a
-        device that hangs during the retry must not stall the verify seam
+        """Integrity-retry path: full fresh transfer+dispatch+fetch of the
+        FULL payload (the header already said unhappy), supervised AND
+        watchdog-bounded like every other device wait — a device that
+        hangs during the retry must not stall the verify seam
         (decode_payload catches and falls to the host oracle), and the
         hang/failure is recorded so the breaker and crypto_health see it."""
         try:
             return _fetch_pool().submit(
-                lambda: np.asarray(sup.run(submit_fn))).result(
+                lambda: np.asarray(sup.run(submit_fn)[1])).result(
                     timeout=_dispatch.watchdog_timeout())
         except (_dispatch.DeviceOpFailed, _dispatch.DeviceUnavailable):
             raise  # sup.run already recorded it
@@ -626,14 +838,38 @@ def supervised_device_thunk(scheme: str, sup, submit_fn, fetch_site: str,
 
     def result() -> np.ndarray:
         try:
-            payload = _fetch_np(_acquire())
+            header_dev, payload_dev = _acquire()
+            header = _fetch_np(header_dev)
         except (_dispatch.DeviceOpFailed, _dispatch.DeviceUnavailable):
+            _release()
             return host_oracle_mask(n, pre_ok, ok_a, rows, info)
-        return decode_payload(
-            payload, n, pre_ok, ok_a, rows, info, redo=_redo)
+        verdict = decode_header(header, expected)
+        if verdict == "happy":
+            _count_fetch(True, header.nbytes)
+            _release()
+            return pre_ok & ok_a  # no failed lanes -> nothing to recheck
+        if verdict == "echo_corrupt":
+            _count_integrity("mask_echo_mismatch")
+            from cometbft_tpu.libs import log as _log
+
+            _log.default().error(
+                "reduced-fetch header failed its complement echo; pulling "
+                "the full payload", scheme=info[1])
+        try:
+            payload = _fetch_np(payload_dev)
+        except (_dispatch.DeviceOpFailed, _dispatch.DeviceUnavailable):
+            _release()
+            return host_oracle_mask(n, pre_ok, ok_a, rows, info)
+        _count_fetch(False, header.nbytes + payload.nbytes)
+        try:
+            return decode_payload(
+                payload, n, pre_ok, ok_a, rows, info, redo=_redo)
+        finally:
+            _release()
 
     result.device_parts = lambda: (
         _acquire, n, pre_ok, ok_a, rows, info, _redo)
+    result.release_staging = _release
     return result
 
 
@@ -665,7 +901,9 @@ def verify_batch_async(
     cache = cache or _default_cache
 
     b = bucket_size(n)
-    pre_ok, safe_pubs, r_words, s_words, k_words = stage_batch(pubs, msgs, sigs, b)
+    block = L.POOL.lease(b)
+    pre_ok, safe_pubs, r_words, s_words, k_words = stage_batch(
+        pubs, msgs, sigs, b, out=block)
     rows = (safe_pubs, list(msgs), list(sigs))
     info = (oracle.verify_zip215, "ed25519", recheck_groups)
     sup = _dispatch.supervisor("device")
@@ -677,6 +915,7 @@ def verify_batch_async(
         except Exception as exc:  # noqa: BLE001 - device died in staging
             sup.record_op_failure(exc)
     if a_dev is None:
+        L.POOL.release(block)
         return make_host_thunk(n, pre_ok, rows, info)
     expected = np.uint32(_host_checksum(r_words, s_words, k_words))
 
@@ -687,10 +926,10 @@ def verify_batch_async(
         rw = jnp.asarray(r_words)
         sw = jnp.asarray(s_words)
         kw = jnp.asarray(k_words)
-        mask = _dispatch_verify(a_dev, rw, sw, kw)
-        payload = _integrity_payload(mask, rw, sw, kw, expected)
+        mask, allok = _dispatch_verify(a_dev, rw, sw, kw)
+        parts = _integrity_parts(mask, allok, rw, sw, kw, expected)
         _count_device_batch("ed25519", b)
-        return payload
+        return parts
 
     # The host->device copy blocks the calling thread for the wire time
     # (~45 ms/MB through the axon tunnel), so it runs on a small pool:
@@ -698,64 +937,103 @@ def verify_batch_async(
     # and parallel puts multiplex the tunnel.
     return supervised_device_thunk(
         "ed25519", sup, _transfer_and_dispatch, "ed25519.fetch",
-        n, pre_ok, ok_a, rows, info)
+        n, pre_ok, ok_a, rows, info, expected=expected, lease=block)
 
 
 def resolve_batches(thunks) -> list[np.ndarray]:
-    """Materialize many verify_batch_async results with ONE device->host
-    fetch (device-side concat): over the axon tunnel every fetch pays an
-    ~89 ms round trip, so streaming callers (blocksync, bench) resolve a
-    window of batches at once. Thunks may mix schemes (the mixed
+    """Materialize many verify_batch_async results with a two-phase
+    reduced fetch (device-side concat): phase 1 pulls every batch's 8-byte
+    header in ONE device->host fetch — over the axon tunnel every fetch
+    pays an ~89 ms round trip, so a happy window (the steady state) costs
+    one tiny transfer instead of the full masks; phase 2 pulls the full
+    per-lane payloads, again concatenated into one fetch, only for batches
+    whose header said unhappy. Thunks may mix schemes (the mixed
     mega-commit resolves its ed25519 and sr25519 sub-batches together) —
     each carries its own host re-check oracle.
 
     Device-fault behavior: a batch whose dispatch failed (or that was
     staged host-side because the breaker was open) resolves on the host
     oracle; a failed/hung combined fetch (watchdog) drops every device
-    batch in the window onto the host oracle. The function never raises on
-    device trouble — blocksync's pool routine awaits it from an executor."""
+    batch still depending on it onto the host oracle. The function never
+    raises on device trouble — blocksync's pool routine awaits it from an
+    executor."""
     parts = [t.device_parts() for t in thunks]
-    payloads: list = []
+    pairs: list = []  # per thunk: (header_dev, payload_dev) | None | False
     for p in parts:
         acquire = p[0]
         if acquire is None:
-            payloads.append(None)
+            pairs.append(None)
             continue
         try:
-            payloads.append(acquire())
+            pairs.append(acquire())
         except Exception:  # noqa: BLE001 - recorded by the thunk's supervisor
-            payloads.append(False)
-    nonempty = [p for p in payloads if p is not None and p is not False]
-    flat = np.zeros(0, dtype=bool)
-    if nonempty:
+            pairs.append(False)
+    live = [pr for pr in pairs if pr is not None and pr is not False]
+
+    def _pull(arrs):
+        from cometbft_tpu.libs import chaos
+
+        chaos.fire("mixed.resolve")
+        return np.asarray(jnp.concatenate(arrs))
+
+    headers = None
+    if live:
         sup = _dispatch.supervisor("device")
-
-        def _pull():
-            from cometbft_tpu.libs import chaos
-
-            chaos.fire("mixed.resolve")
-            return np.asarray(jnp.concatenate(nonempty))
-
         try:
-            flat = _fetch_pool().submit(_pull).result(
-                timeout=_dispatch.watchdog_timeout())
+            headers = _fetch_pool().submit(
+                _pull, [h for h, _ in live]).result(
+                    timeout=_dispatch.watchdog_timeout())
         except Exception as exc:  # noqa: BLE001 - window falls to the CPU rung
             sup.record_op_failure(exc)
-            flat = None
+    verdicts: list[str | None] = []  # parallel to pairs; None = host oracle
+    need_payload = []
+    li = 0
+    for pr, p in zip(pairs, parts):
+        if pr is None or pr is False or headers is None:
+            verdicts.append(None)
+            continue
+        v = decode_header(headers[2 * li:2 * li + 2], p[0].expected)
+        li += 1
+        if v == "echo_corrupt":
+            _count_integrity("mask_echo_mismatch")
+        if v != "happy":
+            need_payload.append(pr[1])
+        verdicts.append(v)
+    flat = None
+    if need_payload:
+        sup = _dispatch.supervisor("device")
+        try:
+            flat = _fetch_pool().submit(_pull, need_payload).result(
+                timeout=_dispatch.watchdog_timeout())
+        except Exception as exc:  # noqa: BLE001 - those batches go host-side
+            sup.record_op_failure(exc)
+    if headers is not None:
+        if not need_payload:
+            _count_fetch(True, headers.nbytes)
+        else:
+            _count_fetch(False, headers.nbytes
+                         + (flat.nbytes if flat is not None else 0))
     out = []
     off = 0
-    for payload_dev, (acquire, n, pre_ok, ok_a, rows, info, redo) in zip(
-            payloads, parts):
-        if payload_dev is None and acquire is None and n == 0:
+    for pr, p, v in zip(pairs, parts, verdicts):
+        acquire, n, pre_ok, ok_a, rows, info, redo = p
+        if pr is None and acquire is None and n == 0:
             out.append(np.zeros(0, dtype=bool))
-            continue
-        if payload_dev is None or payload_dev is False or flat is None:
+        elif pr is None or pr is False or v is None:
             out.append(host_oracle_mask(n, pre_ok, ok_a, rows, info))
-            continue
-        b = payload_dev.shape[0]
-        out.append(decode_payload(
-            flat[off : off + b], n, pre_ok, ok_a, rows, info, redo=redo))
-        off += b
+        elif v == "happy":
+            out.append(pre_ok & ok_a)
+        elif flat is None:
+            out.append(host_oracle_mask(n, pre_ok, ok_a, rows, info))
+        else:
+            b = pr[1].shape[0]
+            out.append(decode_payload(
+                flat[off:off + b], n, pre_ok, ok_a, rows, info, redo=redo))
+            off += b
+    for t in thunks:
+        rel = getattr(t, "release_staging", None)
+        if rel is not None:
+            rel()
     return out
 
 

@@ -8,6 +8,8 @@ stages in well under a millisecond of host time.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 RADIX = 13
@@ -74,9 +76,63 @@ def bytes_to_words(raw: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(raw).view("<u4").reshape(raw.shape[0], 8)
 
 
-def scalars_to_words(scalars: list[int]) -> np.ndarray:
-    """List of B ints (< 2^256) -> (B, 8) uint32 word array."""
+def scalars_to_words(scalars) -> np.ndarray:
+    """B scalars (< 2^256) -> (B, 8) uint32 word array. Accepts a list of
+    ints, a bytes blob of B concatenated little-endian 32-byte values, or
+    a (B, 32) uint8 array — the bytes/array forms are the staging fast
+    path: no per-row int round trip, one view."""
+    if isinstance(scalars, (bytes, bytearray, memoryview)):
+        raw = np.frombuffer(bytes(scalars), dtype=np.uint8).reshape(-1, 32)
+        return bytes_to_words(raw)
+    if isinstance(scalars, np.ndarray):
+        assert scalars.dtype == np.uint8 and scalars.shape[1] == 32
+        return bytes_to_words(scalars)
     raw = np.frombuffer(
         b"".join(s.to_bytes(32, "little") for s in scalars), dtype=np.uint8
     ).reshape(len(scalars), 32)
     return bytes_to_words(raw)
+
+
+class StagingPool:
+    """Per-bucket pool of (3, 8, bucket) uint32 staging blocks — the r/s/k
+    word arrays of one device batch, batch-minor, preallocated. The
+    stagers (ed25519_kernel.stage_batch / sr25519_kernel.stage_batch_sr)
+    pack rows in place into a leased block instead of allocating, joining
+    and transposing fresh arrays per batch; the verify thunk releases the
+    block once its batch resolves. A block that is never released (error
+    paths, bench callers that keep the arrays) is simply garbage-collected
+    — the pool is a bounded free list, not a ledger. Leased blocks are
+    dirty: stagers overwrite every word, padding lanes included."""
+
+    MAX_FREE_PER_BUCKET = 4
+
+    def __init__(self) -> None:
+        self._free: dict[int, list[np.ndarray]] = {}
+        self._lock = threading.Lock()
+        self.leases = 0
+        self.reuses = 0
+
+    def lease(self, bucket: int) -> np.ndarray:
+        with self._lock:
+            self.leases += 1
+            free = self._free.get(bucket)
+            if free:
+                self.reuses += 1
+                return free.pop()
+        return np.empty((3, 8, bucket), dtype=np.uint32)
+
+    def release(self, block: np.ndarray | None) -> None:
+        if block is None:
+            return
+        with self._lock:
+            free = self._free.setdefault(block.shape[2], [])
+            if len(free) < self.MAX_FREE_PER_BUCKET:
+                free.append(block)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"leases": self.leases, "reuses": self.reuses,
+                    "free_blocks": sum(len(v) for v in self._free.values())}
+
+
+POOL = StagingPool()

@@ -124,7 +124,7 @@ def _verify_reps_timer(batch: int, n_windows: int = 0, stages: str = "full"):
     @functools.partial(jax.jit, static_argnums=(7,))
     def reps(ax, ay, az, at, rw, sw, kw, n):
         def body(_, acc):
-            m = PV._verify_pallas_bench(
+            m, _ok = PV._verify_pallas_bench(
                 ax, ay, az, at, rw, sw, kw,
                 n_windows=n_windows, stages=stages,
             )
@@ -385,9 +385,69 @@ def probe_variants() -> None:
     probe_loop("add 1-round", lambda s: (_add_1round(s[0], s[1]), s[0]), 2, 1_000_000)
 
 
+def probe_staging(n: int = 10240, mlen: int = 110) -> None:
+    """Host-staging fast path: serial per-row hashers vs the vectorized
+    batch rungs (ops/hashvec + BatchStrobe128), us/row. Pure host work —
+    no device involved; this is the 48 ms of BENCH_r05's
+    mixed_host_staging_ms decomposed."""
+    import hashlib
+    import os
+    import time
+
+    from cometbft_tpu.crypto import sr25519_math as srm
+    from cometbft_tpu.ops import hashvec
+
+    rng = __import__("numpy").random.default_rng(0)
+    datas = [rng.bytes(mlen) for _ in range(n)]
+    print(f"  hashvec native core: {hashvec.native_available()}")
+
+    t0 = time.perf_counter()
+    for d in datas:
+        hashlib.sha512(d).digest()
+    t_serial = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    hashvec.sha512_many(datas)
+    t_vec = time.perf_counter() - t0
+    print(f"  sha512      serial {t_serial / n * 1e6:7.2f} us/row | "
+          f"vectorized {t_vec / n * 1e6:7.2f} us/row "
+          f"({t_serial / t_vec:.1f}x)")
+
+    t0 = time.perf_counter()
+    hashvec.sha512_mod_l_words(datas)
+    t_pipe = time.perf_counter() - t0
+    print(f"  sha512+modL pipeline          | "
+          f"vectorized {t_pipe / n * 1e6:7.2f} us/row")
+
+    m = n // 4  # serial strobe is slow; measure a quarter and scale
+    pubs = [rng.bytes(32) for _ in range(m)]
+    rs = [rng.bytes(32) for _ in range(m)]
+    msgs = [rng.bytes(mlen) for _ in range(m)]
+    prior = os.environ.get("CBFT_HASHVEC")
+    os.environ["CBFT_HASHVEC"] = "serial"
+    try:
+        t0 = time.perf_counter()
+        srm.batch_compute_challenges(pubs, rs, msgs)
+        t_serial = time.perf_counter() - t0
+    finally:
+        if prior is None:
+            del os.environ["CBFT_HASHVEC"]
+        else:
+            os.environ["CBFT_HASHVEC"] = prior
+    t0 = time.perf_counter()
+    srm.batch_compute_challenges(pubs, rs, msgs)
+    t_vec = time.perf_counter() - t0
+    print(f"  sr challenge serial {t_serial / m * 1e6:6.2f} us/row | "
+          f"batch STROBE {t_vec / m * 1e6:5.2f} us/row "
+          f"({t_serial / t_vec:.1f}x)")
+
+
 def main(argv: list[str]) -> None:
     probes = set(argv) or {"all"}
     print(f"backend={jax.default_backend()} device={jax.devices()[0]}")
+
+    if probes & {"all", "staging"}:
+        print("host staging (serial vs vectorized hashers):")
+        probe_staging()
 
     if probes & {"all", "verify"}:
         print("full verify:")

@@ -83,9 +83,15 @@ def _verify_block_kernel(*refs, n_windows: int = 0, stages: str = "full",
     n_windows/stages are microbench bisection knobs (ops/microbench.py):
     n_windows truncates the ladder, stages="nodecomp" skips the R
     decompression — both produce WRONG masks and exist only to slope out
-    per-stage in-context device cost. Production callers use the defaults."""
+    per-stage in-context device cost. Production callers use the defaults.
+
+    A second (1, 1) SMEM output accumulates the batch-wide all-ok scalar
+    across grid blocks (TPU grid iterations run sequentially, so the
+    revisited block is a running AND) — the reduced-fetch header
+    (ed25519_kernel._integrity_parts) rides on it without materializing a
+    separate mask reduction."""
     consts = refs[:_N_CONSTS]
-    ax, ay, az, at, rw, sdig_ref, kdig_ref, out = refs[_N_CONSTS:]
+    ax, ay, az, at, rw, sdig_ref, kdig_ref, out, ok_out = refs[_N_CONSTS:]
 
     saved_f = {n: getattr(F, n) for n in _FIELD_CONST_NAMES}
     saved_table = curve._BASE_TABLE17
@@ -143,7 +149,17 @@ def _verify_block_kernel(*refs, n_windows: int = 0, stages: str = "full",
         else:  # cofactor 8: ZIP-215
             coset = curve.mul_by_cofactor(diff)
         valid = curve.is_identity(coset)
-        out[0, :] = (valid & ok_r).astype(jnp.int32)
+        blk = (valid & ok_r).astype(jnp.int32)
+        out[0, :] = blk
+        blk_ok = blk.min()  # 1 iff every lane in this 128-lane block passed
+
+        @pl.when(pl.program_id(0) == 0)
+        def _init_ok():
+            ok_out[0, 0] = blk_ok
+
+        @pl.when(pl.program_id(0) != 0)
+        def _and_ok():
+            ok_out[0, 0] = jnp.minimum(ok_out[0, 0], blk_ok)
     finally:
         for n, v in saved_f.items():
             setattr(F, n, v)
@@ -178,24 +194,39 @@ def _verify_pallas_bench(
     word_spec = pl.BlockSpec((U.WORDS, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
     dig_spec = pl.BlockSpec((NDIG, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
     out_spec = pl.BlockSpec((1, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
-    mask = pl.pallas_call(
+    ok_spec = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    mask, allok = pl.pallas_call(
         functools.partial(
             _verify_block_kernel, n_windows=n_windows, stages=stages,
             scheme=scheme,
         ),
         grid=grid,
         in_specs=const_specs + [limb_spec] * 4 + [word_spec] + [dig_spec] * 2,
-        out_specs=out_spec,
-        out_shape=jax.ShapeDtypeStruct((1, b), jnp.int32),
+        out_specs=(out_spec, ok_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, b), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ),
         interpret=interpret,
     )(*_const_args(), ax, ay, az, at, r_words, s_dig, k_dig)
-    return mask[0] != 0
+    return mask[0] != 0, allok[0, 0] != 0
 
 
 def verify_pallas(ax, ay, az, at, r_words, s_words, k_words, interpret=False):
     """(20, B) int32 A-coords + (8, B) uint32 packed r/s/k words ->
     (B,) bool mask (ed25519 ZIP-215). B must be a multiple of LANES
     (callers fall back to the XLA path for smaller buckets)."""
+    return _verify_pallas_bench(
+        ax, ay, az, at, r_words, s_words, k_words, interpret=interpret
+    )[0]
+
+
+def verify_pallas_ok(ax, ay, az, at, r_words, s_words, k_words,
+                     interpret=False):
+    """verify_pallas plus the fused all-ok scalar — the reduced-fetch
+    header's device-side reduction (kernel-accumulated, see
+    _verify_block_kernel). Pairs with ed25519_kernel.verify_math_ok as the
+    PallasGate (pallas_fn, xla_fn) couple."""
     return _verify_pallas_bench(
         ax, ay, az, at, r_words, s_words, k_words, interpret=interpret
     )
@@ -205,6 +236,15 @@ def verify_pallas_sr(ax, ay, az, at, r_words, s_words, k_words,
                      interpret=False):
     """sr25519 (schnorrkel/ristretto) variant of verify_pallas: same
     ladder, ristretto decode, cofactor-4 coset check."""
+    return _verify_pallas_bench(
+        ax, ay, az, at, r_words, s_words, k_words, interpret=interpret,
+        scheme="sr25519",
+    )[0]
+
+
+def verify_pallas_sr_ok(ax, ay, az, at, r_words, s_words, k_words,
+                        interpret=False):
+    """sr25519 variant of verify_pallas_ok (mask, all-ok scalar)."""
     return _verify_pallas_bench(
         ax, ay, az, at, r_words, s_words, k_words, interpret=interpret,
         scheme="sr25519",

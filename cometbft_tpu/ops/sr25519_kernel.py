@@ -118,6 +118,18 @@ def verify_math_sr(ax, ay, az, at, r_words, s_words, k_words) -> jnp.ndarray:
 
 _verify_kernel = jax.jit(verify_math_sr)
 
+
+def verify_math_sr_ok(ax, ay, az, at, r_words, s_words, k_words):
+    """verify_math_sr plus the all-ok reduction for the reduced-fetch
+    header (padding lanes are zero encodings with zero scalars — the
+    identity verifies valid — so all() over the padded batch equals all()
+    over the live lanes)."""
+    mask = verify_math_sr(ax, ay, az, at, r_words, s_words, k_words)
+    return mask, mask.all()
+
+
+_verify_kernel_ok = jax.jit(verify_math_sr_ok)
+
 from cometbft_tpu.ops.dispatch import PallasGate  # noqa: E402
 
 _pallas_gate = PallasGate("pallas.sr25519")
@@ -160,36 +172,52 @@ def stage_batch_sr(
     msgs: list[bytes],
     sigs: list[bytes],
     cache: SrPubKeyCache | None = None,
+    out: np.ndarray | None = None,
 ):
     """Host staging only: marker/canonicity checks, Merlin challenges,
     ristretto pubkey decode, packed device arrays. Returns
     (pre_ok, ok_a, n, a_dev, r_words, s_words, k_words) with the word
     arrays already device-resident — verify_batch dispatches them; the
-    bench harness rep-differences verify_math_sr over them."""
+    bench harness rep-differences verify_math_sr over them.
+
+    All batch-axis: vectorized length/marker/s<L checks, the whole
+    commit's Merlin challenges through the batch STROBE transcript
+    (srm.batch_challenge_words — N sponges under one Keccak permutation
+    per duplex boundary), r/s/k packed in place into `out` (a leased
+    StagingPool block) when given."""
     n = len(sigs)
     assert len(pubs) == n and len(msgs) == n
     cache = cache or _default_cache
+    from cometbft_tpu.ops import ed25519_kernel as EK
 
-    # host: marker/canonicity checks + Merlin challenges
-    pre_ok = np.ones(n, dtype=bool)
-    s_vals = [0] * n
-    r_encs: list[bytes] = [b""] * n
-    for i, (pub, sig) in enumerate(zip(pubs, sigs)):
-        if len(pub) != 32:
-            pre_ok[i] = False
-            continue
-        parsed = srm.parse_signature(sig)
-        if parsed is None:
-            pre_ok[i] = False
-            continue
-        r_encs[i], s_vals[i] = parsed
-    safe_pubs = [p if pre_ok[i] else _ID_ENC32 for i, p in enumerate(pubs)]
-    safe_rs = [r if pre_ok[i] else _ID_ENC32 for i, r in enumerate(r_encs)]
-    ks = srm.batch_compute_challenges(safe_pubs, safe_rs, list(msgs))
-    for i in range(n):
-        if not pre_ok[i]:
-            ks[i] = 0
-    s_safe = [s if pre_ok[i] else 0 for i, s in enumerate(s_vals)]
+    ok_len = np.fromiter(map(len, sigs), np.int64, n) == 64
+    ok_len &= np.fromiter(map(len, pubs), np.int64, n) == 32
+    if ok_len.all():
+        sig_rows = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(n, 64)
+        safe_pubs = list(pubs)
+    else:  # ragged stragglers: per-row placeholder substitution
+        sig_rows = np.zeros((n, 64), dtype=np.uint8)
+        safe_pubs = [_ID_ENC32] * n
+        for i in np.flatnonzero(ok_len):
+            sig_rows[i] = np.frombuffer(sigs[i], dtype=np.uint8)
+            safe_pubs[i] = pubs[i]
+    # schnorrkel signature parse, vectorized (mirrors srm.parse_signature):
+    # marker bit 255 must be set; s (with the marker cleared) must be < L
+    marker = (sig_rows[:, 63] & 128) != 0
+    s_rows = np.ascontiguousarray(sig_rows[:, 32:])
+    s_rows[:, 31] &= 127
+    pre_ok = ok_len & marker & EK.scalars_lt_l(s_rows)
+    bad = np.flatnonzero(~pre_ok)
+    if bad.size:
+        if not sig_rows.flags.writeable:
+            sig_rows = sig_rows.copy()
+        sig_rows[bad, :32] = 0  # ristretto identity encoding
+        s_rows[bad] = 0
+        safe_pubs = [p if pre_ok[i] else _ID_ENC32
+                     for i, p in enumerate(safe_pubs)]
+    r_rows = sig_rows[:, :32]
+    k_rows = srm.batch_challenge_words_rows(safe_pubs, r_rows, list(msgs))
+    k_rows[~pre_ok] = 0
 
     b = bucket_size(n)
     # device-resident A-coordinate staging: digest cache over the UNIQUE
@@ -198,27 +226,19 @@ def stage_batch_sr(
     from cometbft_tpu.ops.ed25519_kernel import _stage_gather
 
     ok_a, a_dev = _stage_gather(cache, safe_pubs, b, put_key="sr")
-    pad = b - n
-    r_enc_arr = np.frombuffer(b"".join(safe_rs), dtype=np.uint8).reshape(n, 32)
-    r_words = L.bytes_to_words(r_enc_arr)
-    s_words = L.scalars_to_words(s_safe)
-    k_words = L.scalars_to_words(ks)
-    if pad:
-        zw = np.zeros((pad, 8), dtype=np.uint32)
-        r_words = np.concatenate([r_words, zw])
-        s_words = np.concatenate([s_words, zw])
-        k_words = np.concatenate([k_words, zw])
+    if out is None:
+        out = np.empty((3, 8, b), dtype=np.uint32)
+    r_words, s_words, k_words = out[0], out[1], out[2]
+    r_words[:, :n] = np.ascontiguousarray(r_rows).view("<u4").T
+    s_words[:, :n] = s_rows.view("<u4").T
+    k_words[:, :n] = k_rows.T
+    if b > n:
+        r_words[:, n:] = 0
+        s_words[:, n:] = 0
+        k_words[:, n:] = 0
     # r/s/k stay HOST arrays (batch-minor (8, B)): the dispatcher checksums
     # them before the transfer and re-transfers on an integrity retry
-    return (
-        pre_ok,
-        ok_a,
-        n,
-        a_dev,
-        np.ascontiguousarray(r_words.T),
-        np.ascontiguousarray(s_words.T),
-        np.ascontiguousarray(k_words.T),
-    )
+    return pre_ok, ok_a, n, a_dev, r_words, s_words, k_words
 
 
 def verify_batch_async(
@@ -249,12 +269,14 @@ def verify_batch_async(
     sup = D.supervisor("device")
 
     staged = None
+    block = L.POOL.lease(bucket_size(n))
     if D.device_allowed():
         try:
-            staged = stage_batch_sr(pubs, msgs, sigs, cache=cache)
+            staged = stage_batch_sr(pubs, msgs, sigs, cache=cache, out=block)
         except Exception as exc:  # noqa: BLE001 - device died in staging
             sup.record_op_failure(exc)
     if staged is None:
+        L.POOL.release(block)
         # structural pre-checks still run host-side so pre_ok keeps the
         # identity-placeholder semantics of the device path
         pre_ok = np.fromiter(
@@ -276,16 +298,16 @@ def verify_batch_async(
         with KERNEL_DISPATCH_LOCK:
             from cometbft_tpu.ops import pallas_verify as PV
 
-            mask = _pallas_gate.run(
-                PV.verify_pallas_sr, _verify_kernel,
+            mask, allok = _pallas_gate.run(
+                PV.verify_pallas_sr_ok, _verify_kernel_ok,
                 (*a_dev, r_w, s_w, k_w), r_w.shape[1])
-        payload = EK._integrity_payload(mask, r_w, s_w, k_w, expected)
+        parts = EK._integrity_parts(mask, allok, r_w, s_w, k_w, expected)
         EK._count_device_batch("sr25519", r_w.shape[1])
-        return payload
+        return parts
 
     return EK.supervised_device_thunk(
         "sr25519", sup, _dispatch, "sr25519.fetch",
-        n, pre_ok, ok_a, rows, info)
+        n, pre_ok, ok_a, rows, info, expected=expected, lease=block)
 
 
 def verify_batch(

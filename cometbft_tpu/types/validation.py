@@ -413,9 +413,13 @@ def prefetch_staged(staged: list[StagedCommitVerification],
     """Resolve every staged commit in the window with ONE device batch:
     the window's rows concatenate into a single transfer + kernel dispatch +
     device->host fetch, then the combined mask is sliced back per commit.
-    Subsequent finish() calls are pure host work (per-commit error isolation
-    stays with the caller). Pre-dispatched device_thunk items are resolved
-    alongside with the same single fetch.
+    The fetch rides the reduced-fetch protocol (ed25519_kernel.
+    resolve_batches): a happy window — every commit valid, the steady
+    state — transfers 8 bytes per batch; the per-lane masks are pulled
+    only when some batch's header reports a failure. Subsequent finish()
+    calls are pure host work (per-commit error isolation stays with the
+    caller). Pre-dispatched device_thunk items are resolved alongside with
+    the same single fetch.
 
     With the global verify scheduler enabled (the default) the window is
     submitted to it instead — one group per commit, so each keeps its own

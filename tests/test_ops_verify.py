@@ -103,6 +103,44 @@ def test_checksum_host_device_agree():
     assert K._host_checksum(a2, b) != host
 
 
+def test_happy_path_header_fetch_is_tiny():
+    """An all-valid batch must resolve from the 8-byte reduced-fetch
+    header alone — the full per-lane mask never crosses the tunnel."""
+    import numpy as np
+
+    items = _sign_n(5)
+    pubs, msgs, sigs = map(list, zip(*items))
+    thunk = K.verify_batch_async(pubs, msgs, sigs)
+    acquire, n, pre_ok, ok_a, rows, info, _redo = thunk.device_parts()
+    header_dev, _payload_dev = acquire()
+    header = np.asarray(header_dev)
+    assert header.nbytes == 8 < 128
+    assert K.decode_header(header, acquire.expected) == "happy"
+    K.reset_fetch_stats()
+    assert thunk().tolist() == [True] * 5
+    st = K.fetch_stats()
+    assert st["happy_fetches"] == 1 and st["full_fetches"] == 0
+    assert st["happy_bytes"] == 8
+
+
+def test_failing_lane_pulls_full_mask():
+    """A batch with a bad lane must take the full-payload path and still
+    pinpoint the lane."""
+    items = _sign_n(5)
+    pubs, msgs, sigs = map(list, zip(*items))
+    sigs[1] = sigs[2]
+    thunk = K.verify_batch_async(pubs, msgs, sigs)
+    acquire, *_ = thunk.device_parts()
+    import numpy as np
+
+    header_dev, _ = acquire()
+    assert K.decode_header(np.asarray(header_dev), acquire.expected) == "full"
+    K.reset_fetch_stats()
+    assert thunk().tolist() == [True, False, True, True, True]
+    st = K.fetch_stats()
+    assert st["full_fetches"] == 1 and st["happy_fetches"] == 0
+
+
 def test_injected_mask_echo_corruption_detected():
     """A flipped bit on the device->host mask fetch must be detected by the
     redundant echo and resolved by the host oracle, not silently accepted."""
@@ -114,12 +152,31 @@ def test_injected_mask_echo_corruption_detected():
     pubs, msgs, sigs = map(list, zip(*items))
     thunk = K.verify_batch_async(pubs, msgs, sigs)
     acquire, n, pre_ok, ok_a, rows, info, _redo = thunk.device_parts()
-    payload = np.asarray(acquire()).copy()
+    payload = np.asarray(acquire()[1]).copy()
     payload[2] = not payload[2]  # corrupt one mask lane; echo now disagrees
     mask = K.decode_payload(payload, n, pre_ok, ok_a, rows, info, redo=None)
     assert mask.tolist() == [True] * 5  # host oracle restored the truth
     reg_out = metrics.global_registry().render()
-    assert "mask_echo_mismatch 1" in reg_out or "mask_echo_mismatch 2" in reg_out
+    assert "mask_echo_mismatch" in reg_out
+
+
+def test_corrupted_header_degrades_to_full_fetch():
+    """A mangled header (complement echo disagrees) must never produce a
+    verdict — the full echo-protected payload decides instead."""
+    import numpy as np
+
+    items = _sign_n(4)
+    pubs, msgs, sigs = map(list, zip(*items))
+    thunk = K.verify_batch_async(pubs, msgs, sigs)
+    acquire, *_ = thunk.device_parts()
+    header = np.asarray(acquire()[0]).copy()
+    header[0] ^= np.uint32(1 << 7)
+    assert K.decode_header(header, acquire.expected) == "echo_corrupt"
+    # a header claiming happy for DIFFERENT staged bytes is a checksum
+    # mismatch, not happy
+    wrong = np.uint32(int(acquire.expected) ^ 0xDEAD ^ int(K.OK_MAGIC))
+    fake = np.array([wrong, ~wrong], dtype=np.uint32)
+    assert K.decode_header(fake, acquire.expected) == "chk_mismatch"
 
 
 def test_injected_staging_corruption_retries_then_recovers():
@@ -130,7 +187,7 @@ def test_injected_staging_corruption_retries_then_recovers():
     pubs, msgs, sigs = map(list, zip(*items))
     thunk = K.verify_batch_async(pubs, msgs, sigs)
     acquire, n, pre_ok, ok_a, rows, info, redo = thunk.device_parts()
-    bad = np.asarray(acquire()).copy()
+    bad = np.asarray(acquire()[1]).copy()
     bad[-1] = False  # device says the staged bytes didn't checksum
     calls = {"n": 0}
 
