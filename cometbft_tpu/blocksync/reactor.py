@@ -22,6 +22,7 @@ import time
 from cometbft_tpu.blocksync import messages as bm
 from cometbft_tpu.blocksync.pool import BlockPool
 from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.libs import trace
 from cometbft_tpu.libs.service import TaskRunner
 from cometbft_tpu.p2p.base_reactor import Envelope, Reactor
 from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
@@ -213,9 +214,16 @@ class BlocksyncReactor(Reactor):
             # sync-class: the window yields the device to consensus-
             # critical flushes in the global verify scheduler, and queued
             # mempool-admission rows ride the window batch as filler
-            def _timed_prefetch(batch=[e[-1] for e in entries]):
+            def _timed_prefetch(batch=[e[-1] for e in entries],
+                                h0=entries[0][0]):
                 t0 = time.monotonic()
-                validation.prefetch_staged(batch, klass="sync")
+                # root span per verify window (fresh context on the
+                # executor thread): a slow window keeps its full tree —
+                # header fetch, payload pulls, host re-checks — in the
+                # slow-batch capture ring
+                with trace.span("sync.window", cat="sync", height=h0,
+                                heights=len(batch)):
+                    validation.prefetch_staged(batch, klass="sync")
                 return time.monotonic() - t0
 
             fetch = asyncio.get_running_loop().run_in_executor(
@@ -267,6 +275,17 @@ class BlocksyncReactor(Reactor):
         h = start_height
         vals = self.state.validators
         vals_hash = vals.hash()
+        with trace.span("sync.stage_window", cat="sync",
+                        height=start_height) as stage_sp:
+            try:
+                self._stage_window_inner(chain_id, vals, vals_hash, h,
+                                         entries)
+            finally:
+                stage_sp.set(heights=len(entries))
+        return entries
+
+    def _stage_window_inner(self, chain_id: str, vals, vals_hash,
+                            h: int, entries: list) -> None:
         while len(entries) < self.window:
             first, first_ext = self.pool.block_at(h)
             second, _ = self.pool.block_at(h + 1)
@@ -290,7 +309,6 @@ class BlocksyncReactor(Reactor):
                 break
             entries.append((h, first, first_ext, second, parts, first_id, staged))
             h += 1
-        return entries
 
     def _check_extensions(self, first, first_ext) -> None:
         """reactor.go:471-480."""

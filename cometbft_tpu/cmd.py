@@ -439,6 +439,49 @@ def cmd_debug(args) -> int:
     return 0
 
 
+def cmd_trace_dump(args) -> int:
+    """Pull the verify-plane flight recorder off a RUNNING node (the
+    `trace_dump` RPC route, libs/trace.py) and write a Perfetto-loadable
+    Chrome trace-event file — open it at ui.perfetto.dev. Also prints the
+    rolling wall-time attribution (stage shares, measured bytes-per-sig)
+    and, with --slow, writes the slow-batch capture ring next to the
+    trace. Requires instrumentation.tracing=true (or CBFT_TRACE=1) on
+    the node, else the dump is empty."""
+    import time as _time
+    import urllib.parse
+    import urllib.request
+
+    base = args.rpc_laddr.removeprefix("tcp://")
+    if not base.startswith("http"):
+        base = "http://" + base
+    q = urllib.parse.urlencode({"slow": "true"} if args.slow else {})
+    url = f"{base}/trace_dump" + (f"?{q}" if q else "")
+    with urllib.request.urlopen(url, timeout=30) as r:
+        env = json.loads(r.read())
+    if "error" in env and env["error"]:
+        print(f"trace_dump failed: {env['error']}")
+        return 1
+    result = env.get("result", env)
+    out = args.output or f"cometbft-trace-{int(_time.time())}.json"
+    with open(out, "w") as f:
+        json.dump(result["chrome_trace"], f)
+    n_ev = len(result["chrome_trace"].get("traceEvents", []))
+    print(f"wrote {out} ({n_ev} events; load at ui.perfetto.dev)")
+    if not result.get("enabled", False):
+        print("note: tracing is DISABLED on the node "
+              "(instrumentation.tracing / CBFT_TRACE)")
+    if result.get("spans_dropped"):
+        print(f"ring dropped {result['spans_dropped']} oldest spans")
+    print(json.dumps({"attribution": result.get("attribution", {})}))
+    if args.slow:
+        slow_out = out.removesuffix(".json") + "-slow.json"
+        with open(slow_out, "w") as f:
+            json.dump(result.get("slow_captures", []), f, indent=1)
+        print(f"wrote {slow_out} "
+              f"({len(result.get('slow_captures', []))} slow captures)")
+    return 0
+
+
 def cmd_loadtime(args) -> int:
     """test/loadtime analog: 'run' drives stamped-tx load at RPC
     endpoints; 'report' recomputes per-tx latency from committed blocks."""
@@ -543,6 +586,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--profile-seconds", type=int, default=5)
     sp.add_argument("--output", default="", help="output tar.gz path")
     sp.set_defaults(fn=cmd_debug)
+
+    sp = sub.add_parser(
+        "trace-dump",
+        help="pull the verify-plane flight recorder off a running node "
+             "into a Perfetto-loadable trace file")
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr",
+                    default="tcp://127.0.0.1:26657")
+    sp.add_argument("--output", default="", help="output .json path")
+    sp.add_argument("--slow", action="store_true",
+                    help="also write the slow-batch capture ring")
+    sp.set_defaults(fn=cmd_trace_dump)
 
     sp = sub.add_parser("loadtime", help="tx load generator + latency report")
     sp.add_argument("mode", choices=["run", "report"])

@@ -33,6 +33,7 @@ from cometbft_tpu.consensus.ticker import TimeoutInfo, TimeoutTicker
 from cometbft_tpu.consensus.wal import WAL, EndHeightMessage
 from cometbft_tpu.libs import fail
 from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.libs import trace
 from cometbft_tpu.libs.service import BaseService, TaskRunner
 from cometbft_tpu.privval.file_pv import PrivValidator
 from cometbft_tpu.state import BlockExecutor, State
@@ -133,6 +134,13 @@ class ConsensusState(BaseService):
         # batched-path attribution: staged vote -> staging peer, so a
         # FLUSH_INVALID result can still be pinned on its sender
         self._staged_peer: dict[tuple, str] = {}
+
+        # flight-recorder height timeline (libs/trace.py): one begin()-span
+        # per consensus height — propose/flush/commit-verify/ABCI-exec
+        # spans and step events hang off it, so a slow height keeps its
+        # whole tree in the slow capture ring
+        self._height_span = None
+        self._height_span_h = 0
 
         self.sync_to_state(state)
 
@@ -355,6 +363,9 @@ class ConsensusState(BaseService):
     def _new_step(self, step: RoundStepType) -> None:
         self.rs.step = step
         self.n_steps += 1
+        trace.event(f"consensus.step.{step.name.lower()}", cat="consensus",
+                    parent=self._height_span, height=self.rs.height,
+                    round=self.rs.round_)
         if self.event_switch is not None:
             self.event_switch.fire("NewRoundStep", self.rs)
 
@@ -365,6 +376,21 @@ class ConsensusState(BaseService):
             rs.round_ == round_ and rs.step != RoundStepType.NEW_HEIGHT
         ):
             return
+        if trace.enabled() and self._height_span_h != height:
+            # new height: roll the timeline span (the previous one closed
+            # in _finalize_commit; this also covers replay/catch-up jumps)
+            if self._height_span is not None:
+                self._height_span.finish()
+            # the height budget rides on top of the unavoidable protocol
+            # waits (propose window + commit delay): with the bare global
+            # slow_ms, every ordinary height would be "slow" and the
+            # capture ring would hold nothing but routine heights
+            cfg = self.config
+            wait_ms = (cfg.timeout_propose + cfg.timeout_commit) * 1e3
+            self._height_span = trace.begin(
+                "consensus.height", cat="consensus", height=height,
+                slow_ms=trace.slow_budget_ms() + wait_ms)
+            self._height_span_h = height
         validators = rs.validators
         if rs.round_ < round_:
             validators = validators.copy()
@@ -410,7 +436,10 @@ class ConsensusState(BaseService):
             self.config.propose_timeout(round_), height, round_, RoundStepType.PROPOSE
         )
         if self._is_proposer():
-            await self.decide_proposal(height, round_)
+            with trace.span("consensus.propose", cat="consensus",
+                            parent=self._height_span, height=height,
+                            round=round_):
+                await self.decide_proposal(height, round_)
         if self._is_proposal_complete():
             await self._enter_prevote(height, rs.round_)
 
@@ -694,7 +723,9 @@ class ConsensusState(BaseService):
         block, block_parts = rs.proposal_block, rs.proposal_block_parts
         precommits = rs.votes.precommits(rs.commit_round)
         block_id, _ = precommits.two_thirds_majority()
-        self.block_exec.validate_block(self.state, block)
+        with trace.span("consensus.commit_verify", cat="consensus",
+                        parent=self._height_span, height=height):
+            self.block_exec.validate_block(self.state, block)
 
         fail.fail(0)  # state.go:1777
         if self.block_store.height() < block.header.height:
@@ -709,7 +740,16 @@ class ConsensusState(BaseService):
             self.wal.write_sync(EndHeightMessage(height))  # state.go:1810 fsync
         fail.fail(2)  # state.go:1817 — the committed-but-unsaved crash window
 
-        new_state = await self.block_exec.apply_block(self.state, block_id, block)
+        with trace.span("consensus.abci_exec", cat="consensus",
+                        parent=self._height_span, height=height,
+                        txs=len(block.data.txs)):
+            new_state = await self.block_exec.apply_block(
+                self.state, block_id, block)
+        if self._height_span is not None and self._height_span_h == height:
+            self._height_span.set(
+                rounds=rs.commit_round, txs=len(block.data.txs))
+            self._height_span.finish()
+            self._height_span = None
         self.logger.info(
             "finalized block", height=height, hash=block.hash().hex()[:12],
             txs=len(block.data.txs), app_hash=new_state.app_hash.hex()[:12],
@@ -920,8 +960,14 @@ class ConsensusState(BaseService):
         if self.metrics is not None and n_pending > 0:
             self.metrics.batch_flushes.inc()
             self.metrics.batch_lanes.inc(n_pending)
+        kind = ("prevote" if vs.signed_msg_type == SignedMsgType.PREVOTE
+                else "precommit")
+        flush_sp = trace.span(
+            f"consensus.{kind}_flush", cat="consensus",
+            parent=self._height_span, height=self.rs.height,
+            round=vs.round_, rows=n_pending)
         try:
-            with sched.work_class(sched.CONSENSUS):
+            with flush_sp, sched.work_class(sched.CONSENSUS):
                 results = vs.flush_pending()
         except ErrVoteConflictingVotes as e:
             results = getattr(e, "results", [])

@@ -3,16 +3,26 @@
 Module-scoped child loggers via with_fields(); lazy value rendering so hot
 paths (vote ingestion) pay nothing when the level is filtered — the analog of
 the reference's log.NewLazySprintf (consensus/state.go:1654).
+
+Trace correlation: when the flight recorder (libs/trace.py) is armed and a
+span is active on the emitting thread/task, every record is stamped with
+`trace_id`/`span_id` — a slow-batch capture and its log lines correlate by
+id. JSON output is opt-in process-wide via set_default_format("json") (node
+boot wires base.log_format through it) or CBFT_LOG_FORMAT=json, so library
+code calling default() follows the node's choice.
 """
 
 from __future__ import annotations
 
 import io
 import json
+import os
 import sys
 import threading
 import time
 from typing import Any, Callable, Optional, TextIO
+
+from cometbft_tpu.libs import trace as _trace
 
 DEBUG, INFO, WARN, ERROR, NONE = 0, 1, 2, 3, 4
 _LEVEL_NAMES = {DEBUG: "debug", INFO: "info", WARN: "warn", ERROR: "error"}
@@ -76,7 +86,10 @@ class Logger:
         if level < self.level:
             return
         ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime())
+        ids = _trace.current_ids()  # None in two reads when tracing is off
         items = self._fields + tuple(kv.items())
+        if ids is not None:
+            items += (("trace_id", ids[0]), ("span_id", ids[1]))
         if self._fmt == "json":
             rec = {"level": _LEVEL_NAMES[level], "ts": ts, "msg": msg}
             for k, v in items:
@@ -123,7 +136,25 @@ def nop() -> Logger:
     return _NOP
 
 
-def default(level: int = INFO, fmt: str = "logfmt") -> Logger:
+_default_fmt: str | None = None
+
+
+def set_default_format(fmt: str) -> None:
+    """Process-wide default output format for default()-constructed
+    loggers ("logfmt" | "json"). Node boot routes base.log_format here so
+    deep library log sites (kernels, scheduler, supervisors) emit in the
+    node's configured format instead of hardcoded logfmt."""
+    if fmt not in ("logfmt", "json"):
+        raise ValueError(f"unknown log format {fmt!r}")
+    global _default_fmt
+    _default_fmt = fmt
+
+
+def default(level: int = INFO, fmt: str | None = None) -> Logger:
+    if fmt is None:
+        # the env var is the operator overlay and wins over the config-
+        # routed process default (the CBFT_TRACE-over-config pattern)
+        fmt = os.environ.get("CBFT_LOG_FORMAT") or _default_fmt or "logfmt"
     return Logger(sys.stderr, level, (), fmt)
 
 

@@ -14,6 +14,25 @@ import threading
 from typing import Optional, Sequence
 
 
+def escape_label_value(v: str) -> str:
+    """Prometheus text-exposition label-value escaping: backslash, double
+    quote, and newline must be escaped or a scraper misparses the series
+    (a chaos spec or error string in a label value can contain all
+    three)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def escape_help(s: str) -> str:
+    """# HELP line escaping: backslash and newline only (quotes are legal
+    there)."""
+    return str(s).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_labels(pairs) -> str:
+    return ",".join(f'{n}="{escape_label_value(v)}"' for n, v in pairs)
+
+
 class _Metric:
     def __init__(self, name: str, help_: str, labels: Sequence[str] = ()):
         self.name = name
@@ -46,12 +65,11 @@ class _Metric:
     def _fmt_key(self, key: tuple) -> str:
         if not key:
             return self.name
-        inner = ",".join(
-            f'{n}="{v}"' for n, v in zip(self.label_names, key))
-        return f"{self.name}{{{inner}}}"
+        return f"{self.name}{{{_fmt_labels(zip(self.label_names, key))}}}"
 
     def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.TYPE}"]
+        out = [f"# HELP {self.name} {escape_help(self.help)}",
+               f"# TYPE {self.name} {self.TYPE}"]
         with self._lock:
             vals = dict(self._values) or ({(): 0.0} if not self.label_names else {})
         for key, v in sorted(vals.items()):
@@ -121,26 +139,45 @@ class Histogram(_Metric):
             self._sums[key] = self._sums.get(key, 0.0) + v
             self._totals[key] = self._totals.get(key, 0) + 1
 
+    def sum_value(self, *label_values: str) -> float:
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            return self._sums.get(key, 0.0)
+
+    def count_value(self, *label_values: str) -> int:
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            return self._totals.get(key, 0)
+
     def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        """Exposition-format series per label set, in the order scrapers
+        require: cumulative _bucket lines ascending by `le`, then the
+        mandatory `le="+Inf"` bucket, then _sum, then _count — with label
+        values escaped. `le` renders LAST within the braces (the
+        convention promtool canonicalizes to)."""
+        out = [f"# HELP {self.name} {escape_help(self.help)}",
+               f"# TYPE {self.name} histogram"]
         with self._lock:
             keys = list(self._totals) or ([()] if not self.label_names else [])
             for key in sorted(keys):
                 counts = self._counts.get(key, [0] * len(self.buckets))
-                cum = 0
-                for b, c in zip(self.buckets, counts):
-                    cum = c  # counts are already cumulative per-bucket
-                    labels = dict(zip(self.label_names, key))
-                    labels["le"] = f"{b:g}"
-                    inner = ",".join(f'{n}="{v}"' for n, v in labels.items())
+                base_pairs = list(zip(self.label_names, key))
+                # per-bucket counts are recorded cumulatively by
+                # observe_key; render them as-is, ascending
+                for b, cum in zip(self.buckets, counts):
+                    inner = _fmt_labels(base_pairs + [("le", f"{b:g}")])
                     out.append(f"{self.name}_bucket{{{inner}}} {cum}")
-                labels = dict(zip(self.label_names, key))
-                labels["le"] = "+Inf"
-                inner = ",".join(f'{n}="{v}"' for n, v in labels.items())
-                out.append(f"{self.name}_bucket{{{inner}}} {self._totals.get(key, 0)}")
-                base = self._fmt_key(key)
-                out.append(f"{base}_sum {self._sums.get(key, 0.0):g}")
-                out.append(f"{base}_count {self._totals.get(key, 0)}")
+                inner = _fmt_labels(base_pairs + [("le", "+Inf")])
+                out.append(
+                    f"{self.name}_bucket{{{inner}}} {self._totals.get(key, 0)}")
+                # the suffix goes on the metric NAME, before the braces
+                # (the seed rendered `name{labels}_sum`, which scrapers
+                # reject for any labeled histogram)
+                braces = f"{{{_fmt_labels(base_pairs)}}}" if key else ""
+                out.append(
+                    f"{self.name}_sum{braces} {self._sums.get(key, 0.0):g}")
+                out.append(
+                    f"{self.name}_count{braces} {self._totals.get(key, 0)}")
         return out
 
 

@@ -242,6 +242,7 @@ class CListMempool:
         sheds load instead of queuing unboundedly."""
         from cometbft_tpu import sched
         from cometbft_tpu.crypto import ed25519 as _ed
+        from cometbft_tpu.libs import trace
 
         if len(tx) < TX_SIG_OVERHEAD + 1:
             self.cache.remove(tx)
@@ -249,25 +250,38 @@ class CListMempool:
                 f"tx of {len(tx)} bytes cannot carry pub+sig+payload")
         pub, sig = tx[:TX_SIG_PUB], tx[TX_SIG_PUB:TX_SIG_OVERHEAD]
         payload = tx[TX_SIG_OVERHEAD:]
-        try:
-            futs = sched.get().submit(
-                [(_ed.PubKey(pub), payload, sig)], klass=sched.MEMPOOL)
-        except sched.SchedulerSaturated as e:
-            self.cache.remove(tx)
-            raise ErrMempoolIsFull(f"verify scheduler saturated: {e}") from e
-        # bounded wait: the scheduler resolves within its deadline plus,
-        # worst case, one device-watchdog window (hang -> supervisor ->
-        # host oracle). A timeout here means something is deeply wrong —
-        # shed the tx rather than wedging this RPC coroutine forever.
-        from cometbft_tpu.ops import dispatch as _dispatch
+        # admission timeline: submit -> (queue wait inside the scheduler,
+        # attributed there) -> resolved future. A slow admit is a root
+        # span, so it lands in the slow capture ring with its batch tree.
+        # the `with` covers EVERY exit below: an exception escaping an
+        # unfinished span would leak it on this task's contextvar,
+        # silently reparenting every later span on the connection
+        with trace.span("mempool.admit", cat="mempool",
+                        tx_bytes=len(tx)) as admit_sp:
+            try:
+                futs = sched.get().submit(
+                    [(_ed.PubKey(pub), payload, sig)], klass=sched.MEMPOOL)
+            except sched.SchedulerSaturated as e:
+                admit_sp.set(outcome="saturated")
+                self.cache.remove(tx)
+                raise ErrMempoolIsFull(
+                    f"verify scheduler saturated: {e}") from e
+            # bounded wait: the scheduler resolves within its deadline
+            # plus, worst case, one device-watchdog window (hang ->
+            # supervisor -> host oracle). A timeout here means something
+            # is deeply wrong — shed the tx rather than wedging this RPC
+            # coroutine forever.
+            from cometbft_tpu.ops import dispatch as _dispatch
 
-        try:
-            ok = await asyncio.wait_for(
-                asyncio.wrap_future(futs[0]),
-                timeout=_dispatch.watchdog_timeout() + 5.0)
-        except asyncio.TimeoutError:
-            self.cache.remove(tx)
-            raise ErrMempoolIsFull("verify scheduler timed out") from None
+            try:
+                ok = await asyncio.wait_for(
+                    asyncio.wrap_future(futs[0]),
+                    timeout=_dispatch.watchdog_timeout() + 5.0)
+            except asyncio.TimeoutError:
+                admit_sp.set(outcome="timeout")
+                self.cache.remove(tx)
+                raise ErrMempoolIsFull("verify scheduler timed out") from None
+            admit_sp.set(outcome="ok" if ok else "bad_signature")
         if not ok:
             if self.metrics is not None:
                 self.metrics.failed_txs.inc()

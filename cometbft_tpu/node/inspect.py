@@ -10,6 +10,7 @@ running the node.
 from __future__ import annotations
 
 import asyncio
+import os
 import signal
 
 from cometbft_tpu.config import Config
@@ -102,8 +103,14 @@ class _NoSwitch:
 
 async def run_inspect(config: Config) -> None:
     """Serve until SIGINT/SIGTERM (inspect.go Run)."""
+    # CBFT_LOG_FORMAT overlays base.log_format, same as Node.__init__ —
+    # otherwise the main logger and default()-built library loggers
+    # would disagree on format under the env override
+    log_fmt = (os.environ.get("CBFT_LOG_FORMAT", "").strip().lower()
+               or config.base.log_format)
+    cmtlog.set_default_format(log_fmt)
     logger = cmtlog.Logger(level=cmtlog.parse_level(config.base.log_level),
-                           fmt=config.base.log_format)
+                           fmt=log_fmt)
     node = InspectNode(config, logger)
     server = RPCServer(node, config.rpc, logger=logger.with_fields(module="rpc"))
     await server.start()

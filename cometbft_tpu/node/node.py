@@ -27,6 +27,7 @@ from cometbft_tpu.crypto import ed25519
 from cometbft_tpu.evidence import EvidencePool
 from cometbft_tpu.evidence.reactor import EvidenceReactor
 from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.libs import trace as cmttrace
 from cometbft_tpu.libs.events import EventSwitch
 from cometbft_tpu.libs.service import BaseService
 from cometbft_tpu.mempool.mempool import CListMempool
@@ -101,14 +102,38 @@ class Node(BaseService):
 
     def __init__(self, config: Config, logger: cmtlog.Logger | None = None,
                  app=None, genesis_doc: GenesisDoc | None = None):
+        # CBFT_LOG_FORMAT overlays base.log_format (the CBFT_TRACE
+        # pattern: env wins at boot, config is the durable knob);
+        # normalized so CBFT_LOG_FORMAT=JSON means json, and an unknown
+        # value fails loudly in set_default_format below
+        log_fmt = (os.environ.get("CBFT_LOG_FORMAT", "").strip().lower()
+                   or config.base.log_format)
         if logger is None:
             logger = cmtlog.Logger(
                 level=cmtlog.parse_level(config.base.log_level),
-                fmt=config.base.log_format,
+                fmt=log_fmt,
             )
         super().__init__("Node", logger)
         self.config = config
         config.validate_basic()
+
+        # process-wide default log format: deep library log sites
+        # (kernels, scheduler, supervisors) follow the node's choice, and
+        # JSON records carry trace/span ids for slow-batch correlation
+        cmtlog.set_default_format(log_fmt)
+        # flight recorder (libs/trace.py): ARM-only — a node booting with
+        # tracing off never disarms a tracer a test/bench armed directly.
+        # CBFT_TRACE overlays the config knob (the CBFT_CHAOS pattern).
+        inst = config.instrumentation
+        env_trace = os.environ.get("CBFT_TRACE")
+        tracing = (env_trace.strip().lower() not in ("", "0", "false",
+                                                     "off", "no")
+                   if env_trace is not None else inst.tracing)
+        if tracing:
+            cmttrace.configure(
+                enabled=True, capacity=inst.trace_buffer_spans,
+                slow_ms=inst.trace_slow_ms,
+                slow_captures=inst.trace_slow_captures)
 
         # crypto backend selection + device-fault supervision knobs
         # (BASELINE: --crypto.backend flag; ops/dispatch.py supervisor)

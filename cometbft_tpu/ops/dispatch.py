@@ -36,6 +36,8 @@ import random
 import threading
 import time
 
+from cometbft_tpu.libs import trace as _trace
+
 KERNEL_DISPATCH_LOCK = threading.Lock()
 
 # failure classes
@@ -131,6 +133,11 @@ class CircuitBreaker:
         self._publish(CLOSED, transition=False)
 
     def _publish(self, state: str, transition: bool = True) -> None:
+        if transition:
+            # breaker flips land in the flight recorder as instant events:
+            # a trace showing a fetch stall next to `breaker.open` answers
+            # "did the device die or did the wire?" without log archaeology
+            _trace.event(f"breaker.{state}", cat="device", breaker=self.name)
         m = _metrics()
         if m is None:
             return
@@ -251,6 +258,7 @@ class DeviceSupervisor:
     def _count_retry(self) -> None:
         with self._lock:
             self.retries += 1
+        _trace.event("device.retry", cat="device", supervisor=self.name)
         m = _metrics()
         if m is not None:
             try:
@@ -430,6 +438,10 @@ def health_snapshot() -> dict:
         # the verify plane's batching layer: producers feed the global
         # scheduler, the scheduler feeds these supervisors
         "verify_sched": sched.health_snapshot(),
+        # rolling per-batch wall-time attribution (libs/trace.py): stage-
+        # share percentages + measured bytes-per-sig — the number the
+        # mesh / reduced-send PRs are judged against
+        "attribution": _trace.attribution(),
     }
     try:
         # staging plane: hash rung usage, reduced-fetch happy/full split,

@@ -38,12 +38,23 @@ import jax.numpy as jnp
 import numpy as np
 
 from cometbft_tpu.crypto import ed25519_math as oracle
+from cometbft_tpu.libs import trace as _trace
 from cometbft_tpu.ops import curve
 from cometbft_tpu.ops import limbs as L
 from cometbft_tpu.ops import unpack as U
 
 MIN_BUCKET = 8
 MAX_BUCKET_LOG2 = 17  # 128k lanes
+
+
+def _staging_rung() -> str:
+    """hashvec rung label for staging trace spans (never raises)."""
+    try:
+        from cometbft_tpu.ops import hashvec
+
+        return hashvec.active_rung()
+    except Exception:  # noqa: BLE001 - tracing must never break staging
+        return "unknown"
 
 _ID_ENC32 = (1).to_bytes(32, "little")  # y=1: the identity point encoding
 
@@ -269,9 +280,10 @@ def host_oracle_mask(n, pre_ok, ok_a, rows, info) -> np.ndarray:
     over the batch rows. Counts the lanes as fallback verifies."""
     verify_fn = info[0]
     pubs, msgs, sigs = rows
-    host = np.fromiter(
-        (verify_fn(p, m, s) for p, m, s in zip(pubs, msgs, sigs)),
-        dtype=bool, count=n)
+    with _trace.span("host_oracle", cat="compute", scheme=info[1], rows=n):
+        host = np.fromiter(
+            (verify_fn(p, m, s) for p, m, s in zip(pubs, msgs, sigs)),
+            dtype=bool, count=n)
     _count_fallback(info[1], n)
     return host & pre_ok & ok_a
 
@@ -486,6 +498,9 @@ class PubKeyCache:
         dev = None
         for attempt in (1, 2):
             dev = tuple(put(a) for a in host_arrs)
+            # coordinate-table upload bytes (per attempt: a retry really
+            # re-crosses the wire) against the enclosing transfer span
+            _trace.add_bytes(tx=sum(a.nbytes for a in host_arrs))
             # upload-time integrity check: a corrupted coordinate table
             # would poison EVERY batch against this valset until eviction,
             # so the one extra round trip per cache miss is paid here
@@ -537,6 +552,7 @@ def _stage_gather(cache: "PubKeyCache", pubs: list[bytes], bucket: int,
     idx[: len(pubs)] = [pos[p] for p in pubs]
     ok_a = np.asarray(ok_u)[idx[: len(pubs)]]
     idx_dev = jax.device_put(idx)
+    _trace.add_bytes(tx=idx.nbytes)
     return ok_a, _gather_coords(dev_u, idx_dev)
 
 
@@ -781,7 +797,9 @@ def supervised_device_thunk(scheme: str, sup, submit_fn, fetch_site: str,
     the StagingPool block backing the staged words, returned to the pool
     once the batch resolves (the _redo retry re-reads it, so release waits
     for resolution, not dispatch)."""
-    fut = _xfer_pool().submit(sup.run, submit_fn)
+    # wrap_ctx carries the caller's trace context onto the pool thread so
+    # the dispatch's transfer/compute spans land inside this batch's tree
+    fut = _xfer_pool().submit(_trace.wrap_ctx(sup.run), submit_fn)
     _lease = [lease]
 
     def _release() -> None:
@@ -809,14 +827,17 @@ def supervised_device_thunk(scheme: str, sup, submit_fn, fetch_site: str,
         catch it)."""
         from cometbft_tpu.libs import chaos
 
-        try:
-            chaos.fire(fetch_site)
-            out = _fetch_pool().submit(
-                lambda: np.asarray(dev_arr)).result(
-                    timeout=_dispatch.watchdog_timeout())
-        except Exception as exc:  # noqa: BLE001
-            sup.record_op_failure(exc)
-            raise _dispatch.DeviceOpFailed(f"{scheme} payload fetch") from exc
+        with _trace.span(f"{scheme}.d2h", cat="fetch") as sp:
+            try:
+                chaos.fire(fetch_site)
+                out = _fetch_pool().submit(
+                    lambda: np.asarray(dev_arr)).result(
+                        timeout=_dispatch.watchdog_timeout())
+            except Exception as exc:  # noqa: BLE001
+                sup.record_op_failure(exc)
+                raise _dispatch.DeviceOpFailed(
+                    f"{scheme} payload fetch") from exc
+            sp.add_bytes(rx=out.nbytes)
         return chaos.corrupt_mask(fetch_site, out)
 
     def _redo():
@@ -862,8 +883,9 @@ def supervised_device_thunk(scheme: str, sup, submit_fn, fetch_site: str,
             return host_oracle_mask(n, pre_ok, ok_a, rows, info)
         _count_fetch(False, header.nbytes + payload.nbytes)
         try:
-            return decode_payload(
-                payload, n, pre_ok, ok_a, rows, info, redo=_redo)
+            with _trace.span(f"{scheme}.decode", cat="resolve", rows=n):
+                return decode_payload(
+                    payload, n, pre_ok, ok_a, rows, info, redo=_redo)
         finally:
             _release()
 
@@ -902,8 +924,12 @@ def verify_batch_async(
 
     b = bucket_size(n)
     block = L.POOL.lease(b)
-    pre_ok, safe_pubs, r_words, s_words, k_words = stage_batch(
-        pubs, msgs, sigs, b, out=block)
+    # sig_rows: THE attribution row-counting site for this batch (one
+    # stage span per dispatched batch; everything else is informational)
+    with _trace.span("ed25519.stage", cat="stage", sig_rows=n, lanes=b,
+                     hash_rung=_staging_rung()):
+        pre_ok, safe_pubs, r_words, s_words, k_words = stage_batch(
+            pubs, msgs, sigs, b, out=block)
     rows = (safe_pubs, list(msgs), list(sigs))
     info = (oracle.verify_zip215, "ed25519", recheck_groups)
     sup = _dispatch.supervisor("device")
@@ -911,7 +937,9 @@ def verify_batch_async(
     a_dev = None
     if _dispatch.device_allowed():
         try:
-            ok_a, a_dev = _stage_gather(cache, safe_pubs, b)
+            with _trace.span("ed25519.stage_pubkeys", cat="transfer",
+                             lanes=b):
+                ok_a, a_dev = _stage_gather(cache, safe_pubs, b)
         except Exception as exc:  # noqa: BLE001 - device died in staging
             sup.record_op_failure(exc)
     if a_dev is None:
@@ -923,11 +951,15 @@ def verify_batch_async(
         from cometbft_tpu.libs import chaos
 
         chaos.fire("ed25519.dispatch")
-        rw = jnp.asarray(r_words)
-        sw = jnp.asarray(s_words)
-        kw = jnp.asarray(k_words)
-        mask, allok = _dispatch_verify(a_dev, rw, sw, kw)
-        parts = _integrity_parts(mask, allok, rw, sw, kw, expected)
+        with _trace.span("ed25519.h2d", cat="transfer", lanes=b) as sp:
+            rw = jnp.asarray(r_words)
+            sw = jnp.asarray(s_words)
+            kw = jnp.asarray(k_words)
+            sp.add_bytes(
+                tx=r_words.nbytes + s_words.nbytes + k_words.nbytes)
+        with _trace.span("ed25519.dispatch", cat="compute", lanes=b):
+            mask, allok = _dispatch_verify(a_dev, rw, sw, kw)
+            parts = _integrity_parts(mask, allok, rw, sw, kw, expected)
         _count_device_batch("ed25519", b)
         return parts
 
@@ -980,9 +1012,12 @@ def resolve_batches(thunks) -> list[np.ndarray]:
     if live:
         sup = _dispatch.supervisor("device")
         try:
-            headers = _fetch_pool().submit(
-                _pull, [h for h, _ in live]).result(
-                    timeout=_dispatch.watchdog_timeout())
+            with _trace.span("resolve.header_fetch", cat="fetch",
+                             batches=len(live)) as sp:
+                headers = _fetch_pool().submit(
+                    _pull, [h for h, _ in live]).result(
+                        timeout=_dispatch.watchdog_timeout())
+                sp.add_bytes(rx=headers.nbytes)
         except Exception as exc:  # noqa: BLE001 - window falls to the CPU rung
             sup.record_op_failure(exc)
     verdicts: list[str | None] = []  # parallel to pairs; None = host oracle

@@ -133,6 +133,20 @@ def native_available() -> bool:
     return _NATIVE is not None
 
 
+def active_rung() -> str:
+    """The rung the next batch hash will ride given the forced mode and
+    what loaded: "native" (SIMD C core), "numpy" (batch-axis), or
+    "serial" (hashlib/strobe stragglers). Stamped onto staging trace
+    spans (libs/trace.py) so a trace shows WHICH hash ladder produced a
+    given stage_us."""
+    m = _mode()
+    if m == "auto":
+        return "native" if _NATIVE is not None else "numpy"
+    if m == "native" and _NATIVE is None:
+        return "numpy"
+    return m
+
+
 # ---------------------------------------------------------------- keccak rung
 #
 # State layout matches crypto/sr25519_math.keccak_f1600: lane i = x + 5*y,

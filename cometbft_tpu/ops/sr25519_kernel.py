@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from cometbft_tpu.crypto import sr25519_math as srm
+from cometbft_tpu.libs import trace as _trace
 from cometbft_tpu.ops import curve
 from cometbft_tpu.ops import field as F
 from cometbft_tpu.ops import limbs as L
@@ -225,7 +226,8 @@ def stage_batch_sr(
     # decoded coords once; repeated/tiled keys cost 4 bytes/lane)
     from cometbft_tpu.ops.ed25519_kernel import _stage_gather
 
-    ok_a, a_dev = _stage_gather(cache, safe_pubs, b, put_key="sr")
+    with _trace.span("sr25519.stage_pubkeys", cat="transfer", lanes=b):
+        ok_a, a_dev = _stage_gather(cache, safe_pubs, b, put_key="sr")
     if out is None:
         out = np.empty((3, 8, b), dtype=np.uint32)
     r_words, s_words, k_words = out[0], out[1], out[2]
@@ -269,19 +271,34 @@ def verify_batch_async(
     sup = D.supervisor("device")
 
     staged = None
+    stage_counted = False
     block = L.POOL.lease(bucket_size(n))
     if D.device_allowed():
         try:
-            staged = stage_batch_sr(pubs, msgs, sigs, cache=cache, out=block)
+            # sig_rows: THE attribution row-counting site for this batch
+            # (mirrors ed25519_kernel.verify_batch_async)
+            with _trace.span("sr25519.stage", cat="stage", sig_rows=n,
+                             lanes=bucket_size(n),
+                             hash_rung=EK._staging_rung()):
+                stage_counted = True  # span finishes (and counts) even
+                staged = stage_batch_sr(pubs, msgs, sigs, cache=cache,
+                                        out=block)
         except Exception as exc:  # noqa: BLE001 - device died in staging
             sup.record_op_failure(exc)
     if staged is None:
         L.POOL.release(block)
         # structural pre-checks still run host-side so pre_ok keeps the
-        # identity-placeholder semantics of the device path
-        pre_ok = np.fromiter(
-            (len(p) == 32 and srm.parse_signature(s) is not None
-             for p, s in zip(pubs, sigs)), dtype=bool, count=n)
+        # identity-placeholder semantics of the device path. On the
+        # fully-degraded route (breaker open: the stage span above never
+        # ran) this is the row-counting site — otherwise degraded
+        # batches would grow compute_us with flat rows and inflate
+        # bytes-per-sig exactly during the episodes the flight recorder
+        # exists to diagnose
+        with _trace.span("sr25519.host_precheck", cat="stage",
+                         sig_rows=0 if stage_counted else n):
+            pre_ok = np.fromiter(
+                (len(p) == 32 and srm.parse_signature(s) is not None
+                 for p, s in zip(pubs, sigs)), dtype=bool, count=n)
         return EK.make_host_thunk(n, pre_ok, rows, info)
     pre_ok, ok_a, n, a_dev, r_np, s_np, k_np = staged
     expected = np.uint32(EK._host_checksum(r_np, s_np, k_np))
@@ -292,16 +309,21 @@ def verify_batch_async(
         chaos.fire("sr25519.dispatch")
         # any curve-kernel trace swaps field/curve module constants under
         # this lock (ops/dispatch.py); never trace concurrently
-        r_w = jnp.asarray(r_np)
-        s_w = jnp.asarray(s_np)
-        k_w = jnp.asarray(k_np)
-        with KERNEL_DISPATCH_LOCK:
-            from cometbft_tpu.ops import pallas_verify as PV
+        with _trace.span("sr25519.h2d", cat="transfer",
+                         lanes=r_np.shape[1]) as sp:
+            r_w = jnp.asarray(r_np)
+            s_w = jnp.asarray(s_np)
+            k_w = jnp.asarray(k_np)
+            sp.add_bytes(tx=r_np.nbytes + s_np.nbytes + k_np.nbytes)
+        with _trace.span("sr25519.dispatch", cat="compute",
+                         lanes=r_np.shape[1]):
+            with KERNEL_DISPATCH_LOCK:
+                from cometbft_tpu.ops import pallas_verify as PV
 
-            mask, allok = _pallas_gate.run(
-                PV.verify_pallas_sr_ok, _verify_kernel_ok,
-                (*a_dev, r_w, s_w, k_w), r_w.shape[1])
-        parts = EK._integrity_parts(mask, allok, r_w, s_w, k_w, expected)
+                mask, allok = _pallas_gate.run(
+                    PV.verify_pallas_sr_ok, _verify_kernel_ok,
+                    (*a_dev, r_w, s_w, k_w), r_w.shape[1])
+            parts = EK._integrity_parts(mask, allok, r_w, s_w, k_w, expected)
         EK._count_device_batch("sr25519", r_w.shape[1])
         return parts
 

@@ -714,6 +714,44 @@ class Environment:
             self.node.evidence_pool.add_evidence(ev)
         return {"hash": _hex(evs[0].hash()) if evs else ""}
 
+    async def trace_dump(self, params: dict) -> dict:
+        """Flight-recorder dump (libs/trace.py, no reference analog):
+        the verify-plane span ring as Chrome trace-event JSON — save the
+        `chrome_trace` value to a file and load it at ui.perfetto.dev —
+        plus the rolling wall-time attribution. `format=spans` returns
+        the raw span records instead (the attribution-model input);
+        `slow=true` appends the slow-batch capture ring (full span trees
+        of batches/heights that blew the latency budget). Served in
+        inspect mode too: the tracer is process-global, so a post-mortem
+        over a crashed node's home can still read what the dying process
+        wrote if inspect runs in-process (e.g. tests)."""
+        import asyncio
+
+        from cometbft_tpu.libs import trace
+
+        fmt = str(params.get("format", "chrome") or "chrome")
+        out: dict = {
+            "enabled": trace.enabled(),
+            "spans_dropped": trace.dropped(),
+            "attribution": trace.attribution(),
+        }
+        # rendering a full 64k-span ring to dicts costs tens of ms —
+        # push it off the event loop consensus coroutines share, so
+        # pulling a dump doesn't inject the latency spike being debugged
+        loop = asyncio.get_running_loop()
+        if fmt == "spans":
+            out["spans"] = await loop.run_in_executor(None, trace.snapshot)
+        elif fmt == "chrome":
+            out["chrome_trace"] = await loop.run_in_executor(
+                None, trace.chrome_trace)
+        else:
+            raise RPCError(-32602, f"unknown trace_dump format {fmt!r}"
+                                   " (want chrome|spans)")
+        if self._bool_param(params.get("slow", False)):
+            out["slow_captures"] = await loop.run_in_executor(
+                None, trace.slow_captures)
+        return out
+
     # ------------------------------------------------------ unsafe routes
 
     @staticmethod
@@ -801,6 +839,7 @@ class Environment:
         return {
             "health": self.health,
             "crypto_health": self.crypto_health,
+            "trace_dump": self.trace_dump,
             "status": self.status,
             "net_info": self.net_info,
             "genesis": self.genesis,

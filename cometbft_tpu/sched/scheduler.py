@@ -58,6 +58,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from cometbft_tpu.libs import trace
+
 # priority classes, highest first (the wire values appear in metrics
 # labels and the crypto_health snapshot — keep in sync with README)
 CONSENSUS = "consensus"
@@ -192,6 +194,12 @@ class VerifyScheduler:
         # bounded submit->dispatch latency samples per class (bench/test
         # percentile source; the histogram metric is the scrape surface)
         self._lat: dict[str, list[float]] = {k: [] for k in CLASSES}
+        # warm the kernel import chain (jax + ops, ~2s cold) at
+        # construction: the first flush must never pay module imports
+        # inside its span — they would dominate its latency budget (a
+        # phantom slow-batch capture) and sink per-batch span coverage
+        from cometbft_tpu.ops import ed25519_kernel  # noqa: F401
+        from cometbft_tpu.ops import sr25519_kernel  # noqa: F401
 
     # ------------------------------------------------------------ metrics
 
@@ -266,6 +274,8 @@ class VerifyScheduler:
         grp = _Group(klass=klass, rows=list(rows), submitted_at=now,
                      unit=self._next_unit(), deadline=deadline,
                      futures=[concurrent.futures.Future() for _ in rows])
+        trace.event("sched.submit", cat="sched", klass=klass,
+                    rows=len(grp.rows))
         with self._cond:
             depth = self._depth[klass]
             if klass == MEMPOOL:
@@ -318,8 +328,15 @@ class VerifyScheduler:
             for g in own:
                 g.resolve(np.zeros(0, dtype=bool))
             return [g.mask for g in own]
-        riders = self._take_riders(n_own)
-        self._dispatch(own + riders)
+        # root span: one inline drain == one batch lifecycle; a drain
+        # slower than the latency budget keeps its full tree (slow-batch
+        # capture ring)
+        with trace.span("sched.verify", cat="sched", klass=klass,
+                        rows=n_own, groups=len(own)) as sp:
+            riders = self._take_riders(n_own)
+            if riders:
+                sp.set(rider_rows=sum(len(g.rows) for g in riders))
+            self._dispatch(own + riders)
         return [g.mask for g in own]
 
     def flush(self) -> int:
@@ -430,14 +447,30 @@ class VerifyScheduler:
         sub-batch through the existing ladder (TPU kernels under the
         supervisor/breaker, else the registry CPU verifier), resolve all
         device thunks with ONE fetch, slice masks back per group."""
+        n_rows = sum(len(g.rows) for g in groups)
+        if trace.enabled():
+            # queue attribution: each group's submit->dispatch wait (an
+            # interval on the group, not a span on any one thread).
+            # Inline-drain own groups contribute only their ~µs of
+            # residence, so the queue share stays dominated by groups
+            # that genuinely sat in the queue.
+            t_flush = self._clock()
+            for g in groups:
+                wait = t_flush - g.submitted_at
+                if wait > 0:
+                    trace.account("queue", wait)
+        lanes = self.bucket_lanes(n_rows)
+        flush_sp = trace.span("sched.flush", cat="sched", rows=n_rows,
+                              groups=len(groups), lanes=lanes,
+                              classes=",".join(sorted(
+                                  {g.klass for g in groups})))
         try:
-            masks = self._run_batch(groups)
+            with flush_sp:
+                masks = self._run_batch(groups)
         except Exception as exc:  # noqa: BLE001 - must not lose futures
             for g in groups:
                 g.fail(exc)
             raise
-        n_rows = sum(len(g.rows) for g in groups)
-        lanes = self.bucket_lanes(n_rows)
         now = self._clock()
         # ---- stats (under the lock: worker and inline drains dispatch
         # concurrently) + metrics
@@ -484,53 +517,75 @@ class VerifyScheduler:
         from cometbft_tpu.crypto import batch as crypto_batch
         from cometbft_tpu.ops import ed25519_kernel
 
-        backend = crypto_batch.resolve_backend()
         # scheme -> (pubs, msgs, sigs, bounds, [(group_idx, row_idx)])
         per: dict[str, dict] = {}
-        for gi, g in enumerate(groups):
-            for ri, (pub, msg, sig) in enumerate(g.rows):
-                scheme = pub.type_()
-                d = per.setdefault(scheme, {
-                    "pubs": [], "msgs": [], "sigs": [], "where": [],
-                    "bounds": [], "open": None,
-                })
-                if d["open"] != gi:
-                    if d["open"] is not None:
-                        d["bounds"].append((d["_b0"], len(d["sigs"])))
-                    d["open"] = gi
-                    d["_b0"] = len(d["sigs"])
-                d["pubs"].append(pub)
-                d["msgs"].append(bytes(msg))
-                d["sigs"].append(bytes(sig))
-                d["where"].append((gi, ri))
+        # batch preparation is all "stage": backend selection plus the
+        # scheme grouping/bounds pass (the span starts before
+        # resolve_backend so flush glue stays inside the coverage model)
+        with trace.span("sched.group_rows", cat="stage",
+                        rows=sum(len(g.rows) for g in groups)):
+            backend = crypto_batch.resolve_backend()
+            for gi, g in enumerate(groups):
+                for ri, (pub, msg, sig) in enumerate(g.rows):
+                    scheme = pub.type_()
+                    d = per.setdefault(scheme, {
+                        "pubs": [], "msgs": [], "sigs": [], "where": [],
+                        "bounds": [], "open": None,
+                    })
+                    if d["open"] != gi:
+                        if d["open"] is not None:
+                            d["bounds"].append((d["_b0"], len(d["sigs"])))
+                        d["open"] = gi
+                        d["_b0"] = len(d["sigs"])
+                    d["pubs"].append(pub)
+                    d["msgs"].append(bytes(msg))
+                    d["sigs"].append(bytes(sig))
+                    d["where"].append((gi, ri))
+            for d in per.values():
+                if d["open"] is not None:
+                    d["bounds"].append((d["_b0"], len(d["sigs"])))
         thunks: list = []
         thunk_schemes: list[str] = []
         host_masks: dict[str, np.ndarray] = {}
-        for scheme, d in per.items():
-            if d["open"] is not None:
-                d["bounds"].append((d["_b0"], len(d["sigs"])))
-            if backend == "tpu" and scheme == "ed25519":
-                thunks.append(ed25519_kernel.verify_batch_async(
-                    [p.bytes_() for p in d["pubs"]], d["msgs"], d["sigs"],
-                    recheck_groups=d["bounds"]))
-                thunk_schemes.append(scheme)
-            elif backend == "tpu" and scheme == "sr25519":
-                from cometbft_tpu.ops import sr25519_kernel
+        # the whole dispatch-and-resolve phase sits inside one counted
+        # span so per-scheme loop glue, thunk construction, and the
+        # resolve call are covered flush time; nested counted children
+        # (host_verify here, the kernels' stage/transfer/fetch spans on
+        # the device path) subtract from its self time, leaving only the
+        # true glue attributed as compute
+        with trace.span("sched.dispatch", cat="compute",
+                        schemes=len(per)):
+            for scheme, d in per.items():
+                if backend == "tpu" and scheme == "ed25519":
+                    thunks.append(ed25519_kernel.verify_batch_async(
+                        [p.bytes_() for p in d["pubs"]], d["msgs"],
+                        d["sigs"], recheck_groups=d["bounds"]))
+                    thunk_schemes.append(scheme)
+                elif backend == "tpu" and scheme == "sr25519":
+                    from cometbft_tpu.ops import sr25519_kernel
 
-                thunks.append(sr25519_kernel.verify_batch_async(
-                    [p.bytes_() for p in d["pubs"]], d["msgs"], d["sigs"]))
-                thunk_schemes.append(scheme)
-            else:
-                host_masks[scheme] = self._host_mask(scheme, d)
-        if thunks:
-            resolved = ed25519_kernel.resolve_batches(thunks)
-            for scheme, mask in zip(thunk_schemes, resolved):
-                host_masks[scheme] = np.asarray(mask, dtype=bool)
-        out = [np.zeros(len(g.rows), dtype=bool) for g in groups]
-        for scheme, d in per.items():
-            mask = host_masks[scheme]
-            for (gi, ri), ok in zip(d["where"], mask):
-                out[gi][ri] = bool(ok)
+                    thunks.append(sr25519_kernel.verify_batch_async(
+                        [p.bytes_() for p in d["pubs"]], d["msgs"],
+                        d["sigs"]))
+                    thunk_schemes.append(scheme)
+                else:
+                    # sig_rows marks THE counting site for these rows
+                    # (rolling attribution row totals; every other span
+                    # annotates informational `rows` only)
+                    with trace.span("sched.host_verify", cat="compute",
+                                    scheme=scheme,
+                                    sig_rows=len(d["sigs"])):
+                        host_masks[scheme] = self._host_mask(scheme, d)
+            if thunks:
+                resolved = ed25519_kernel.resolve_batches(thunks)
+                for scheme, mask in zip(thunk_schemes, resolved):
+                    host_masks[scheme] = np.asarray(mask, dtype=bool)
+        with trace.span("sched.slice_masks", cat="resolve"):
+            out = [np.zeros(len(g.rows), dtype=bool) for g in groups]
+            for scheme, d in per.items():
+                mask = host_masks[scheme]
+                for (gi, ri), ok in zip(d["where"], mask):
+                    out[gi][ri] = bool(ok)
         return out
 
     @staticmethod

@@ -52,6 +52,89 @@ class TestPrimitives:
         assert 'cometbft_consensus_vote_extensions_received{status="accepted"} 1' in out
 
 
+class TestExpositionRoundTrip:
+    """ISSUE 6 exposition hardening: the rendered text must survive a
+    strict parse — escaped label values decode back to the original
+    strings, and each histogram label set renders in the order scrapers
+    require (cumulative buckets ascending, the mandatory le="+Inf", then
+    _sum, then _count)."""
+
+    @staticmethod
+    def _parse_labels(inner: str) -> dict:
+        """A deliberately strict exposition label parser: name="value"
+        pairs with \\\\ , \\" and \\n escapes — anything malformed
+        raises."""
+        out = {}
+        i = 0
+        while i < len(inner):
+            eq = inner.index("=", i)
+            name = inner[i:eq]
+            assert inner[eq + 1] == '"'
+            j = eq + 2
+            val = []
+            while inner[j] != '"':
+                if inner[j] == "\\":
+                    nxt = inner[j + 1]
+                    val.append({"\\": "\\", '"': '"', "n": "\n"}[nxt])
+                    j += 2
+                else:
+                    val.append(inner[j])
+                    j += 1
+            out[name] = "".join(val)
+            i = j + 1
+            if i < len(inner):
+                assert inner[i] == ","
+                i += 1
+        return out
+
+    def test_label_value_escaping_round_trip(self):
+        reg = Registry(namespace="t")
+        c = reg.counter("sub", "evil", "Evil labels", labels=("spec",))
+        nasty = 'quote " backslash \\ newline \n done'
+        c.labels(nasty).inc(3)
+        line = next(l for l in reg.render().splitlines()
+                    if l.startswith("t_sub_evil{"))
+        assert "\n" not in line  # raw newline would split the series line
+        inner = line[line.index("{") + 1:line.rindex("}")]
+        assert self._parse_labels(inner) == {"spec": nasty}
+        assert line.rsplit(" ", 1)[1] == "3"
+
+    def test_help_escaping(self):
+        reg = Registry(namespace="t")
+        reg.counter("sub", "h", "line one\nline two \\ slash")
+        out = reg.render()
+        assert "# HELP t_sub_h line one\\nline two \\\\ slash" in out
+
+    def test_histogram_series_order_and_escaping(self):
+        reg = Registry(namespace="t")
+        h = reg.histogram("sub", "lat", "Latency", labels=("klass",),
+                          buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 0.5, 5.0):
+            h.labels('a"b').observe(v)
+        lines = [l for l in reg.render().splitlines()
+                 if l.startswith("t_sub_lat")]
+        # exact per-label-set order: buckets ascending, +Inf, _sum, _count
+        kinds = [l.split("{")[0].rsplit(" ", 1)[0] for l in lines]
+        assert kinds == ["t_sub_lat_bucket", "t_sub_lat_bucket",
+                         "t_sub_lat_bucket", "t_sub_lat_sum",
+                         "t_sub_lat_count"]
+        les, counts = [], []
+        for line in lines[:3]:
+            inner = line[line.index("{") + 1:line.rindex("}")]
+            labels = self._parse_labels(inner)
+            assert labels["klass"] == 'a"b'
+            les.append(labels["le"])
+            counts.append(int(line.rsplit(" ", 1)[1]))
+        assert les == ["0.1", "1", "+Inf"]
+        # cumulative and consistent with _count / _sum
+        assert counts == sorted(counts) and counts[-1] == 4
+        assert float(lines[3].rsplit(" ", 1)[1]) == 6.05
+        assert int(lines[4].rsplit(" ", 1)[1]) == 4
+        # accessor pair used by bench/tests
+        assert h.sum_value('a"b') == 6.05
+        assert h.count_value('a"b') == 4
+
+
 def test_node_metrics_endpoint(tmp_path):
     """A live node serves Prometheus text at /metrics with consensus
     heights advancing."""
