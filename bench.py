@@ -127,19 +127,42 @@ def bench_device_compute(verify_fn, a_dev, rwd, swd, kwd,
     return (out[hi] - out[lo]) / (hi - lo) * 1e3
 
 
+def _run_stats(runs: list[float], converged: bool) -> dict:
+    """Honest spread over ALL post-warmup runs: median + p90 +
+    spread_pct ((p90 - min) / min). The old artifact reported min-vs-min
+    agreement as 'repeatability', which hid bimodal run lists like
+    [2.08, 8.63, 8.53, 8.66, 8.5, 1.99] behind a 4.3% figure."""
+    s = sorted(runs)
+    n = len(s)
+    median = s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2
+    p90 = s[min(n - 1, int(0.9 * (n - 1) + 0.999))]
+    return {
+        "runs": n,
+        "min_ms": round(s[0], 2),
+        "median_ms": round(median, 2),
+        "p90_ms": round(p90, 2),
+        "spread_pct": round((p90 - s[0]) / s[0] * 100, 1) if n > 1 else None,
+        "best_pair_converged": converged,
+    }
+
+
 def measure_device_compute(verify_fn, a_dev, rwd, swd, kwd, rep_pair=(2, 8),
                            tol_pct=10.0, max_tries=6, budget_s=240.0):
     """Defensible device-compute time: rep-difference repeatedly until the
     two SMALLEST runs agree within tol_pct (dev-box contention only ever
     inflates a slope, so the two quietest runs bracket the true kernel
     time), refusing non-positive slopes (a too-narrow pair under tunnel
-    noise). Returns (best_ms, runs_ms, repeatability_pct); repeatability is
-    None when only ONE positive run was obtained (never a fabricated 0.0),
-    and a value > tol_pct means the runs did not converge — both cases are
-    recorded as-is so the artifact is honest about its own quality. Raises
-    only if no positive slope was ever measured."""
+    noise). Returns (best_ms, runs_ms, stats): best is the min of the two
+    converged quietest runs (the defensible kernel-time claim), while
+    `stats` reports the HONEST spread over every post-warmup run —
+    median + p90 + spread_pct (_run_stats) — identically for every scheme
+    that calls this. A spread far above tol_pct means the box was noisy or
+    the measurement bimodal; both are recorded as-is so the artifact is
+    honest about its own quality. Raises only if no positive slope was
+    ever measured."""
     runs: list[float] = []
     pair = rep_pair
+    converged = False
     deadline = time.perf_counter() + budget_s  # contention must not stall
     for _ in range(max_tries):
         if time.perf_counter() > deadline and runs:
@@ -154,17 +177,14 @@ def measure_device_compute(verify_fn, a_dev, rwd, swd, kwd, rep_pair=(2, 8),
         runs.append(ms)
         if len(runs) >= 2:
             lo2 = sorted(runs)[:2]
-            rep = (lo2[1] - lo2[0]) / lo2[0] * 100
-            if rep <= tol_pct:
-                return lo2[0], [round(r, 2) for r in runs], round(rep, 1)
+            if (lo2[1] - lo2[0]) / lo2[0] * 100 <= tol_pct:
+                converged = True
+                break
     if not runs:
         raise RuntimeError(
             f"no positive slope after {max_tries} tries (pair widened to {pair})")
-    if len(runs) == 1:
-        return runs[0], [round(runs[0], 2)], None
-    lo2 = sorted(runs)[:2]
-    return lo2[0], [round(r, 2) for r in runs], round(
-        (lo2[1] - lo2[0]) / lo2[0] * 100, 1)
+    return (min(runs), [round(r, 2) for r in runs],
+            _run_stats(runs, converged))
 
 
 def bench_blocksync(detail: dict) -> None:
@@ -352,11 +372,14 @@ def bench_mixed_megacommit(detail: dict) -> None:
                   and not SRK._pallas_gate.broken)
     sr_fn = PVsr.verify_pallas_sr if use_pallas else SRK.verify_math_sr
     detail["sr25519_device_path"] = "pallas" if use_pallas else "xla"
-    sr_best, sr_runs, sr_rep = measure_device_compute(
+    sr_best, sr_runs, sr_stats = measure_device_compute(
         sr_fn, a_dev, rw, sw, kw, rep_pair=(2, 8))
     detail["sr25519_device_compute_ms"] = round(sr_best, 2)
     detail["sr25519_device_runs_ms"] = sr_runs
-    detail["sr25519_device_repeatability_pct"] = sr_rep
+    # honest spread over ALL post-warmup runs (median/p90/spread_pct) —
+    # repeatability_pct IS the spread now, same stat as ed25519's
+    detail["sr25519_device_repeatability_pct"] = sr_stats["spread_pct"]
+    detail["sr25519_device_run_stats"] = sr_stats
     detail["sr25519_device_batch"] = rw.shape[1]
     ed_ms = detail.get("device_compute_ms_per_batch")
     if isinstance(ed_ms, (int, float)):
@@ -426,6 +449,8 @@ def bench_attribution(detail: dict) -> None:
         "bytes_per_sig_* are measured off span wire-byte counters "
         "(h2d staged words + pubkey tables tx, reduced-fetch headers/"
         "payloads rx), not estimated from shapes")
+    # the live tunnel estimator's view of the same window lands once in
+    # the artifact, as the top-level `tunnel_model` detail (main())
     detail["attribution"] = attr
 
 
@@ -808,7 +833,7 @@ def bench_scheduler(detail: dict) -> None:
     detail["sched"] = out
 
 
-def main() -> None:
+def main() -> dict:
     import jax
 
     jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__), ".jax_cache"))
@@ -855,10 +880,13 @@ def main() -> None:
 
         ed_fn = PV.verify_pallas if K._pallas_available() else K.verify_math
         args = (jnp.asarray(rw), jnp.asarray(sw), jnp.asarray(kw))
-        best, runs, rep = measure_device_compute(ed_fn, a_dev, *args)
+        best, runs, stats = measure_device_compute(ed_fn, a_dev, *args)
         detail["device_compute_ms_per_batch"] = round(best, 2)
         detail["device_compute_runs_ms"] = runs
-        detail["device_repeatability_pct"] = rep
+        # same honest-spread stat as sr25519 (median/p90/spread over all
+        # post-warmup runs; min-vs-min agreement only as converged flag)
+        detail["device_repeatability_pct"] = stats["spread_pct"]
+        detail["device_compute_run_stats"] = stats
         device_sigs_per_s = BATCH / (best / 1e3)
         detail["device_sigs_per_s"] = round(device_sigs_per_s, 1)
         # Roofline statement (VERDICT r4 weak-9): the verify program
@@ -945,10 +973,33 @@ def main() -> None:
     if device_sigs_per_s is not None:
         detail["device_vs_batch_pinned"] = round(
             device_sigs_per_s / cpu_batch_pinned, 2)
-    detail["tunnel_cap_note"] = (
-        "stream headline is wire-bound: 96 B/sig over a ~22 MB/s, ~89 ms "
-        "RTT dev-box tunnel caps it near ~229k sigs/s regardless of kernel "
-        "speed; device_sigs_per_s is the chip-bound co-headline")
+    # live tunnel model (libs/linkmodel.py): the streaming window above
+    # fed the estimator with every measured h2d/fetch transfer, so the
+    # tunnel cap is now MEASURED per run instead of the hand-measured
+    # "~22 MB/s, ~89 ms" constants baked into earlier rounds' notes
+    from cometbft_tpu.libs import linkmodel
+
+    tun = linkmodel.tunnel()
+    detail["tunnel_model"] = tun.snapshot()
+    bw, rtt = tun.bandwidth_bps(), tun.rtt_seconds()
+    if rtt > 0:
+        detail["tunnel_note"] = (
+            f"single-batch latency includes the measured ~{rtt * 1e3:.0f} "
+            f"ms tunnel RTT floor (live estimate)")
+    if tun.converged() and bw > 0:
+        detail["tunnel_cap_sigs_per_s"] = round(bw / 96, 1)
+        detail["tunnel_cap_note"] = (
+            f"stream headline is wire-bound: 96 B/sig over a measured "
+            f"~{bw / 1e6:.1f} MB/s, ~{rtt * 1e3:.0f} ms RTT link (live "
+            f"EWMA estimate, libs/linkmodel.py) caps it near "
+            f"~{bw / 96 / 1e3:.0f}k sigs/s regardless of kernel speed; "
+            f"device_sigs_per_s is the chip-bound co-headline")
+    else:
+        detail["tunnel_cap_note"] = (
+            "stream headline is wire-bound (tunnel estimator did not "
+            "converge this run; historical dev-box figures ~22 MB/s, "
+            "~89 ms RTT cap it near ~229k sigs/s); device_sigs_per_s is "
+            "the chip-bound co-headline")
 
     # -- subsystem benches (each guarded: a failure reports, not aborts)
     for fn in (bench_blocksync, bench_mixed_megacommit, bench_attribution,
@@ -964,18 +1015,48 @@ def main() -> None:
     # dev-box tunnel contention (r3: 55.8k, a contended rerun: 15.5k for
     # the SAME kernel) and is kept in detail with the cap stated.
     headline = device_sigs_per_s if device_sigs_per_s else tpu_sigs_per_s
-    print(
-        json.dumps(
-            {
-                "metric": "ed25519_verify_throughput",
-                "value": round(headline, 1),
-                "unit": "sigs/sec/chip (device-bound)",
-                "vs_baseline": round(headline / cpu_batch_pinned, 2),
-                "detail": detail,
-            }
-        )
-    )
+    record = {
+        "metric": "ed25519_verify_throughput",
+        "value": round(headline, 1),
+        "unit": "sigs/sec/chip (device-bound)",
+        "vs_baseline": round(headline / cpu_batch_pinned, 2),
+        "detail": detail,
+    }
+    print(json.dumps(record))
+    return record
+
+
+def _cli() -> int:
+    """Plain `python bench.py` prints the one headline JSON line (the
+    driver contract, unchanged). `--compare BENCH_rNN.json` additionally
+    runs the regression sentinel (tools/bench_compare.py) against the
+    prior snapshot and prints its machine-readable verdict as a second
+    line — exit 1 when a tracked metric regressed past its threshold.
+    `--current saved.json` skips the run and diffs two files."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="bench.py")
+    p.add_argument("--compare", default="",
+                   help="prior snapshot (BENCH_rNN.json or a saved bench "
+                        "line) to diff this run against")
+    p.add_argument("--current", default="",
+                   help="with --compare: diff this saved run instead of "
+                        "running the bench")
+    args = p.parse_args()
+    if not args.compare:
+        main()
+        return 0
+    from tools import bench_compare
+
+    if args.current:
+        record = bench_compare.load_snapshot(args.current)
+    else:
+        record = main()
+    verdict = bench_compare.compare(
+        bench_compare.load_snapshot(args.compare), record)
+    print(json.dumps(verdict))
+    return 0 if verdict["verdict"] == "pass" else 1
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(_cli())

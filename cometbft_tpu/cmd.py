@@ -482,6 +482,54 @@ def cmd_trace_dump(args) -> int:
     return 0
 
 
+def cmd_netinfo(args) -> int:
+    """Fleet wire-plane view: pull the `net_telemetry` route off every
+    RPC endpoint in --endpoints (comma-separated; defaults to the single
+    --rpc.laddr) and print one JSON document — per-node per-peer/
+    per-channel accounting plus a fleet rollup (total wire bytes by
+    channel, stall time, tunnel/link estimates). The single-pane answer
+    to 'where do this net's wire bytes go'."""
+    import urllib.request
+
+    endpoints = [e for e in (args.endpoints or args.rpc_laddr).split(",") if e]
+    nodes = []
+    fleet_channels: dict = {}
+    fleet = {"send_bytes": 0, "recv_bytes": 0, "send_msgs": 0,
+             "recv_msgs": 0, "send_stall_seconds": 0.0, "n_peers": 0}
+    for ep in endpoints:
+        base = ep.removeprefix("tcp://")
+        if not base.startswith("http"):
+            base = "http://" + base
+        try:
+            with urllib.request.urlopen(f"{base}/net_telemetry",
+                                        timeout=10) as r:
+                env = json.loads(r.read())
+            tel = env.get("result", env)
+        except Exception as e:  # noqa: BLE001 - report reachability per node
+            nodes.append({"endpoint": ep, "error": str(e)})
+            continue
+        nodes.append({"endpoint": ep, **tel})
+        totals = tel.get("totals", {})
+        for k in ("send_bytes", "recv_bytes", "send_msgs", "recv_msgs"):
+            fleet[k] += totals.get(k, 0)
+        fleet["send_stall_seconds"] += totals.get("send_stall_seconds", 0.0)
+        fleet["n_peers"] += tel.get("n_peers", 0)
+        for ch_id, ch in tel.get("channels", {}).items():
+            agg = fleet_channels.setdefault(
+                ch_id, {"send_bytes": 0, "recv_bytes": 0,
+                        "send_msgs": 0, "recv_msgs": 0})
+            for k in agg:
+                agg[k] += ch.get(k, 0)
+    fleet["send_stall_seconds"] = round(fleet["send_stall_seconds"], 6)
+    print(json.dumps({
+        "nodes": nodes,
+        "fleet": {**fleet, "channels": fleet_channels,
+                  "nodes_reporting": sum(1 for n in nodes
+                                         if "error" not in n)},
+    }, indent=None if args.compact else 1))
+    return 0 if all("error" not in n for n in nodes) else 1
+
+
 def cmd_loadtime(args) -> int:
     """test/loadtime analog: 'run' drives stamped-tx load at RPC
     endpoints; 'report' recomputes per-tx latency from committed blocks."""
@@ -597,6 +645,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--slow", action="store_true",
                     help="also write the slow-batch capture ring")
     sp.set_defaults(fn=cmd_trace_dump)
+
+    sp = sub.add_parser(
+        "netinfo",
+        help="fleet wire-plane telemetry: per-peer/per-channel network "
+             "accounting + live link models across RPC endpoints")
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr",
+                    default="tcp://127.0.0.1:26657")
+    sp.add_argument("--endpoints", default="",
+                    help="comma-separated RPC endpoints (overrides "
+                         "--rpc.laddr; one net_telemetry pull each)")
+    sp.add_argument("--compact", action="store_true",
+                    help="single-line JSON output")
+    sp.set_defaults(fn=cmd_netinfo)
 
     sp = sub.add_parser("loadtime", help="tx load generator + latency report")
     sp.add_argument("mode", choices=["run", "report"])

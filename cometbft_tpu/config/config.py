@@ -213,6 +213,11 @@ class P2PConfig:
     # (p2p/netchaos.py syntax: latency/jitter/drop/dup/reorder/bandwidth/
     # partition); test/e2e only — CBFT_NET_CHAOS overlays this
     chaos: str = ""
+    # wire-plane metrics cardinality cap (libs/metrics.P2PMetrics): how
+    # many distinct peers get their own label on the per-peer Prometheus
+    # series before later peers fold into peer="other" — bounds the
+    # exposition on a large-fleet node
+    metrics_peer_cap: int = 32
     # misbehavior scoring / ban ledger (p2p/switch.py PeerScorer):
     # misbehavior score that triggers a ban, the first-offense ban window,
     # its cap as repeat offenses double it, and the score decay half-life
@@ -235,6 +240,8 @@ class P2PConfig:
                 raise ValueError(f"{name} must be a probability, got {v}")
         if self.test_fuzz_max_delay < 0:
             raise ValueError("test_fuzz_max_delay cannot be negative")
+        if self.metrics_peer_cap < 0:
+            raise ValueError("metrics_peer_cap cannot be negative")
         if self.ban_score_threshold <= 0:
             raise ValueError("ban_score_threshold must be positive")
         if self.ban_duration < 0 or self.ban_max_duration < 0:

@@ -236,6 +236,31 @@ def _kill(proc) -> None:
         pass
 
 
+def _write_net_report(net: _Net, names: list[str], log=print) -> str | None:
+    """Snapshot net_telemetry from every live node into
+    <out_dir>/net_report.json (the run report's wire-plane section).
+    Telemetry failures are recorded per node, never raised — the report
+    is an artifact, not an assertion."""
+    report = {"manifest": net.manifest.name, "nodes": {}}
+    for i, name in enumerate(names):
+        try:
+            report["nodes"][name] = _rpc(net, i, "net_telemetry",
+                                         timeout=5.0).get("result", {})
+        except Exception as e:  # noqa: BLE001
+            report["nodes"][name] = {"error": str(e)}
+    path = os.path.join(net.dir, "net_report.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+    except OSError as e:
+        log(f"[{net.manifest.name}] net report not written: {e}")
+        return None
+    ok = sum(1 for v in report["nodes"].values() if "error" not in v)
+    log(f"[{net.manifest.name}] wrote {path} "
+        f"({ok}/{len(names)} nodes reporting)")
+    return path
+
+
 def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
                  log=print) -> None:
     """Setup + start + perturb + verify + cleanup. Raises RunError on any
@@ -411,6 +436,11 @@ def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
                     f"(got {got!r})")
         log(f"[{manifest.name}] OK (height {h}, {n} nodes in agreement)")
     finally:
+        # wire-plane report: snapshot every node's net_telemetry into the
+        # run dir BEFORE teardown — on FAILED runs especially, this is the
+        # forensics record of where the wire bytes went (nodes that died
+        # are recorded as per-node errors, never raised)
+        _write_net_report(net, names, log=log)
         for p in net.node_procs:
             if p is not None:
                 _kill(p)

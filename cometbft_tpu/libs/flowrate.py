@@ -18,19 +18,28 @@ class Monitor:
         self.rate_limit = rate_limit  # bytes/sec; 0 = unlimited
         self.window = window
         self.bytes_total = 0
-        self._window_start = time.monotonic()
+        self.updates_total = 0
+        self.peak_rate = 0.0  # highest completed-window average seen
+        self._t0 = time.monotonic()
+        self._window_start = self._t0
         self._window_bytes = 0
         self._avg_rate = 0.0
 
     def update(self, n: int) -> float:
         """Record n transferred bytes; return seconds the caller should
-        sleep to stay under rate_limit (0.0 when unlimited/under budget)."""
+        sleep to stay under rate_limit (0.0 when unlimited/under budget).
+        Accounting (bytes_total / rate / peak_rate) is recorded whether or
+        not a limit is set — rate_limit=0 means non-throttling, never
+        non-measuring."""
         now = time.monotonic()
         self.bytes_total += n
+        self.updates_total += 1
         self._window_bytes += n
         elapsed = now - self._window_start
         if elapsed >= self.window:
             self._avg_rate = self._window_bytes / elapsed
+            if self._avg_rate > self.peak_rate:
+                self.peak_rate = self._avg_rate
             self._window_start = now
             self._window_bytes = 0
         if self.rate_limit <= 0:
@@ -45,3 +54,19 @@ class Monitor:
         if elapsed > 0.1:
             return self._window_bytes / elapsed
         return self._avg_rate
+
+    def lifetime_rate(self) -> float:
+        """bytes_total over the monitor's whole lifetime (bytes/sec)."""
+        elapsed = time.monotonic() - self._t0
+        return self.bytes_total / elapsed if elapsed > 0 else 0.0
+
+    def stats(self) -> dict:
+        """Snapshot for status()/telemetry consumers."""
+        return {
+            "bytes_total": self.bytes_total,
+            "updates_total": self.updates_total,
+            "rate_bytes_per_s": round(self.rate(), 1),
+            "lifetime_rate_bytes_per_s": round(self.lifetime_rate(), 1),
+            "peak_rate_bytes_per_s": round(self.peak_rate, 1),
+            "rate_limit": self.rate_limit,
+        }

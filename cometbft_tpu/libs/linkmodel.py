@@ -1,0 +1,186 @@
+"""Live link estimation: EWMA bandwidth/RTT models for the wires the node
+actually runs on.
+
+Two links dominate this framework's measured ceilings and both were, until
+now, hand-measured constants baked into bench notes ("~22 MB/s, ~89 ms
+RTT"):
+
+  the device tunnel   every h2d staging transfer and d2h result fetch
+                      crosses the host<->accelerator link (a network
+                      tunnel on the dev box, PCIe on a co-located host).
+                      The kernels report every measured transfer span here
+                      (ops/ed25519_kernel.py, ops/sr25519_kernel.py), so
+                      `tunnel()` converges on the REAL link within a few
+                      windows of traffic — crypto_health exposes it, the
+                      scheduler reads it, and the reduced-send work will
+                      be graded against it.
+  peer links          MConnection ping RTTs and flowrate throughput feed
+                      per-peer models (owned by the MConnection) plus the
+                      process-wide `p2p()` aggregate that net_telemetry
+                      reports.
+
+Estimation model (shared by both): a transfer of n bytes costs
+rtt_share + n/bandwidth. Small transfers (below `rtt_bytes`) are
+latency-dominated and update the RTT estimate; large ones (above
+`bw_bytes`) update bandwidth after subtracting the current RTT estimate
+from the measured wall time. Both estimates are exponentially weighted
+moving averages, so the model tracks a link whose quality drifts (a
+contended tunnel, a healing partition) instead of averaging history
+forever. `observe_rtt()` feeds pure round-trip measurements (p2p pings,
+header-only fetches) without a byte count.
+
+Everything is thread-safe and allocation-free on the observe path — these
+sites sit inside verify batches and send routines.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class LinkModel:
+    """EWMA bandwidth/RTT estimator for one link."""
+
+    def __init__(self, alpha: float = 0.2, rtt_bytes: int = 4096,
+                 bw_bytes: int = 65536):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError("alpha must be in (0, 1]")
+        self.alpha = alpha
+        self.rtt_bytes = rtt_bytes
+        self.bw_bytes = bw_bytes
+        self._lock = threading.Lock()
+        self._bw = 0.0  # bytes/sec EWMA (0 = no estimate yet)
+        self._rtt = 0.0  # seconds EWMA (0 = no estimate yet)
+        self._bw_samples = 0
+        self._rtt_samples = 0
+        self._bytes_total = 0
+        self._seconds_total = 0.0
+
+    # ---------------------------------------------------------- observing
+
+    def observe_rtt(self, seconds: float) -> None:
+        """A pure round-trip measurement (ping/pong, header-only fetch)."""
+        if seconds <= 0:
+            return
+        with self._lock:
+            self._rtt_samples += 1
+            self._rtt = (seconds if self._rtt == 0.0
+                         else self._rtt + self.alpha * (seconds - self._rtt))
+
+    def observe_transfer(self, nbytes: int, seconds: float) -> None:
+        """A measured transfer of nbytes taking seconds of wall time.
+        Small transfers refine RTT; large ones refine bandwidth (with the
+        RTT share subtracted, so a latency-heavy link doesn't read as
+        slow bandwidth)."""
+        if seconds <= 0 or nbytes < 0:
+            return
+        with self._lock:
+            self._bytes_total += nbytes
+            self._seconds_total += seconds
+            if nbytes <= self.rtt_bytes:
+                self._rtt_samples += 1
+                self._rtt = (seconds if self._rtt == 0.0
+                             else self._rtt + self.alpha * (seconds - self._rtt))
+                return
+            if nbytes < self.bw_bytes:
+                return  # mid-size: ambiguous between rtt and bandwidth
+            wire = seconds - self._rtt
+            if wire <= 0:
+                # faster than the RTT floor says is possible: the link got
+                # quicker — bleed the RTT estimate down and use raw time
+                self._rtt *= 1.0 - self.alpha
+                wire = seconds
+            sample = nbytes / wire
+            self._bw_samples += 1
+            self._bw = (sample if self._bw == 0.0
+                        else self._bw + self.alpha * (sample - self._bw))
+
+    # ------------------------------------------------------------ reading
+
+    def bandwidth_bps(self) -> float:
+        """Estimated link bandwidth in bytes/sec (0.0 = no estimate)."""
+        with self._lock:
+            return self._bw
+
+    def rtt_seconds(self) -> float:
+        """Estimated round-trip time in seconds (0.0 = no estimate)."""
+        with self._lock:
+            return self._rtt
+
+    def transfer_seconds(self, nbytes: int) -> float | None:
+        """Predicted wall time for an nbytes transfer (None until both
+        estimates exist) — the scheduler/reduced-send planning primitive."""
+        with self._lock:
+            if self._bw == 0.0:
+                return None
+            return self._rtt + nbytes / self._bw
+
+    def converged(self, min_samples: int = 3) -> bool:
+        with self._lock:
+            return self._bw_samples >= min_samples and self._rtt_samples >= 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "bandwidth_bytes_per_s": round(self._bw, 1),
+                "bandwidth_mb_per_s": round(self._bw / 1e6, 3),
+                "rtt_ms": round(self._rtt * 1e3, 3),
+                "bandwidth_samples": self._bw_samples,
+                "rtt_samples": self._rtt_samples,
+                "bytes_observed": self._bytes_total,
+                "seconds_observed": round(self._seconds_total, 3),
+                "converged": (self._bw_samples >= 3
+                              and self._rtt_samples >= 1),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._bw = self._rtt = 0.0
+            self._bw_samples = self._rtt_samples = 0
+            self._bytes_total = 0
+            self._seconds_total = 0.0
+
+
+# ---------------------------------------------------------------------------
+# process-global links. The device tunnel is a process-global resource
+# (like the device supervisors); the p2p aggregate pools every peer's ping
+# RTTs and flow rates into one "how is my network" view for net_telemetry.
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_tunnel: LinkModel | None = None
+_p2p: LinkModel | None = None
+
+
+def tunnel() -> LinkModel:
+    """The host<->device link (fed by the kernels' measured h2d/d2h
+    transfers — ops/ed25519_kernel.py, ops/sr25519_kernel.py)."""
+    global _tunnel
+    if _tunnel is None:
+        with _lock:
+            if _tunnel is None:
+                # thresholds sized to the kernels' real transfer mix: the
+                # 4 B/lane index uploads (<=2 KB at small buckets) probe
+                # RTT; staged-word uploads start at 24 KB for a 256-lane
+                # flush, so 16 KB+ counts toward bandwidth
+                _tunnel = LinkModel(alpha=0.2, rtt_bytes=2048,
+                                    bw_bytes=16384)
+    return _tunnel
+
+
+def p2p() -> LinkModel:
+    """The aggregate peer-link view (fed by MConnection ping RTTs)."""
+    global _p2p
+    if _p2p is None:
+        with _lock:
+            if _p2p is None:
+                _p2p = LinkModel(alpha=0.1, rtt_bytes=4096, bw_bytes=16384)
+    return _p2p
+
+
+def reset() -> None:
+    """Forget both process links (tests)."""
+    global _tunnel, _p2p
+    with _lock:
+        _tunnel = None
+        _p2p = None

@@ -262,14 +262,45 @@ class MempoolMetrics:
 
 
 class P2PMetrics:
-    """p2p/metrics.go."""
+    """p2p/metrics.go + the wire-plane accounting dimension.
 
-    def __init__(self, reg: Registry):
+    Cardinality policy: per-channel series label by `chID` (a handful of
+    values, fixed by the reactor set). Per-peer series label by a CAPPED
+    peer set — the first `peer_cap` distinct peers get their own label
+    (short node id); every later peer folds into an `other` bucket, so a
+    10k-peer fleet cannot explode the exposition. The cap is first-come
+    (stable across a scrape's lifetime); `peer_label()` is the one
+    chokepoint enforcing it."""
+
+    def __init__(self, reg: Registry, peer_cap: int = 32):
         self.peers = reg.gauge("p2p", "peers", "Connected peers")
         self.message_send_bytes = reg.counter(
             "p2p", "message_send_bytes_total", "Bytes sent", labels=("chID",))
         self.message_receive_bytes = reg.counter(
             "p2p", "message_receive_bytes_total", "Bytes received", labels=("chID",))
+        # wire-plane accounting (MConnection per-peer/per-channel counters;
+        # peer labels capped — see class docstring)
+        self.peer_send_bytes = reg.counter(
+            "p2p", "peer_send_bytes_total",
+            "Wire bytes sent per peer per channel (peer labels capped; "
+            "overflow peers fold into peer=\"other\")",
+            labels=("peer", "chID"))
+        self.peer_receive_bytes = reg.counter(
+            "p2p", "peer_receive_bytes_total",
+            "Wire bytes received per peer per channel (capped peer set)",
+            labels=("peer", "chID"))
+        self.peer_send_msgs = reg.counter(
+            "p2p", "peer_send_messages_total",
+            "Messages sent per peer per channel (capped peer set)",
+            labels=("peer", "chID"))
+        self.peer_receive_msgs = reg.counter(
+            "p2p", "peer_receive_messages_total",
+            "Messages received per peer per channel (capped peer set)",
+            labels=("peer", "chID"))
+        self.peer_ping_rtt = reg.gauge(
+            "p2p", "peer_ping_rtt_seconds",
+            "Last ping->pong round trip per peer (capped peer set)",
+            labels=("peer",))
         # misbehavior-scoring plane (p2p/switch.py PeerScorer): byzantine
         # peers must lose their connection slot, not just their messages
         self.peer_misbehavior = reg.counter(
@@ -278,6 +309,41 @@ class P2PMetrics:
         self.peer_bans = reg.counter(
             "p2p", "peer_bans",
             "Peers banned after repeated misbehavior")
+        self.peer_cap = peer_cap
+        self._peer_labels: dict[str, str] = {}
+        self._peer_lock = threading.Lock()
+
+    OTHER_PEER_LABEL = "other"
+
+    def peer_label(self, node_id: str) -> str:
+        """Bounded-cardinality peer label: the first peer_cap distinct
+        node ids map to their short id, everything after to "other"."""
+        if not node_id:
+            return self.OTHER_PEER_LABEL
+        with self._peer_lock:
+            label = self._peer_labels.get(node_id)
+            if label is None:
+                label = (node_id[:10] if len(self._peer_labels) < self.peer_cap
+                         else self.OTHER_PEER_LABEL)
+                self._peer_labels[node_id] = label
+            return label
+
+    def record_conn_traffic(self, peer_label: str, per_chan: dict,
+                            send: bool) -> None:
+        """Apply a batch of per-channel (bytes, msgs) deltas from one
+        MConnection flush. `peer_label` must already be capped (the
+        Switch hands each Peer its label at construction)."""
+        peer = peer_label or self.OTHER_PEER_LABEL
+        byte_m = self.peer_send_bytes if send else self.peer_receive_bytes
+        msg_m = self.peer_send_msgs if send else self.peer_receive_msgs
+        chan_m = self.message_send_bytes if send else self.message_receive_bytes
+        for cid, (nbytes, nmsgs) in per_chan.items():
+            ch = f"{cid:#x}" if isinstance(cid, int) else str(cid)
+            if nbytes:
+                byte_m.labels(peer, ch).inc(nbytes)
+                chan_m.labels(ch).inc(nbytes)
+            if nmsgs:
+                msg_m.labels(peer, ch).inc(nmsgs)
 
 
 class EvidenceMetrics:

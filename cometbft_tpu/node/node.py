@@ -223,7 +223,8 @@ class Node(BaseService):
         self.metrics_registry = cmtmetrics.Registry()
         self.consensus_metrics = cmtmetrics.ConsensusMetrics(self.metrics_registry)
         self.mempool_metrics = cmtmetrics.MempoolMetrics(self.metrics_registry)
-        self.p2p_metrics = cmtmetrics.P2PMetrics(self.metrics_registry)
+        self.p2p_metrics = cmtmetrics.P2PMetrics(
+            self.metrics_registry, peer_cap=config.p2p.metrics_peer_cap)
         self.evidence_metrics = cmtmetrics.EvidenceMetrics(self.metrics_registry)
         self.mempool.metrics = self.mempool_metrics
         self.evidence_pool.metrics = self.evidence_metrics

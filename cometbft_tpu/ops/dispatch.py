@@ -427,6 +427,8 @@ def health_snapshot() -> dict:
     from cometbft_tpu.crypto import batch as crypto_batch
     from cometbft_tpu.libs import chaos
 
+    from cometbft_tpu.libs import linkmodel as _linkmodel
+
     with _registry_lock:
         sups = dict(_supervisors)
     snap = {
@@ -442,6 +444,10 @@ def health_snapshot() -> dict:
         # share percentages + measured bytes-per-sig — the number the
         # mesh / reduced-send PRs are judged against
         "attribution": _trace.attribution(),
+        # live host<->device link model (libs/linkmodel.py): EWMA
+        # bandwidth/RTT fed by the kernels' measured h2d/d2h transfers —
+        # replaces the hand-measured "~22 MB/s, ~89 ms" tunnel constants
+        "tunnel": _linkmodel.tunnel().snapshot(),
     }
     try:
         # staging plane: hash rung usage, reduced-fetch happy/full split,

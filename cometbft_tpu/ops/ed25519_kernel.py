@@ -32,12 +32,14 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time as _time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from cometbft_tpu.crypto import ed25519_math as oracle
+from cometbft_tpu.libs import linkmodel as _linkmodel
 from cometbft_tpu.libs import trace as _trace
 from cometbft_tpu.ops import curve
 from cometbft_tpu.ops import limbs as L
@@ -497,10 +499,18 @@ class PubKeyCache:
         expected = _host_checksum(*host_arrs)
         dev = None
         for attempt in (1, 2):
+            t0 = _time.perf_counter()
             dev = tuple(put(a) for a in host_arrs)
+            # block before t1 (async dispatch would record enqueue time,
+            # not wire time); the checksum read below forces residency
+            # immediately after anyway
+            jax.block_until_ready(dev)
             # coordinate-table upload bytes (per attempt: a retry really
             # re-crosses the wire) against the enclosing transfer span
-            _trace.add_bytes(tx=sum(a.nbytes for a in host_arrs))
+            nbytes = sum(a.nbytes for a in host_arrs)
+            _linkmodel.tunnel().observe_transfer(
+                nbytes, _time.perf_counter() - t0)
+            _trace.add_bytes(tx=nbytes)
             # upload-time integrity check: a corrupted coordinate table
             # would poison EVERY batch against this valset until eviction,
             # so the one extra round trip per cache miss is paid here
@@ -551,7 +561,14 @@ def _stage_gather(cache: "PubKeyCache", pubs: list[bytes], bucket: int,
     idx = np.full(bucket, len(uniq), dtype=np.int32)  # padding -> identity
     idx[: len(pubs)] = [pos[p] for p in pubs]
     ok_a = np.asarray(ok_u)[idx[: len(pubs)]]
+    t0 = _time.perf_counter()
     idx_dev = jax.device_put(idx)
+    # the 4 B/lane index vector is the steady-state small upload — the
+    # tunnel model's h2d RTT probe (no pending compute to entangle with;
+    # blocked before t1 so async dispatch can't record enqueue time)
+    jax.block_until_ready(idx_dev)
+    _linkmodel.tunnel().observe_transfer(
+        idx.nbytes, _time.perf_counter() - t0)
     _trace.add_bytes(tx=idx.nbytes)
     return ok_a, _gather_coords(dev_u, idx_dev)
 
@@ -821,18 +838,27 @@ def supervised_device_thunk(scheme: str, sup, submit_fn, fetch_site: str,
 
     _acquire.expected = expected  # resolve_batches decodes headers itself
 
-    def _fetch_np(dev_arr) -> np.ndarray:
+    def _fetch_np(dev_arr, pure_transfer: bool = False) -> np.ndarray:
         """Device->host fetch (header or full payload): chaos site +
         watchdog + injected lane corruption (the integrity echo plane must
-        catch it)."""
+        catch it). Only a `pure_transfer` fetch feeds the link model: the
+        FIRST fetch of a batch blocks until the kernel finishes, so its
+        wall time is compute + wire — feeding that into the tunnel
+        estimator would inflate RTT by the kernel time. Once the header
+        has been read the device result is materialized, and the payload
+        fetch is pure wire."""
         from cometbft_tpu.libs import chaos
 
         with _trace.span(f"{scheme}.d2h", cat="fetch") as sp:
             try:
                 chaos.fire(fetch_site)
+                t0 = _time.perf_counter()
                 out = _fetch_pool().submit(
                     lambda: np.asarray(dev_arr)).result(
                         timeout=_dispatch.watchdog_timeout())
+                if pure_transfer:
+                    _linkmodel.tunnel().observe_transfer(
+                        out.nbytes, _time.perf_counter() - t0)
             except Exception as exc:  # noqa: BLE001
                 sup.record_op_failure(exc)
                 raise _dispatch.DeviceOpFailed(
@@ -877,7 +903,7 @@ def supervised_device_thunk(scheme: str, sup, submit_fn, fetch_site: str,
                 "reduced-fetch header failed its complement echo; pulling "
                 "the full payload", scheme=info[1])
         try:
-            payload = _fetch_np(payload_dev)
+            payload = _fetch_np(payload_dev, pure_transfer=True)
         except (_dispatch.DeviceOpFailed, _dispatch.DeviceUnavailable):
             _release()
             return host_oracle_mask(n, pre_ok, ok_a, rows, info)
@@ -952,11 +978,20 @@ def verify_batch_async(
 
         chaos.fire("ed25519.dispatch")
         with _trace.span("ed25519.h2d", cat="transfer", lanes=b) as sp:
+            t0 = _time.perf_counter()
             rw = jnp.asarray(r_words)
             sw = jnp.asarray(s_words)
             kw = jnp.asarray(k_words)
-            sp.add_bytes(
-                tx=r_words.nbytes + s_words.nbytes + k_words.nbytes)
+            # block before t1: device_put can dispatch asynchronously, and
+            # an enqueue-only timing would feed the link model microsecond
+            # "transfers" instead of wire time. The verify dispatch below
+            # needs these arrays resident anyway, and this thread is the
+            # transfer pool — blocking it is the design.
+            jax.block_until_ready((rw, sw, kw))
+            nbytes = r_words.nbytes + s_words.nbytes + k_words.nbytes
+            _linkmodel.tunnel().observe_transfer(
+                nbytes, _time.perf_counter() - t0)
+            sp.add_bytes(tx=nbytes)
         with _trace.span("ed25519.dispatch", cat="compute", lanes=b):
             mask, allok = _dispatch_verify(a_dev, rw, sw, kw)
             parts = _integrity_parts(mask, allok, rw, sw, kw, expected)
@@ -1014,6 +1049,9 @@ def resolve_batches(thunks) -> list[np.ndarray]:
         try:
             with _trace.span("resolve.header_fetch", cat="fetch",
                              batches=len(live)) as sp:
+                # NOT fed to the link model: this fetch blocks until every
+                # batch's kernel finishes, so its wall time is compute-
+                # entangled (the post-header payload pull below is pure)
                 headers = _fetch_pool().submit(
                     _pull, [h for h, _ in live]).result(
                         timeout=_dispatch.watchdog_timeout())
@@ -1038,8 +1076,11 @@ def resolve_batches(thunks) -> list[np.ndarray]:
     if need_payload:
         sup = _dispatch.supervisor("device")
         try:
+            t0 = _time.perf_counter()
             flat = _fetch_pool().submit(_pull, need_payload).result(
                 timeout=_dispatch.watchdog_timeout())
+            _linkmodel.tunnel().observe_transfer(
+                flat.nbytes, _time.perf_counter() - t0)
         except Exception as exc:  # noqa: BLE001 - those batches go host-side
             sup.record_op_failure(exc)
     if headers is not None:

@@ -22,11 +22,14 @@ reach the device.
 
 from __future__ import annotations
 
+import time as _time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from cometbft_tpu.crypto import sr25519_math as srm
+from cometbft_tpu.libs import linkmodel as _linkmodel
 from cometbft_tpu.libs import trace as _trace
 from cometbft_tpu.ops import curve
 from cometbft_tpu.ops import field as F
@@ -311,10 +314,17 @@ def verify_batch_async(
         # this lock (ops/dispatch.py); never trace concurrently
         with _trace.span("sr25519.h2d", cat="transfer",
                          lanes=r_np.shape[1]) as sp:
+            t0 = _time.perf_counter()
             r_w = jnp.asarray(r_np)
             s_w = jnp.asarray(s_np)
             k_w = jnp.asarray(k_np)
-            sp.add_bytes(tx=r_np.nbytes + s_np.nbytes + k_np.nbytes)
+            # block before t1: async dispatch would record enqueue time,
+            # not wire time (the kernel needs these resident anyway)
+            jax.block_until_ready((r_w, s_w, k_w))
+            nbytes = r_np.nbytes + s_np.nbytes + k_np.nbytes
+            _linkmodel.tunnel().observe_transfer(
+                nbytes, _time.perf_counter() - t0)
+            sp.add_bytes(tx=nbytes)
         with _trace.span("sr25519.dispatch", cat="compute",
                          lanes=r_np.shape[1]):
             with KERNEL_DISPATCH_LOCK:

@@ -12,14 +12,25 @@ via libs/flowrate (connection.go:44-45).
 Wire: varint-length-delimited protobuf Packet envelopes
 (proto/tendermint/p2p/conn.proto shape): oneof ping=1 / pong=2 /
 msg=3{channel_id=1, eof=2, data=3}.
+
+Wire-plane accounting (framework extension): every connection keeps
+per-channel byte/message/packet counters for both directions, send-queue
+depth high-water marks, send-routine stall time (rate-limit sleeps +
+blocked socket writes), and a ping-RTT EWMA — surfaced via status(), the
+net_telemetry RPC route, and (through the owning Switch's P2PMetrics)
+bounded-cardinality Prometheus series. The flowrate monitors are ALWAYS
+updated, throttling or not: rate_limit=0 keeps them non-throttling, so
+accounting never depends on rate limiting being enabled.
 """
 
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass
 from typing import Awaitable, Callable, Optional
 
+from cometbft_tpu.libs import linkmodel
 from cometbft_tpu.libs import log as cmtlog
 from cometbft_tpu.libs.flowrate import Monitor
 from cometbft_tpu.libs.service import TaskRunner
@@ -54,6 +65,21 @@ class _Channel:
         self.sent_pos = 0
         self.recently_sent = 0  # decayed sent-bytes counter for scheduling
         self.recving = bytearray()
+        # wire accounting (monotonic counters; bytes are WIRE bytes — the
+        # encoded packet envelope, so per-channel sums match the flowrate
+        # monitor totals and the actual conn-seam traffic)
+        self.send_bytes = 0
+        self.send_msgs = 0
+        self.send_packets = 0
+        self.recv_bytes = 0
+        self.recv_msgs = 0
+        self.recv_packets = 0
+        self.queue_hwm = 0  # send-queue depth high-water mark
+
+    def note_queued(self) -> None:
+        depth = self.send_queue.qsize()
+        if depth > self.queue_hwm:
+            self.queue_hwm = depth
 
     def has_data(self) -> bool:
         return bool(self.sending) or not self.send_queue.empty()
@@ -69,6 +95,8 @@ class _Channel:
         if eof:
             self.sending = b""
             self.sent_pos = 0
+            self.send_msgs += 1
+        self.send_packets += 1
         self.recently_sent += len(chunk)
         return chunk, eof
 
@@ -85,6 +113,8 @@ class MConnection:
         on_error: Callable[[Exception], Awaitable[None]],
         config: MConnConfig | None = None,
         logger: cmtlog.Logger | None = None,
+        metrics=None,  # libs.metrics.P2PMetrics | None
+        peer_label: str = "",  # pre-capped metrics label for this peer
     ):
         self.config = config or MConnConfig()
         self._conn = conn
@@ -102,6 +132,19 @@ class MConnection:
         self._tasks = TaskRunner("mconn")
         self._stopped = False  # no new sends / no more error callbacks
         self._torn_down = False  # tasks cancelled + socket closed
+        self.metrics = metrics
+        self.peer_label = peer_label
+        # send-routine stall accounting: seconds the routine spent NOT
+        # idle-parked — asleep on the rate limiter or blocked in a socket
+        # write (TCP backpressure); the "is the wire the bottleneck"
+        # number for this peer
+        self._stall_rate_limit_s = 0.0
+        self._stall_write_s = 0.0
+        # ping RTT EWMA (alpha 0.2) + last sample; feeds the process-wide
+        # p2p link model for net_telemetry
+        self._ping_rtt_s = 0.0
+        self._ping_rtt_last_s = 0.0
+        self._ping_samples = 0
 
     # ----------------------------------------------------------- lifecycle
 
@@ -134,6 +177,7 @@ class MConnection:
             await asyncio.wait_for(ch.send_queue.put(msg), self.config.send_timeout)
         except asyncio.TimeoutError:
             return False
+        ch.note_queued()
         self._send_wake.set()
         return True
 
@@ -147,6 +191,7 @@ class MConnection:
             ch.send_queue.put_nowait(msg)
         except asyncio.QueueFull:
             return False
+        ch.note_queued()
         self._send_wake.set()
         return True
 
@@ -181,16 +226,28 @@ class MConnection:
                 # 100ms flush throttle analog — we flush per loop, batching
                 # whatever is ready)
                 n_packets = 0
+                flushed: dict[int, tuple[int, int]] = {}  # cid -> (bytes, msgs)
                 while ch is not None and n_packets < 16:
                     chunk, eof = ch.next_packet()
-                    batch += _encode_packet_msg(ch.desc.id, eof, chunk)
+                    pkt = _encode_packet_msg(ch.desc.id, eof, chunk)
+                    ch.send_bytes += len(pkt)
+                    b, m = flushed.get(ch.desc.id, (0, 0))
+                    flushed[ch.desc.id] = (b + len(pkt), m + (1 if eof else 0))
+                    batch += pkt
                     n_packets += 1
                     ch = self._pick_channel()
                 if batch:
+                    # ALWAYS update the monitor (rate_limit=0 keeps it
+                    # non-throttling): accounting must not depend on
+                    # throttling being enabled
                     delay = self._send_monitor.update(len(batch))
                     if delay > 0:
+                        self._stall_rate_limit_s += delay
                         await asyncio.sleep(delay)
+                    t0 = time.monotonic()
                     await self._conn.write(bytes(batch))
+                    self._stall_write_s += time.monotonic() - t0
+                    self._flush_metrics(flushed, send=True)
                 # decay scheduling counters
                 for c in self._channels.values():
                     c.recently_sent = int(c.recently_sent * 0.8)
@@ -199,13 +256,34 @@ class MConnection:
         except Exception as e:  # noqa: BLE001
             await self._error(e)
 
+    def _flush_metrics(self, per_chan: dict, send: bool) -> None:
+        """Hand aggregated per-channel (bytes, msgs) deltas to the owning
+        switch's P2PMetrics (bounded-cardinality peer labels live there).
+        Metrics failures must never error a connection."""
+        m = self.metrics
+        if m is None or not per_chan:
+            return
+        try:
+            m.record_conn_traffic(self.peer_label, per_chan, send=send)
+        except Exception:  # noqa: BLE001
+            pass
+
     # ---------------------------------------------------------------- recv
 
     async def _recv_routine(self) -> None:
+        # metric deltas accumulate here and flush on message boundaries
+        # (or every 32 packets mid-message) — the recv hot loop must not
+        # pay two locked counter updates per 1 KB packet when the send
+        # side batches up to 16 packets per flush
+        pending: dict[int, tuple[int, int]] = {}
+        pending_packets = 0
         try:
             while True:
-                packet = await self._read_packet()
-                delay = self._recv_monitor.update(len(packet))
+                packet, wire_len = await self._read_packet()
+                # ALWAYS update (accounting without throttling — see send);
+                # wire_len includes the varint length prefix, matching the
+                # sender's encoded-packet accounting byte for byte
+                delay = self._recv_monitor.update(wire_len)
                 if delay > 0:
                     await asyncio.sleep(delay)
                 kind, chan_id, eof, data = _decode_packet(packet)
@@ -218,22 +296,38 @@ class MConnection:
                     ch = self._channels.get(chan_id)
                     if ch is None:
                         raise ValueError(f"unknown channel {chan_id:#x}")
+                    ch.recv_bytes += wire_len
+                    ch.recv_packets += 1
                     ch.recving += data
                     if len(ch.recving) > ch.desc.recv_message_capacity:
                         raise ValueError(
                             f"recv message exceeds capacity on channel {chan_id:#x}"
                         )
+                    b, m = pending.get(chan_id, (0, 0))
+                    pending[chan_id] = (b + wire_len, m + (1 if eof else 0))
+                    pending_packets += 1
                     if eof:
+                        ch.recv_msgs += 1
                         msg = bytes(ch.recving)
                         ch.recving.clear()
+                        self._flush_metrics(pending, send=False)
+                        pending = {}
+                        pending_packets = 0
                         await self._on_receive(chan_id, msg)
+                    elif pending_packets >= 32:
+                        self._flush_metrics(pending, send=False)
+                        pending = {}
+                        pending_packets = 0
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001
             await self._error(e)
 
-    async def _read_packet(self) -> bytes:
-        """Read one varint-delimited packet from the secret connection."""
+    async def _read_packet(self) -> tuple[bytes, int]:
+        """Read one varint-delimited packet from the secret connection.
+        Returns (body, wire_len) where wire_len includes the length
+        prefix — the recv accounting must match the sender's
+        encoded-packet byte counts, not undercount by the varint."""
         # read varint length byte-by-byte (<=5 bytes for our sizes)
         hdr = b""
         while True:
@@ -246,7 +340,7 @@ class MConnection:
         n, _ = decode_uvarint(hdr)
         if n > self.config.max_packet_msg_payload_size + 64:
             raise ValueError(f"packet too large: {n}")
-        return await self._conn.readexactly(n)
+        return await self._conn.readexactly(n), len(hdr) + n
 
     async def _ping_routine(self) -> None:
         """Keepalive + dead-peer detection: a ping that is not answered
@@ -256,18 +350,40 @@ class MConnection:
             await asyncio.sleep(self.config.ping_interval)
             try:
                 self._pong_received.clear()
-                await self._conn.write(_encode_packet_ping())
+                ping = _encode_packet_ping()
+                self._send_monitor.update(len(ping))  # keepalives count too
+                t0 = time.monotonic()
+                await self._conn.write(ping)
                 try:
                     await asyncio.wait_for(
                         self._pong_received.wait(), self.config.pong_timeout
                     )
                 except asyncio.TimeoutError:
                     raise ConnectionError("pong timeout") from None
+                self._note_ping_rtt(time.monotonic() - t0)
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001
                 await self._error(e)
                 return
+
+    def _note_ping_rtt(self, rtt: float) -> None:
+        """Ping->pong round trip: EWMA per peer + the process-wide p2p
+        link model (net_telemetry's aggregate view). The pong rode the
+        send routine's batching, so this is an upper bound on the raw
+        link RTT — which is the honest number for protocol planning: a
+        vote pays the same queueing."""
+        self._ping_rtt_last_s = rtt
+        self._ping_samples += 1
+        self._ping_rtt_s = (rtt if self._ping_samples == 1
+                            else self._ping_rtt_s + 0.2 * (rtt - self._ping_rtt_s))
+        linkmodel.p2p().observe_rtt(rtt)
+        m = self.metrics
+        if m is not None:
+            try:
+                m.peer_ping_rtt.labels(self.peer_label or "other").set(rtt)
+            except Exception:  # noqa: BLE001
+                pass
 
     async def _error(self, e: Exception) -> None:
         if self._stopped:
@@ -281,13 +397,35 @@ class MConnection:
     # ---------------------------------------------------------------- misc
 
     def status(self) -> dict:
+        """Connection status incl. the wire-plane accounting: monitor
+        totals/averages, per-channel byte/msg/packet counters both ways,
+        queue depth + high-water, send-routine stall split, ping RTT EWMA.
+        net_info / net_telemetry serve this per peer."""
         return {
             "send_rate": self._send_monitor.rate(),
             "recv_rate": self._recv_monitor.rate(),
+            "send": self._send_monitor.stats(),
+            "recv": self._recv_monitor.stats(),
+            "send_stall_seconds": round(
+                self._stall_rate_limit_s + self._stall_write_s, 6),
+            "send_stall_split_seconds": {
+                "rate_limit": round(self._stall_rate_limit_s, 6),
+                "socket_write": round(self._stall_write_s, 6),
+            },
+            "ping_rtt_ms": round(self._ping_rtt_s * 1e3, 3),
+            "ping_rtt_last_ms": round(self._ping_rtt_last_s * 1e3, 3),
+            "ping_samples": self._ping_samples,
             "channels": {
                 f"{cid:#x}": {
                     "queued": ch.send_queue.qsize(),
+                    "queue_hwm": ch.queue_hwm,
                     "recently_sent": ch.recently_sent,
+                    "send_bytes": ch.send_bytes,
+                    "send_msgs": ch.send_msgs,
+                    "send_packets": ch.send_packets,
+                    "recv_bytes": ch.recv_bytes,
+                    "recv_msgs": ch.recv_msgs,
+                    "recv_packets": ch.recv_packets,
                 }
                 for cid, ch in self._channels.items()
             },

@@ -30,6 +30,8 @@ class Peer(BaseService):
         persistent: bool = False,
         mconn_config: MConnConfig | None = None,
         logger: cmtlog.Logger | None = None,
+        metrics=None,  # libs.metrics.P2PMetrics | None
+        peer_label: str = "",  # pre-capped metrics label (Switch assigns)
     ):
         super().__init__(f"peer-{node_info.node_id[:10]}", logger)
         self.node_info = node_info
@@ -47,6 +49,7 @@ class Peer(BaseService):
         self.mconn = MConnection(
             conn, channels, _mconn_receive, _mconn_error,
             config=mconn_config, logger=self.logger,
+            metrics=metrics, peer_label=peer_label,
         )
 
     # ------------------------------------------------------------- identity

@@ -273,6 +273,9 @@ class Switch(BaseService):
             persistent=persistent,
             mconn_config=self.mconn_config,
             logger=self.logger.with_fields(peer=node_id[:10]),
+            metrics=self.metrics,
+            peer_label=(self.metrics.peer_label(node_id)
+                        if self.metrics is not None else ""),
         )
         for reactor in self.reactors.values():
             reactor.init_peer(peer)
@@ -392,3 +395,49 @@ class Switch(BaseService):
 
     def get_peer(self, node_id: str) -> Optional[Peer]:
         return self.peers.get(node_id)
+
+    # ------------------------------------------------------------ telemetry
+
+    def net_telemetry(self) -> dict:
+        """The wire-plane accounting rollup the net_telemetry RPC route
+        serves: every peer's full MConnection status (per-channel
+        bytes/msgs/packets both ways, queue depth + high-water, stall
+        split, ping RTT) plus cross-peer totals per channel and for the
+        whole switch — 'where do my wire bytes go'."""
+        peers = []
+        totals = {"send_bytes": 0, "recv_bytes": 0,
+                  "send_msgs": 0, "recv_msgs": 0,
+                  "send_stall_seconds": 0.0}
+        by_channel: dict[str, dict] = {}
+        for p in list(self.peers.values()):
+            st = p.status()
+            peers.append({
+                "id": p.id,
+                "moniker": p.node_info.moniker,
+                "is_outbound": p.outbound,
+                "persistent": p.is_persistent(),
+                "connection_status": st,
+            })
+            totals["send_bytes"] += st["send"]["bytes_total"]
+            totals["recv_bytes"] += st["recv"]["bytes_total"]
+            totals["send_stall_seconds"] += st["send_stall_seconds"]
+            for ch_id, ch in st["channels"].items():
+                agg = by_channel.setdefault(ch_id, {
+                    "send_bytes": 0, "recv_bytes": 0,
+                    "send_msgs": 0, "recv_msgs": 0,
+                    "send_packets": 0, "recv_packets": 0,
+                    "queue_hwm": 0})
+                for k in ("send_bytes", "recv_bytes", "send_msgs",
+                          "recv_msgs", "send_packets", "recv_packets"):
+                    agg[k] += ch[k]
+                agg["queue_hwm"] = max(agg["queue_hwm"], ch["queue_hwm"])
+                totals["send_msgs"] += ch["send_msgs"]
+                totals["recv_msgs"] += ch["recv_msgs"]
+        totals["send_stall_seconds"] = round(totals["send_stall_seconds"], 6)
+        return {
+            "n_peers": len(peers),
+            "peers": peers,
+            "channels": by_channel,
+            "totals": totals,
+            "peer_scores": self.scorer.snapshot(),
+        }

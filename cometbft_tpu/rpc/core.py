@@ -157,6 +157,38 @@ class Environment:
             ],
         }
 
+    async def net_telemetry(self, _params: dict) -> dict:
+        """Wire-plane telemetry (no reference analog): the full per-peer/
+        per-channel network accounting rollup — bytes/msgs/packets both
+        directions per channel per peer, send-queue depth + high-water,
+        send-routine stall split, ping RTT EWMAs — plus the live link
+        models (the host<->device tunnel estimate the kernels feed, and
+        the aggregate p2p RTT view) and the armed net-chaos schedule.
+        `cometbft netinfo` renders this across a fleet; the e2e runner
+        snapshots it per node into the run report."""
+        from cometbft_tpu.libs import linkmodel
+        from cometbft_tpu.p2p import netchaos
+
+        sw = getattr(self.node, "switch", None)
+        # inspect mode serves a _NoSwitch stub: degrade to an empty rollup
+        # (link models + chaos snapshot below are process-global and real)
+        tele = getattr(sw, "net_telemetry", None)
+        wire = tele() if tele is not None else {
+            "n_peers": 0, "peers": [], "channels": {},
+            "totals": {}, "peer_scores": {}}
+        node_key = getattr(self.node, "node_key", None)
+        node_info = getattr(self.node, "node_info", None)
+        return {
+            "node_id": node_key.id() if node_key is not None else "",
+            "moniker": node_info.moniker if node_info is not None else "",
+            "listen_addr": (node_info.listen_addr
+                            if node_info is not None else ""),
+            **wire,
+            "tunnel": linkmodel.tunnel().snapshot(),
+            "p2p_link": linkmodel.p2p().snapshot(),
+            "net_chaos": netchaos.snapshot(),
+        }
+
     async def genesis(self, _params: dict) -> dict:
         import json
 
@@ -842,6 +874,7 @@ class Environment:
             "trace_dump": self.trace_dump,
             "status": self.status,
             "net_info": self.net_info,
+            "net_telemetry": self.net_telemetry,
             "genesis": self.genesis,
             "block": self.block,
             "block_by_hash": self.block_by_hash,

@@ -743,4 +743,24 @@ class VerifyScheduler:
             "worker_alive": bool(self._worker and self._worker.is_alive()),
             "max_lanes": self.max_lanes,
             "deadlines": dict(self.class_deadline),
+            "link": self._link_view(),
         }
+
+    def _link_view(self) -> dict:
+        """The scheduler's live view of the host<->device link
+        (libs/linkmodel.py, fed by the kernels' measured transfers):
+        estimated bandwidth/RTT plus the predicted wall cost of a
+        full-lane flush at ~96 B/sig — the planning primitive the
+        reduced-send work will shrink. Never raises (telemetry)."""
+        try:
+            from cometbft_tpu.libs import linkmodel
+
+            tun = linkmodel.tunnel()
+            out = tun.snapshot()
+            # current wire cost of one maximally-coalesced flush
+            est = tun.transfer_seconds(96 * self.max_lanes)
+            out["full_flush_wire_ms_at_96B_per_sig"] = (
+                round(est * 1e3, 2) if est is not None else None)
+            return out
+        except Exception:  # noqa: BLE001
+            return {}

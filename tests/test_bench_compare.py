@@ -1,0 +1,244 @@
+"""Bench regression sentinel tests (ISSUE 8): direction-aware thresholds,
+snapshot-shape handling (driver records with parsed=null tails), and the
+injected-regression self-test that turns the BENCH_r*.json trajectory
+into an enforced contract. perf-marked (tier-1-safe, selectable via
+`pytest -m perf` as the fast perf smoke)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools import bench_compare as bc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _record(**overrides) -> dict:
+    rec = {
+        "metric": "ed25519_verify_throughput",
+        "value": 800_000.0,
+        "unit": "sigs/sec/chip (device-bound)",
+        "detail": {
+            "device_sigs_per_s": 800_000.0,
+            "device_compute_ms_per_batch": 12.8,
+            "stream_sigs_per_s": 100_000.0,
+            "fetch_bytes_happy_path": 8,
+            "staging_us_per_row": {"ed25519": 0.7, "sr25519": 2.0},
+            "sched": {"fill_ratio_mean": 0.9},
+            "a_note": "strings are not metrics",
+            "runs": [1.0, 2.0],
+        },
+    }
+    for k, v in overrides.items():
+        rec["detail"][k] = v
+    return rec
+
+
+class TestFlatten:
+    def test_nested_numeric_leaves(self):
+        flat = bc.flatten(_record())
+        assert flat["value"] == 800_000.0
+        assert flat["staging_us_per_row.ed25519"] == 0.7
+        assert flat["sched.fill_ratio_mean"] == 0.9
+        assert "a_note" not in flat
+        assert "runs" not in flat  # lists are not comparable scalars
+
+
+class TestDirectionAwareCompare:
+    def test_identical_passes(self):
+        v = bc.compare(_record(), _record())
+        assert v["verdict"] == "pass"
+        assert v["regressions"] == []
+        assert v["tracked"] > 0
+
+    def test_throughput_drop_fails_and_rise_passes(self):
+        old = _record()
+        worse = _record()
+        worse["value"] = 500_000.0  # -37.5% vs 20% threshold
+        v = bc.compare(old, worse)
+        assert v["verdict"] == "fail"
+        assert "value" in v["regressions"]
+        assert v["metrics"]["value"]["verdict"] == "fail"
+        # the same delta as an improvement must PASS (direction-aware)
+        assert bc.compare(worse, old)["verdict"] == "pass"
+
+    def test_latency_rise_fails_and_drop_passes(self):
+        old = _record()
+        worse = _record(device_compute_ms_per_batch=20.0)  # +56%
+        v = bc.compare(old, worse)
+        assert "device_compute_ms_per_batch" in v["regressions"]
+        assert bc.compare(worse, old)["verdict"] == "pass"
+
+    def test_wire_bound_metrics_never_fail(self):
+        old = _record()
+        worse = _record(stream_sigs_per_s=10_000.0)  # -90%, wire-bound
+        v = bc.compare(old, worse)
+        assert v["verdict"] == "pass"
+        row = v["metrics"]["stream_sigs_per_s"]
+        assert row["verdict"] == "info"
+        assert "wire-bound" in row["why_info"]
+
+    def test_within_threshold_passes(self):
+        v = bc.compare(_record(), dict(_record(), value=700_000.0))  # -12.5%
+        assert v["metrics"]["value"]["verdict"] == "pass"
+
+    def test_new_and_missing_are_informational(self):
+        old = _record()
+        new = _record()
+        del new["detail"]["fetch_bytes_happy_path"]
+        new["detail"]["brand_new_metric"] = 42.0
+        v = bc.compare(old, new)
+        assert v["verdict"] == "pass"
+        assert v["metrics"]["fetch_bytes_happy_path"]["verdict"] == "missing"
+        assert v["metrics"]["brand_new_metric"]["verdict"] == "new"
+
+    def test_non_positive_baseline_is_info(self):
+        old = _record(sr25519_device_compute_ms=-4.58)
+        new = _record(sr25519_device_compute_ms=2.0)
+        row = bc.compare(old, new)["metrics"]["sr25519_device_compute_ms"]
+        assert row["verdict"] == "info"
+        assert "non-positive" in row["why_info"]
+
+    def test_threshold_scale_widens(self):
+        old = _record()
+        worse = dict(_record(), value=620_000.0)  # -22.5%
+        assert bc.compare(old, worse)["verdict"] == "fail"
+        assert bc.compare(old, worse,
+                          threshold_scale=1.5)["verdict"] == "pass"
+
+
+class TestSnapshotShapes:
+    def test_driver_record_with_parsed(self):
+        rec = bc.load_snapshot(os.path.join(REPO, "BENCH_r04.json"))
+        assert rec["value"] == 804844.9
+        assert bc.flatten(rec)["device_compute_ms_per_batch"] == 12.72
+
+    def test_driver_record_with_null_parsed_recovers_tail(self):
+        """BENCH_r05.json ships parsed=null and a front-truncated tail;
+        the sentinel must still recover comparable metrics from it."""
+        rec = bc.load_snapshot(os.path.join(REPO, "BENCH_r05.json"))
+        flat = bc.flatten(rec)
+        assert flat["sr25519_device_compute_ms"] == 1.99
+        assert flat["blocksync_blocks_per_s"] == 25.1
+
+    def test_raw_bench_line(self, tmp_path):
+        p = tmp_path / "cur.json"
+        p.write_text(json.dumps(_record()))
+        assert bc.load_snapshot(str(p))["value"] == 800_000.0
+
+    def test_unrecognized_shape_raises(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text('{"unrelated": 1}')
+        with pytest.raises(bc.SnapshotError):
+            bc.load_snapshot(str(p))
+
+
+@pytest.mark.perf
+class TestSentinelSelfTest:
+    """The CI perf smoke: a synthetically injected regression into a
+    copied snapshot MUST be flagged; the unmodified copy must not."""
+
+    def test_injected_regression_flagged_on_synthetic(self, tmp_path):
+        p = tmp_path / "base.json"
+        p.write_text(json.dumps(_record()))
+        res = bc.self_test(str(p), pct=30.0)
+        assert res["ok"], res
+        assert res["regression_verdict"] == "fail"
+        assert res["identical_verdict"] == "pass"
+        assert res["improvement_verdict"] == "pass"
+
+    def test_injected_regression_flagged_on_real_snapshots(self):
+        for name in ("BENCH_r04.json", "BENCH_r05.json"):
+            res = bc.self_test(os.path.join(REPO, name), pct=30.0)
+            assert res["ok"], (name, res)
+            assert res["injected_metric"] in res["regression_flagged"]
+
+    def test_injection_is_direction_aware(self):
+        base = _record()
+        worse, metric, pct = bc.inject_regression(base, pct=30.0,
+                                                  metric="value")
+        assert metric == "value" and pct == 30.0
+        assert worse["value"] == pytest.approx(800_000.0 * 0.7)
+        worse, _, _ = bc.inject_regression(
+            base, pct=30.0, metric="device_compute_ms_per_batch")
+        assert worse["detail"]["device_compute_ms_per_batch"] == \
+            pytest.approx(12.8 * 1.3)
+
+
+@pytest.mark.perf
+class TestEntryPoints:
+    def test_module_cli_self_test(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.bench_compare", "--self-test",
+             os.path.join(REPO, "BENCH_r04.json")],
+            capture_output=True, text=True, cwd=REPO, timeout=60)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert json.loads(out.stdout)["ok"] is True
+
+    def test_module_cli_flags_regression(self, tmp_path):
+        base = tmp_path / "base.json"
+        cur = tmp_path / "cur.json"
+        base.write_text(json.dumps(_record()))
+        worse, _, _ = bc.inject_regression(_record(), pct=35.0,
+                                           metric="value")
+        cur.write_text(json.dumps(worse))
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.bench_compare",
+             str(base), str(cur)],
+            capture_output=True, text=True, cwd=REPO, timeout=60)
+        assert out.returncode == 1
+        assert "value" in json.loads(out.stdout)["regressions"]
+
+    def test_bench_py_compare_current_mode(self, tmp_path):
+        """bench.py --compare OLD --current NEW diffs without running the
+        bench (no device, no jax import needed)."""
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps(_record()))
+        out = subprocess.run(
+            [sys.executable, "bench.py", "--compare", str(base),
+             "--current", str(base)],
+            capture_output=True, text=True, cwd=REPO, timeout=60)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert json.loads(out.stdout.splitlines()[-1])["verdict"] == "pass"
+        worse, _, _ = bc.inject_regression(_record(), pct=35.0,
+                                           metric="value")
+        cur = tmp_path / "cur.json"
+        cur.write_text(json.dumps(worse))
+        out = subprocess.run(
+            [sys.executable, "bench.py", "--compare", str(base),
+             "--current", str(cur)],
+            capture_output=True, text=True, cwd=REPO, timeout=60)
+        assert out.returncode == 1
+        assert json.loads(out.stdout.splitlines()[-1])["verdict"] == "fail"
+
+
+class TestHonestSpreadStats:
+    """Satellite: the bench's device-timing repeatability stat must report
+    the spread over ALL post-warmup runs (median + p90 + spread_pct), not
+    a min-vs-min agreement that hides bimodality."""
+
+    def test_bimodal_runs_report_honest_spread(self):
+        sys.path.insert(0, REPO)
+        import bench
+
+        # the exact r05 list that reported 4.3% "repeatability"
+        runs = [2.08, 8.63, 8.53, 8.66, 8.5, 1.99]
+        stats = bench._run_stats(runs, converged=True)
+        assert stats["runs"] == 6
+        assert stats["min_ms"] == 1.99
+        assert stats["median_ms"] == pytest.approx(8.52, abs=0.05)
+        assert stats["p90_ms"] == pytest.approx(8.66, abs=0.01)
+        # the honest spread is ~335%, not 4.3%
+        assert stats["spread_pct"] > 300
+
+    def test_single_run_spread_is_none_not_zero(self):
+        import bench
+
+        stats = bench._run_stats([5.0], converged=False)
+        assert stats["spread_pct"] is None
+        assert stats["median_ms"] == 5.0

@@ -1,0 +1,2 @@
+"""Standalone operator tooling that rides next to bench.py (not part of
+the cometbft_tpu package): the bench regression sentinel lives here."""

@@ -1,0 +1,331 @@
+"""Bench regression sentinel: diff two bench snapshots with per-metric
+direction-aware thresholds and emit a machine-readable verdict.
+
+The BENCH_r*.json trajectory was archaeology: numbers moved between
+rounds and nothing but a human reading the diff decided whether a move
+was a regression. This turns it into an enforced contract:
+
+    python -m tools.bench_compare BENCH_r05.json current.json
+    python bench.py --compare BENCH_r05.json          # run, then diff
+    python -m tools.bench_compare --self-test BENCH_r05.json
+
+Inputs may be either shape the repo actually contains:
+  - a raw bench record: {"metric", "value", "unit", "detail": {...}}
+    (one bench.py stdout line saved to a file), or
+  - a driver snapshot: {"n", "cmd", "rc", "tail", "parsed"} — `parsed`
+    preferred; when it is null (BENCH_r05) the record is recovered from
+    the `tail` text (the tail may be truncated at the FRONT, so recovery
+    tries progressively later JSON start points, then falls back to
+    scraping flat "key": number pairs).
+
+The metric table below is deliberately curated: only device/host-bound,
+repeatable numbers are ENFORCED (fail the verdict); wire-bound numbers
+(stream throughput, blocksync on a contended tunnel, anything paying the
+dev-box RTT) swing multiples between runs with no code change, so they
+are reported as informational drift and never fail a run. Direction is
+explicit per metric — throughput regressing DOWN fails, latency
+regressing UP fails, and an improvement in either direction always
+passes.
+
+Verdict schema (one JSON object):
+  {"verdict": "pass"|"fail", "regressions": [name...],
+   "metrics": {name: {"old", "new", "change_pct", "direction",
+                      "threshold_pct", "verdict"}}}
+per-metric verdict: "pass" | "fail" | "info" (untracked or wire-bound) |
+"new" (no baseline value) | "missing" (baseline metric absent now —
+informational; benches grow sections across rounds).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+HIGHER = "higher_better"
+LOWER = "lower_better"
+
+# metric name (flattened: detail keys verbatim, nested via ".") ->
+# (direction, fail threshold in %). Everything else is informational.
+TRACKED: dict[str, tuple[str, float]] = {
+    # headline + device-bound throughput (rep-differenced, repeatable)
+    "value": (HIGHER, 20.0),
+    "device_sigs_per_s": (HIGHER, 20.0),
+    "device_compute_ms_per_batch": (LOWER, 25.0),
+    "vote_flush_device_ms": (LOWER, 50.0),
+    "sr25519_device_compute_ms": (LOWER, 50.0),
+    # host staging plane (pure host work; contention-light)
+    "staging_us_per_row.ed25519": (LOWER, 50.0),
+    "staging_us_per_row.sr25519": (LOWER, 50.0),
+    "mixed_host_staging_ms": (LOWER, 50.0),
+    "mixed_host_challenge_us_per_row": (LOWER, 50.0),
+    # protocol properties (bytes on the wire — stable by construction)
+    "fetch_bytes_happy_path": (LOWER, 10.0),
+    "attribution.bytes_per_sig_tx": (LOWER, 25.0),
+    "attribution.bytes_per_sig_rx": (LOWER, 25.0),
+    # scheduler batching quality (ratio of the same load, not wall time)
+    "sched.fill_ratio_mean": (HIGHER, 25.0),
+    "sched.fill_gain": (HIGHER, 25.0),
+}
+
+# informational-by-design (wire/tunnel-bound): listed so the verdict can
+# say WHY they are not enforced instead of silently defaulting
+WIRE_BOUND = {
+    "stream_sigs_per_s", "blocksync_blocks_per_s", "blocksync_sigs_per_s",
+    "blocksync_device_busy_fraction", "p50_batch_latency_ms",
+    "mixed_megacommit_ms", "mixed_colocated_estimate_ms",
+    "lc_bisection_s", "lc_client_s", "consensus_tpu_height_p50_ms",
+}
+
+
+class SnapshotError(Exception):
+    pass
+
+
+# ------------------------------------------------------------- loading
+
+
+def load_snapshot(path: str) -> dict:
+    """Load a bench record from either supported file shape."""
+    with open(path) as f:
+        doc = json.load(f)
+    return coerce_record(doc)
+
+
+def coerce_record(doc: dict) -> dict:
+    """A raw bench record passes through; a driver snapshot resolves to
+    its parsed record or a tail-recovered one."""
+    if not isinstance(doc, dict):
+        raise SnapshotError(f"snapshot is {type(doc).__name__}, want object")
+    if "detail" in doc or "metric" in doc:
+        return doc
+    if "parsed" in doc or "tail" in doc:
+        if isinstance(doc.get("parsed"), dict):
+            return doc["parsed"]
+        rec = recover_from_tail(doc.get("tail") or "")
+        if rec is not None:
+            return rec
+        raise SnapshotError("driver snapshot has no parsed record and the "
+                            "tail could not be recovered")
+    raise SnapshotError("unrecognized snapshot shape "
+                        f"(keys {sorted(doc)[:6]})")
+
+
+def recover_from_tail(tail: str) -> dict | None:
+    """Recover a (possibly partial) record from a driver snapshot's
+    stdout tail. The tail keeps the END of the line, so the front may be
+    cut mid-token: try the full JSON first, then progressively later
+    start points re-opened with '{' (dropping surplus closing braces),
+    then fall back to scraping flat numeric pairs."""
+    tail = tail.strip()
+    start = tail.find('{"metric"')
+    if start >= 0:
+        try:
+            return json.loads(tail[start:])
+        except json.JSONDecodeError:
+            pass
+    # re-open at a later key boundary; surplus trailing '}' (we started
+    # inside nested objects) are trimmed one at a time
+    starts = [m.start() for m in re.finditer(r'"[A-Za-z0-9_]+":', tail)][:64]
+    for i in starts:
+        body = "{" + tail[i:]
+        for trim in range(4):
+            try:
+                got = json.loads(body[: len(body) - trim if trim else None])
+            except json.JSONDecodeError:
+                continue
+            if isinstance(got, dict) and got:
+                return {"detail": got}
+    flat = {}
+    for m in re.finditer(r'"([A-Za-z0-9_]+)": (-?\d+(?:\.\d+)?)\b', tail):
+        flat.setdefault(m.group(1), float(m.group(2)))
+    return {"detail": flat} if flat else None
+
+
+# ----------------------------------------------------------- flattening
+
+
+def flatten(record: dict) -> dict[str, float]:
+    """Numeric leaves of a bench record, keyed the way TRACKED names them:
+    top-level "value", then detail keys verbatim with nested dicts dotted
+    (lists and strings are skipped — runs arrays and notes are not
+    comparable scalars)."""
+    out: dict[str, float] = {}
+    v = record.get("value")
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        out["value"] = float(v)
+
+    def walk(prefix: str, node: dict) -> None:
+        for k, val in node.items():
+            key = prefix + str(k)
+            if isinstance(val, dict):
+                walk(key + ".", val)
+            elif isinstance(val, (int, float)) and not isinstance(val, bool):
+                out[key] = float(val)
+
+    detail = record.get("detail")
+    if isinstance(detail, dict):
+        walk("", detail)
+    return out
+
+
+# ------------------------------------------------------------ comparing
+
+
+def compare(old_record: dict, new_record: dict,
+            threshold_scale: float = 1.0) -> dict:
+    """The sentinel: per-metric direction-aware diff. `threshold_scale`
+    widens (>1) or tightens (<1) every tracked threshold uniformly —
+    a knob for noisy CI hosts."""
+    old = flatten(old_record)
+    new = flatten(new_record)
+    metrics: dict[str, dict] = {}
+    regressions: list[str] = []
+    for name in sorted(set(old) | set(new)):
+        spec = TRACKED.get(name)
+        o, n = old.get(name), new.get(name)
+        row: dict = {"old": o, "new": n}
+        if spec is not None:
+            row["direction"] = spec[0]
+            row["threshold_pct"] = round(spec[1] * threshold_scale, 3)
+        if o is None:
+            row["verdict"] = "new"
+        elif n is None:
+            row["verdict"] = "missing"
+        else:
+            change = (n - o) / o * 100 if o else (0.0 if n == o else None)
+            row["change_pct"] = (round(change, 2) if change is not None
+                                 else None)
+            if spec is not None and o <= 0:
+                # a non-positive baseline (a failed measurement recorded
+                # honestly, e.g. r04's negative sr25519 slope) cannot
+                # anchor a percentage — report, never judge
+                row["verdict"] = "info"
+                row["why_info"] = "non-positive baseline value"
+            elif spec is None or change is None:
+                row["verdict"] = "info"
+                if name in WIRE_BOUND:
+                    row["why_info"] = "wire-bound: swings with tunnel " \
+                                      "contention, not code"
+            else:
+                direction, threshold = spec
+                threshold *= threshold_scale
+                worse = -change if direction == HIGHER else change
+                if worse > threshold:
+                    row["verdict"] = "fail"
+                    regressions.append(name)
+                else:
+                    row["verdict"] = "pass"
+        metrics[name] = row
+    return {
+        "verdict": "fail" if regressions else "pass",
+        "regressions": regressions,
+        "tracked": sum(1 for r in metrics.values()
+                       if r.get("verdict") in ("pass", "fail")),
+        "metrics": metrics,
+    }
+
+
+def compare_files(old_path: str, new_path: str,
+                  threshold_scale: float = 1.0) -> dict:
+    return compare(load_snapshot(old_path), load_snapshot(new_path),
+                   threshold_scale=threshold_scale)
+
+
+# ------------------------------------------------------------- self-test
+
+
+def inject_regression(record: dict, pct: float = 30.0,
+                      metric: str | None = None) -> tuple[dict, str]:
+    """Copy `record` with one tracked metric worsened (direction-aware:
+    throughput shrinks, latency grows). Returns (copy, metric,
+    injected_pct). When none is named, picks the tracked metric with the
+    smallest threshold present; the injection is at least pct and always
+    big enough to trip the chosen metric's threshold (a partial snapshot
+    may only carry wide-threshold metrics)."""
+    flat = flatten(record)
+    if metric is None:
+        present = [(thr, m) for m, (_, thr) in TRACKED.items()
+                   if m in flat and flat[m]]
+        metric = min(present)[1] if present else None
+    if metric is None or metric not in flat:
+        raise SnapshotError("no tracked metric present to inject into")
+    direction, thr = TRACKED[metric]
+    if pct <= thr:  # the injection must be able to trip the threshold
+        pct = thr * 1.25
+    factor = (1 - pct / 100) if direction == HIGHER else (1 + pct / 100)
+    copy = json.loads(json.dumps(record))
+    # write the worsened value back through the dotted path
+    if metric == "value":
+        copy["value"] = flat[metric] * factor
+    else:
+        node = copy.setdefault("detail", {})
+        parts = metric.split(".")
+        for p in parts[:-1]:
+            node = node[p]
+        node[parts[-1]] = flat[metric] * factor
+    return copy, metric, pct
+
+
+def self_test(path: str, pct: float = 30.0) -> dict:
+    """The sentinel must catch a synthetic pct% regression injected into
+    a copy of `path`, and must NOT flag the identical snapshot or a pct%
+    improvement. Returns a machine-readable result; 'ok' is the gate."""
+    base = load_snapshot(path)
+    same = compare(base, base)
+    worse, metric, injected = inject_regression(base, pct=pct)
+    caught = compare(base, worse)
+    better = compare(worse, base)  # the same delta, as an improvement
+    ok = (same["verdict"] == "pass"
+          and caught["verdict"] == "fail" and metric in caught["regressions"]
+          and better["verdict"] == "pass")
+    return {
+        "ok": ok,
+        "injected_metric": metric,
+        "injected_pct": injected,
+        "identical_verdict": same["verdict"],
+        "regression_verdict": caught["verdict"],
+        "regression_flagged": caught["regressions"],
+        "improvement_verdict": better["verdict"],
+    }
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench_compare",
+        description="diff two bench snapshots with direction-aware "
+                    "per-metric thresholds; exit 1 on regression")
+    p.add_argument("baseline", help="prior snapshot (BENCH_rNN.json or a "
+                                    "saved bench.py line)")
+    p.add_argument("current", nargs="?", default="",
+                   help="current snapshot (omit with --self-test)")
+    p.add_argument("--threshold-scale", type=float, default=1.0,
+                   help="multiply every tracked threshold (noisy hosts)")
+    p.add_argument("--self-test", action="store_true",
+                   help="inject a fake regression into a copy of BASELINE "
+                        "and verify the sentinel flags it")
+    p.add_argument("--inject-pct", type=float, default=30.0,
+                   help="self-test regression size in percent")
+    args = p.parse_args(argv)
+    try:
+        if args.self_test:
+            res = self_test(args.baseline, pct=args.inject_pct)
+            print(json.dumps(res, indent=1))
+            return 0 if res["ok"] else 1
+        if not args.current:
+            p.error("current snapshot required (or pass --self-test)")
+        verdict = compare_files(args.baseline, args.current,
+                                threshold_scale=args.threshold_scale)
+        print(json.dumps(verdict, indent=1))
+        return 0 if verdict["verdict"] == "pass" else 1
+    except (SnapshotError, OSError, json.JSONDecodeError) as e:
+        print(json.dumps({"error": str(e)}))
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
