@@ -660,6 +660,175 @@ def bench_consensus_tpu(detail: dict) -> None:
             "(each flush pays the dev-box tunnel RTT)")
 
 
+def _host_mesh_env(n_devices: int) -> dict:
+    """Subprocess env for an n-device CPU host mesh (the shared
+    axon-stripping recipe lives in parallel/mesh.host_mesh_env)."""
+    from cometbft_tpu.parallel.mesh import host_mesh_env
+
+    env = host_mesh_env(os.environ, n_devices)
+    env["BENCH_MESH_DEVICES"] = str(n_devices)
+    return env
+
+
+def run_mesh_bench(n_devices: int = 8, timeout: float | None = None) -> dict:
+    """Run the VerifyMesh scaling scenario on an n-device host mesh in a
+    child process (the one robust way to guarantee a CPU-only mesh next
+    to the axon plugin) and return its record — the real-numbers
+    replacement for the old MULTICHIP dryrun."""
+    import subprocess
+
+    if timeout is None:
+        # a machine-cold compilation cache pays one executable
+        # instantiation per (chip, ladder shape); warm reruns finish in
+        # minutes
+        timeout = float(os.environ.get("BENCH_MESH_TIMEOUT", "3600"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--mesh-child"],
+        env=_host_mesh_env(n_devices), cwd=repo,
+        capture_output=True, text=True, timeout=timeout,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"mesh bench child failed (rc={proc.returncode}):\n"
+            f"stdout: {proc.stdout[-2000:]}\nstderr: {proc.stderr[-4000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def mesh_child_main() -> dict:
+    """The in-child mesh scenario (bench.py --mesh-child): a real
+    VerifyMesh scaling curve at 1/2/4/8 devices (weak scaling: constant
+    per-chip rows, so every chip compiles exactly one shard shape), a
+    corrupted-lane pinpoint across shards (the old dryrun's correctness
+    property, kept), and a 100k-validator mega-commit through the full
+    mesh. Prints ONE JSON record line."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    import numpy as np
+
+    from cometbft_tpu.crypto import ed25519_math as oracle
+    from cometbft_tpu.parallel.mesh import VerifyMesh
+
+    devices = jax.devices()
+    assert devices[0].platform == "cpu", f"mesh child must be cpu: {devices}"
+    want = int(os.environ.get("BENCH_MESH_DEVICES", "8"))
+    assert len(devices) >= want, f"need {want} devices, have {len(devices)}"
+    devices = devices[:want]
+
+    per_chip = int(os.environ.get("BENCH_MESH_PER_CHIP", "256"))
+    mega_rows = int(os.environ.get("BENCH_MESH_MEGA", "100000"))
+    reps = int(os.environ.get("BENCH_MESH_REPS", "3"))
+
+    n_keys = 64
+    rng = np.random.default_rng(1234)
+    base = []
+    for i in range(n_keys):
+        seed = rng.bytes(32)
+        msg = b"mesh-bench-" + i.to_bytes(4, "big")
+        base.append((oracle.public_key_from_seed(seed), msg,
+                     oracle.sign(seed, msg)))
+
+    def make(n):
+        rows = [base[i % n_keys] for i in range(n)]
+        return ([r[0] for r in rows], [r[1] for r in rows],
+                [r[2] for r in rows])
+
+    detail: dict = {
+        "backend": "cpu (forced host devices)",
+        "devices": len(devices),
+        "per_chip_rows": per_chip,
+        "note": ("weak-scaling curve: per-chip rows held constant so "
+                 "every chip runs one ladder-bucket shard shape; "
+                 "sigs/s on forced HOST devices — the shape of the "
+                 "curve, not TPU magnitude, is the tracked signal"),
+    }
+    curve: dict = {}
+    sizes = [k for k in (1, 2, 4, 8) if k <= len(devices)]
+    for k in sizes:
+        vm = VerifyMesh(devices[:k], placement="spread")
+        n = per_chip * k
+        pubs, msgs, sigs = make(n)
+        mask = vm.verify("ed25519", pubs, msgs, sigs, klass="sync")
+        assert mask.all(), f"warm-up mesh batch failed at {k} devices"
+        runs = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            mask = vm.verify("ed25519", pubs, msgs, sigs, klass="sync")
+            runs.append(time.perf_counter() - t0)
+            assert mask.all()
+        best = min(runs)
+        curve[str(k)] = {
+            "rows": n, "best_s": round(best, 4),
+            "runs_s": [round(r, 4) for r in runs],
+            "sigs_per_s": round(n / best, 1),
+        }
+        detail[f"device_sigs_per_s_{k}dev"] = round(n / best, 1)
+        h = vm.health()
+        assert h["fallbacks"] == 0 and h["evictions"] == 0, h
+    detail["curve"] = curve
+    if "1" in curve and str(sizes[-1]) in curve:
+        detail["scaling_x%d" % sizes[-1]] = round(
+            curve[str(sizes[-1])]["sigs_per_s"] / curve["1"]["sigs_per_s"], 3)
+
+    # correctness across shards (the dryrun's verification property): a
+    # corrupted lane in the middle of the batch is pinpointed, the rest
+    # stay valid
+    vm = VerifyMesh(devices, placement="spread")
+    n = per_chip * len(devices)
+    pubs, msgs, sigs = make(n)
+    bad = n // 2 + 1
+    sigs = list(sigs)
+    sigs[bad] = sigs[bad][:32] + sigs[(bad + 1) % n][32:]
+    mask = vm.verify("ed25519", pubs, msgs, sigs, klass="sync")
+    want_mask = [i != bad for i in range(n)]
+    assert mask.tolist() == want_mask, "sharded mask did not pinpoint"
+    detail["corrupt_lane_pinpointed"] = True
+
+    # the 100k-validator mega-commit: one batch, whole mesh
+    vm = VerifyMesh(devices, placement="spread")
+    pubs, msgs, sigs = make(mega_rows)
+    t0 = time.perf_counter()
+    mask = vm.verify("ed25519", pubs, msgs, sigs, klass="sync")
+    warm = time.perf_counter() - t0  # includes the mega-shard compile
+    assert mask.all()
+    t0 = time.perf_counter()
+    mask = vm.verify("ed25519", pubs, msgs, sigs, klass="sync")
+    wall = time.perf_counter() - t0
+    assert mask.all()
+    detail["mega_commit_rows"] = mega_rows
+    detail["mega_commit_s"] = round(wall, 3)
+    detail["mega_commit_first_s"] = round(warm, 3)
+    detail["mega_commit_sigs_per_s"] = round(mega_rows / wall, 1)
+
+    headline = detail.get(f"device_sigs_per_s_{sizes[-1]}dev", 0.0)
+    record = {
+        "metric": "mesh_verify_scaling",
+        "value": headline,
+        "unit": f"sigs/sec ({sizes[-1]}-chip forced-host mesh)",
+        "vs_baseline": (round(headline / curve["1"]["sigs_per_s"], 2)
+                        if curve.get("1") else None),
+        "detail": detail,
+    }
+    print(json.dumps(record))
+    return record
+
+
+def bench_mesh(detail: dict) -> None:
+    """Multi-chip mesh scenario (subprocess on forced host devices; the
+    record also stands alone as MULTICHIP_rNN via __graft_entry__).
+    BENCH_MESH=0 skips it — the child pays per-device XLA compiles on a
+    cold compilation cache."""
+    if os.environ.get("BENCH_MESH", "1") == "0":
+        detail["mesh"] = "skipped: BENCH_MESH=0"
+        return
+    record = run_mesh_bench(int(os.environ.get("BENCH_MESH_DEVICES", "8")))
+    detail["mesh"] = record["detail"]
+
+
 def bench_scheduler(detail: dict) -> None:
     """Global verify scheduler under a mixed offered load (ISSUE 4
     acceptance): a 4-validator in-process net committing with batched
@@ -1003,7 +1172,8 @@ def main() -> dict:
 
     # -- subsystem benches (each guarded: a failure reports, not aborts)
     for fn in (bench_blocksync, bench_mixed_megacommit, bench_attribution,
-               bench_light_client, bench_consensus_tpu, bench_scheduler):
+               bench_light_client, bench_consensus_tpu, bench_scheduler,
+               bench_mesh):
         try:
             _progress(fn.__name__)
             fn(detail)
@@ -1042,7 +1212,20 @@ def _cli() -> int:
     p.add_argument("--current", default="",
                    help="with --compare: diff this saved run instead of "
                         "running the bench")
+    p.add_argument("--mesh", action="store_true",
+                   help="run ONLY the multi-chip mesh scenario (subprocess "
+                        "on forced host devices) and print its record")
+    p.add_argument("--mesh-child", action="store_true",
+                   help="internal: the in-process mesh scenario (must run "
+                        "under JAX_PLATFORMS=cpu with forced host devices)")
     args = p.parse_args()
+    if args.mesh_child:
+        mesh_child_main()
+        return 0
+    if args.mesh:
+        record = run_mesh_bench(int(os.environ.get("BENCH_MESH_DEVICES", "8")))
+        print(json.dumps(record))
+        return 0
     if not args.compare:
         main()
         return 0

@@ -105,6 +105,20 @@ class CryptoConfig:
     # raise it toward sched_max_lanes on nodes serving huge valsets
     sched_warmup: bool = False
     sched_warmup_max_lanes: int = 2048
+    # --- multi-chip verify mesh (parallel/mesh.py) ---
+    # shard scheduler batches across all visible devices, each chip its
+    # own fault domain (dedicated supervisor/breaker): a dead chip
+    # shrinks the mesh instead of tripping the whole node onto the CPU
+    # ladder; a healed chip is readmitted by the half-open re-probe
+    mesh_enabled: bool = True
+    # below this many devices the mesh stays inactive and the classic
+    # single-chip dispatch path serves (2 = mesh only when there is a
+    # second fault domain to shrink onto)
+    mesh_min_devices: int = 2
+    # placement policy: "class_aware" pins consensus batches to the
+    # least-loaded chip (latency) and spreads sync/mempool (throughput);
+    # "spread"/"pinned" force one behavior for every class
+    mesh_placement: str = "class_aware"
     # --- device-fault supervision (ops/dispatch.py DeviceSupervisor) ---
     # transient failures: retries per dispatch, with backoff doubling from
     # retry_backoff_base up to retry_backoff_cap (plus jitter)
@@ -149,6 +163,12 @@ class CryptoConfig:
             raise ValueError("sched_starvation_limit cannot be negative")
         if self.sched_warmup_max_lanes < 8:
             raise ValueError("sched_warmup_max_lanes must be >= 8")
+        if self.mesh_min_devices < 1:
+            raise ValueError("mesh_min_devices must be >= 1")
+        if self.mesh_placement not in ("class_aware", "spread", "pinned"):
+            raise ValueError(
+                f"unknown mesh_placement {self.mesh_placement!r} "
+                "(expected \"class_aware\", \"spread\", or \"pinned\")")
         if self.chaos:
             from cometbft_tpu.libs import chaos as _chaos
 

@@ -103,6 +103,13 @@ def configure(crypto_cfg) -> None:
         queue_limit=crypto_cfg.sched_queue_limit,
         starvation_limit=crypto_cfg.sched_starvation_limit,
     )
+    from cometbft_tpu.parallel import mesh as verify_mesh
+
+    verify_mesh.configure(
+        enabled=crypto_cfg.mesh_enabled,
+        min_devices=crypto_cfg.mesh_min_devices,
+        placement=crypto_cfg.mesh_placement,
+    )
     if crypto_cfg.chaos:
         from cometbft_tpu.libs import chaos
 

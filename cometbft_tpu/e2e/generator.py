@@ -29,11 +29,13 @@ VOTE_EXT_HEIGHT_OFFSETS = (0, 2)  # 0 = disabled
 # and assert detection via evidence_committed / peer_bans metrics.
 PERTURBATIONS = {"kill": 0.1, "pause": 0.1, "restart": 0.1,
                  "device-kill": 0.05, "device-flap": 0.05,
+                 "chip-kill:1": 0.05, "chip-flap:1": 0.05,
                  "partition": 0.05, "byzantine": 0.05, "flood": 0.05}
 # perturbations that kill + respawn the OS process (a memdb node would
-# lose its stores while its out-of-process app keeps state)
+# lose its stores while its out-of-process app keeps state); compared by
+# BASE name (chip-kill:N respawns too)
 RESPAWN_PERTURBATIONS = {"kill", "restart", "device-kill", "device-flap",
-                         "byzantine", "flood"}
+                         "chip-kill", "chip-flap", "byzantine", "flood"}
 
 
 def generate_manifest(rng: random.Random, index: int) -> Manifest:
@@ -77,7 +79,9 @@ def generate_manifest(rng: random.Random, index: int) -> Manifest:
     # pause never loses the process, so memdb+pause stays in the matrix.
     if perturbed:
         nd = m.nodes[perturbed[0]]
-        if nd.database == "memdb" and set(nd.perturb) & RESPAWN_PERTURBATIONS:
+        if nd.database == "memdb" and {
+                p.partition(":")[0] for p in nd.perturb
+        } & RESPAWN_PERTURBATIONS:
             nd.database = "sqlite"
     m.validate()
     return m

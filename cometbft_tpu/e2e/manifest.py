@@ -28,6 +28,12 @@ class NodeManifest:
     # dead via a CBFT_CHAOS schedule — the node must keep committing on
     # the CPU ladder), device-flap (restart with a transient-fault
     # schedule — the supervisor must retry/re-probe back onto the device);
+    # mesh faults: chip-kill[:N] (restart on a forced host-device mesh —
+    # runner.MESH_DEVICE_COUNT chips, grown to cover N — with chip N's
+    # fault domain permanently dead: the node must finalize on the
+    # SHRUNKEN mesh, not the CPU fallback), chip-flap[:N] (chip N
+    # transiently failing — breaker hysteresis must absorb it without
+    # shrinking the mesh); N defaults to 1;
     # network/byzantine faults: partition (runtime 2-2 split through the
     # unsafe_net_chaos route — no progress while split, heal resumes),
     # byzantine (restart equivocating — honest nodes must commit
@@ -37,7 +43,16 @@ class NodeManifest:
 
     PERTURBATIONS = ("kill", "pause", "restart", "disconnect",
                      "device-kill", "device-flap",
+                     "chip-kill", "chip-flap",
                      "partition", "byzantine", "flood")
+    # perturbations that take a ":<device-index>" argument
+    INDEXED_PERTURBATIONS = ("chip-kill", "chip-flap")
+
+    @staticmethod
+    def split_perturb(p: str) -> tuple[str, str]:
+        """-> (base, arg); arg is "" when the perturbation is unindexed."""
+        base, _, arg = p.partition(":")
+        return base, arg
 
     def validate(self) -> None:
         if self.database not in ("sqlite", "memdb"):
@@ -47,8 +62,24 @@ class NodeManifest:
         if self.fuzz not in ("", "drop", "delay"):
             raise ValueError(f"unknown fuzz mode {self.fuzz!r}")
         for p in self.perturb:
-            if p not in self.PERTURBATIONS:
+            base, arg = self.split_perturb(p)
+            if base not in self.PERTURBATIONS:
                 raise ValueError(f"unknown perturbation {p!r}")
+            if arg:
+                if base not in self.INDEXED_PERTURBATIONS:
+                    raise ValueError(
+                        f"perturbation {base!r} takes no index ({p!r})")
+                from cometbft_tpu.libs.chaos import MESH_CHAOS_DEVICES
+
+                try:
+                    idx = int(arg)
+                except ValueError:
+                    raise ValueError(
+                        f"bad device index in {p!r}") from None
+                if not 0 <= idx < MESH_CHAOS_DEVICES:
+                    raise ValueError(
+                        f"device index out of range in {p!r} "
+                        f"(0..{MESH_CHAOS_DEVICES - 1})")
 
 
 @dataclass

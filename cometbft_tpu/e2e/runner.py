@@ -131,11 +131,42 @@ DEVICE_KILL_CHAOS = ("ed25519.dispatch=permanent,sr25519.dispatch=permanent,"
 DEVICE_FLAP_CHAOS = ("ed25519.dispatch=transient:4,ed25519.fetch=timeout:1,"
                      "sr25519.dispatch=transient:2")
 
+# mesh perturbations (chip-kill[:N] / chip-flap[:N]): the node restarts
+# with forced host devices so the verify mesh activates, and ONLY chip
+# N's fault domain is scheduled to fail — the run must finalize on the
+# SHRUNKEN mesh (kill) or the full mesh after breaker hysteresis absorbs
+# the flap, never on the CPU fallback. Asserted via the mesh metrics.
+# 4 devices, not 8: instantiating the verify executable costs tens of
+# seconds PER CHIP even on a warm compilation cache, and consensus
+# placement round-robins through every chip — the catch-up deadline must
+# cover all of them
+MESH_DEVICE_COUNT = 4
+DEFAULT_CHIP_INDEX = 1
 
-def _spawn_node(home: str):
+
+def _chip_kill_chaos(dev: int) -> str:
+    return (f"ed25519.dispatch.dev{dev}=permanent,"
+            f"sr25519.dispatch.dev{dev}=permanent")
+
+
+def _chip_flap_chaos(dev: int) -> str:
+    return (f"ed25519.dispatch.dev{dev}=transient:6,"
+            f"sr25519.dispatch.dev{dev}=transient:2")
+
+
+def _spawn_node(home: str, mesh_devices: int = 0):
+    env = _env()
+    if mesh_devices:
+        # the axon TPU plugin self-registers from PYTHONPATH and ignores
+        # JAX_PLATFORMS, which would leave this node with ONE real chip —
+        # the shared recipe (parallel/mesh.host_mesh_env) strips it so
+        # the forced host-device mesh actually materializes
+        from cometbft_tpu.parallel.mesh import host_mesh_env
+
+        env = host_mesh_env(env, mesh_devices)
     return subprocess.Popen(
         [sys.executable, "-m", "cometbft_tpu", "--home", home, "start"],
-        cwd=REPO, env=_env(), stdout=subprocess.DEVNULL,
+        cwd=REPO, env=env, stdout=subprocess.DEVNULL,
         stderr=subprocess.STDOUT, start_new_session=True)
 
 
@@ -150,6 +181,23 @@ def _arm_device_chaos(home: str, spec: str) -> None:
     cfg.crypto.chaos = spec
     # a dead device should sideline fast in a liveness test
     cfg.crypto.breaker_failure_threshold = 1
+    cfg.save()
+
+
+def _arm_chip_chaos(home: str, spec: str, kill: bool) -> None:
+    """Mesh perturbation config: device backend + mesh enabled + the
+    per-chip schedule. A killed chip should evict fast (threshold 1); a
+    flapping chip must be ABSORBED by hysteresis, so the flap keeps the
+    default threshold and in-place transient retries."""
+    from cometbft_tpu.config import Config
+
+    cfg = Config.load(home)
+    cfg.crypto.backend = "tpu"
+    cfg.crypto.chaos = spec
+    cfg.crypto.mesh_enabled = True
+    cfg.crypto.mesh_min_devices = 2
+    if kill:
+        cfg.crypto.breaker_failure_threshold = 1
     cfg.save()
 
 
@@ -294,6 +342,7 @@ def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
         # node's own height would deadlock).
         for i, name in enumerate(names):
             for p in manifest.nodes[name].perturb:
+                p, p_arg = manifest.nodes[name].split_perturb(p)
                 others = [j for j in range(n) if j != i]
                 h0 = max((_height(net, j) for j in others), default=0)
                 if p == "kill":
@@ -320,6 +369,26 @@ def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
                     _kill(net.node_procs[i])
                     _arm_device_chaos(net.homes[i], chaos)
                     net.node_procs[i] = _spawn_node(net.homes[i])
+                elif p in ("chip-kill", "chip-flap"):
+                    # restart the node on a forced host-device mesh with
+                    # ONE chip's fault domain scheduled to die (permanent)
+                    # or flap (transient): catching up below proves liveness;
+                    # the mesh metrics asserted after prove the run
+                    # finalized on a shrunken/healed MESH, not on the CPU
+                    # fallback ladder
+                    dev = int(p_arg) if p_arg else DEFAULT_CHIP_INDEX
+                    # the mesh must contain the targeted chip: a manifest
+                    # may index up to chaos.MESH_CHAOS_DEVICES-1
+                    n_mesh = max(MESH_DEVICE_COUNT, dev + 1)
+                    chaos = (_chip_kill_chaos(dev) if p == "chip-kill"
+                             else _chip_flap_chaos(dev))
+                    log(f"[{manifest.name}] {p} {name} "
+                        f"(device {dev} of {n_mesh})")
+                    _kill(net.node_procs[i])
+                    _arm_chip_chaos(net.homes[i], chaos,
+                                    kill=(p == "chip-kill"))
+                    net.node_procs[i] = _spawn_node(
+                        net.homes[i], mesh_devices=n_mesh)
                 elif p == "pause":
                     log(f"[{manifest.name}] pause {name}")
                     os.killpg(net.node_procs[i].pid, signal.SIGSTOP)
@@ -405,6 +474,49 @@ def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
                         raise RunError(
                             f"device-kill on {name}: breaker closed, so a "
                             f"device op succeeded (crypto_health: {h})")
+                if p in ("chip-kill", "chip-flap"):
+                    # the run must have finalized ON THE MESH: shards were
+                    # dispatched, and the all-chips-dead CPU fallback was
+                    # never engaged
+                    text = _metrics_text(net, i, timeout=5.0)
+                    size = _metric_value(
+                        text, "cometbft_crypto_verify_mesh_size")
+                    fallbacks = _metric_value(
+                        text, "cometbft_crypto_mesh_fallback_total")
+                    shard_lanes = _metric_value(
+                        text, "cometbft_crypto_mesh_shard_lanes")
+                    if shard_lanes < 1:
+                        raise RunError(
+                            f"{p} on {name}: no mesh shards dispatched "
+                            f"(mesh never engaged)")
+                    if fallbacks > 0:
+                        raise RunError(
+                            f"{p} on {name}: finalized via the CPU "
+                            f"fallback ({fallbacks} fallbacks), not the mesh")
+                    if p == "chip-kill":
+                        evictions = _metric_value(
+                            text, "cometbft_crypto_mesh_evictions_total")
+                        dead_state = _metric_value(
+                            text, "cometbft_crypto_mesh_breaker_state"
+                                  f'{{device="{dev}"}}')
+                        if evictions < 1:
+                            raise RunError(
+                                f"chip-kill on {name}: the mesh never "
+                                f"evicted the dead chip (size {size})")
+                        if dead_state < 1:  # 0 closed: a device op succeeded
+                            raise RunError(
+                                f"chip-kill on {name}: chip {dev}'s breaker "
+                                f"is closed — its fault domain never died")
+                        if size < 1:
+                            raise RunError(
+                                f"chip-kill on {name}: whole mesh died "
+                                f"(size {size})")
+                    else:  # chip-flap: hysteresis absorbs, mesh stays full
+                        if size < n_mesh:
+                            raise RunError(
+                                f"chip-flap on {name}: flap shrank the mesh "
+                                f"(size {size} of {n_mesh}) instead of "
+                                f"being absorbed")
 
         target = max(manifest.initial_height + manifest.target_height_delta,
                      max(_height(net, i) for i in range(n)))

@@ -16,6 +16,14 @@ fallback -> re-probe). Sites live on the device-dispatch seams:
                      degrades to per-group fragmented dispatch, never
                      failed verification
 
+plus the per-chip mesh shard sites (parallel/mesh.py — one fault domain
+per device, indices 0..MESH_CHAOS_DEVICES-1):
+
+  ed25519.dispatch.devN / sr25519.dispatch.devN
+                     one chip's shard dispatch inside the multi-chip
+                     verify mesh; killing dev3 evicts exactly that fault
+                     domain while the mesh re-shards over the survivors
+
 plus the transport seams (the network plane's deterministic faults; the
 probabilistic link faults — latency/drop/dup/reorder/partitions — live in
 p2p/netchaos.py):
@@ -47,6 +55,19 @@ from __future__ import annotations
 import os
 import threading
 
+# per-device mesh shard sites ("ed25519.dispatch.dev3"): the multi-chip
+# verify mesh (parallel/mesh.py) fires BOTH the plain scheme site and the
+# chip-indexed site inside every shard dispatch, so a schedule can kill or
+# flap exactly one mesh fault domain while the other chips keep serving —
+# the deterministic fixture behind the shrink/grow test matrix
+MESH_CHAOS_DEVICES = 8
+
+_MESH_SITES = tuple(
+    f"{scheme}.dispatch.dev{i}"
+    for scheme in ("ed25519", "sr25519")
+    for i in range(MESH_CHAOS_DEVICES)
+)
+
 SITES = (
     "ed25519.dispatch",
     "ed25519.fetch",
@@ -58,7 +79,7 @@ SITES = (
     "net.dial",
     "net.accept",
     "net.handshake",
-)
+) + _MESH_SITES
 
 KINDS = ("timeout", "transient", "permanent", "corrupt")
 

@@ -433,6 +433,46 @@ class CryptoMetrics:
             labels=("level", "event"))
 
 
+class MeshMetrics:
+    """Multi-chip verify-mesh observability (parallel/mesh.py — no
+    reference analog): live mesh size, per-chip breaker state, shard
+    redispatch/eviction/readmission churn, and the all-chips-dead
+    fallback count. Process-global like CryptoMetrics — the device mesh
+    is one per process."""
+
+    def __init__(self, reg: Registry):
+        self.verify_mesh_size = reg.gauge(
+            "crypto", "verify_mesh_size",
+            "Live verify-mesh size: chips whose breaker currently admits "
+            "shards (0 = all fault domains dead, ladder fallback engaged)")
+        self.mesh_devices = reg.gauge(
+            "crypto", "mesh_devices",
+            "Total chips the verify mesh was built over")
+        self.mesh_breaker_state = reg.gauge(
+            "crypto", "mesh_breaker_state",
+            "Per-chip fault-domain breaker: 0 closed, 1 half-open, 2 open",
+            labels=("device",))
+        self.mesh_redispatch_total = reg.counter(
+            "crypto", "mesh_redispatch_total",
+            "In-flight shards re-dispatched onto surviving chips after "
+            "their fault domain failed, by failure class",
+            labels=("reason",))
+        self.mesh_evictions_total = reg.counter(
+            "crypto", "mesh_evictions_total",
+            "Chips evicted from the live mesh (breaker opened)")
+        self.mesh_readmissions_total = reg.counter(
+            "crypto", "mesh_readmissions_total",
+            "Chips readmitted to the live mesh (half-open probe healed)")
+        self.mesh_fallback_total = reg.counter(
+            "crypto", "mesh_fallback_total",
+            "Batches that fell off an all-chips-dead mesh onto the "
+            "single-chip XLA->CPU ladder")
+        self.mesh_shard_lanes = reg.counter(
+            "crypto", "mesh_shard_lanes",
+            "Padded verify lanes dispatched per chip (the scheduler's "
+            "per-chip lane-fill evidence)", labels=("device",))
+
+
 class SchedMetrics:
     """Verify-scheduler observability (sched/scheduler.py — no reference
     analog): how full the continuously-batched device batches run, how
@@ -516,6 +556,20 @@ def sched_metrics() -> SchedMetrics:
             if _sched is None:
                 _sched = SchedMetrics(global_registry())
     return _sched
+
+
+_mesh: Optional[MeshMetrics] = None
+
+
+def mesh_metrics() -> MeshMetrics:
+    """Process-global MeshMetrics on the global registry (same
+    double-checked init discipline as crypto_metrics)."""
+    global _mesh
+    if _mesh is None:
+        with _crypto_lock:
+            if _mesh is None:
+                _mesh = MeshMetrics(global_registry())
+    return _mesh
 
 
 _netchaos: Optional[NetChaosMetrics] = None

@@ -139,6 +139,24 @@ class Node(BaseService):
         # (BASELINE: --crypto.backend flag; ops/dispatch.py supervisor)
         crypto_batch.configure(config.crypto)
 
+        # device backends: arm the persistent XLA compilation cache so a
+        # node (re)start loads compiled verify executables instead of
+        # re-tracing them — on a multi-chip mesh EVERY chip instantiates
+        # its own executable, and paying a cold compile per chip inside
+        # live consensus rounds would eat the liveness budget
+        if config.crypto.backend != "cpu":
+            try:
+                import jax
+
+                repo_root = os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))))
+                jax.config.update("jax_compilation_cache_dir",
+                                  os.path.join(repo_root, ".jax_cache"))
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 2)
+            except Exception:  # noqa: BLE001 - cache is an optimization
+                pass
+
         # network-fault schedule (p2p/netchaos.py; CBFT_NET_CHAOS overlays)
         if config.p2p.chaos:
             from cometbft_tpu.p2p import netchaos

@@ -421,6 +421,17 @@ def reset_supervision() -> None:
         _supervisors.clear()
 
 
+def _mesh_health() -> dict:
+    """The mesh section of crypto_health; never raises (health must
+    render even when jax/device discovery is mid-import or broken)."""
+    try:
+        from cometbft_tpu.parallel import mesh as _mesh
+
+        return _mesh.health_snapshot()
+    except Exception:  # noqa: BLE001
+        return {"enabled": False, "built": False}
+
+
 def health_snapshot() -> dict:
     """The RPC-visible crypto-health snapshot (rpc crypto_health route)."""
     from cometbft_tpu import sched
@@ -440,6 +451,10 @@ def health_snapshot() -> dict:
         # the verify plane's batching layer: producers feed the global
         # scheduler, the scheduler feeds these supervisors
         "verify_sched": sched.health_snapshot(),
+        # the multi-chip plane (parallel/mesh.py): live mesh size,
+        # per-chip fault-domain breaker states, eviction/readmission/
+        # redispatch churn, all-chips-dead fallback count
+        "mesh": _mesh_health(),
         # rolling per-batch wall-time attribution (libs/trace.py): stage-
         # share percentages + measured bytes-per-sig — the number the
         # mesh / reduced-send PRs are judged against

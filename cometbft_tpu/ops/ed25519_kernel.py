@@ -60,6 +60,21 @@ def _staging_rung() -> str:
 
 _ID_ENC32 = (1).to_bytes(32, "little")  # y=1: the identity point encoding
 
+_default_dev_id: int | None = None
+
+
+def default_device_index() -> int:
+    """Index of the chip the single-chip dispatch path targets — stamped
+    on dispatch trace spans so a flight-recorder tree names its fault
+    domain even off the mesh path (the mesh stamps its own shard index)."""
+    global _default_dev_id
+    if _default_dev_id is None:
+        try:
+            _default_dev_id = int(jax.devices()[0].id)
+        except Exception:  # noqa: BLE001 - tracing must never break dispatch
+            _default_dev_id = 0
+    return _default_dev_id
+
 
 _POW2_CAP = 2048  # above this, buckets are multiples of _POW2_CAP
 
@@ -407,6 +422,21 @@ def decompress_points(enc: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return np.asarray(ok)[:n], coords[:n]
 
 
+def pad_coords_batch_minor(coords: np.ndarray, bucket: int) -> tuple:
+    """(N, 4, 20) int32 coords -> identity-padded, batch-minor
+    (ax, ay, az, at) host arrays, each (20, bucket). THE one place the
+    identity-point pad encoding (Y=1, Z=1) and the device layout
+    transpose live — PubKeyCache.stage and the mesh's direct staging
+    path share it."""
+    pad = bucket - coords.shape[0]
+    if pad:
+        id_coords = np.zeros((pad, 4, L.NLIMBS), dtype=np.int32)
+        id_coords[:, 1, 0] = 1  # Y = 1
+        id_coords[:, 2, 0] = 1  # Z = 1
+        coords = np.concatenate([coords, id_coords])
+    return tuple(np.ascontiguousarray(coords[:, i].T) for i in range(4))
+
+
 class PubKeyCache:
     """Two-level decompressed-pubkey cache.
 
@@ -425,6 +455,11 @@ class PubKeyCache:
     def __init__(self, capacity: int = 65536, device_slots: int = 8):
         self.capacity = capacity
         self.device_slots = device_slots
+        # reentrant (stage -> lookup_or_decompress): the cache is shared
+        # by scheduler inline drains, blocksync staging threads, and mesh
+        # shard workers — a concurrent FIFO eviction racing a reader must
+        # not KeyError an honest batch onto the fallback ladder
+        self._tlock = threading.RLock()
         self._map: dict[bytes, tuple[bool, np.ndarray]] = {}
         self._dev: dict[bytes, tuple] = {}
         # hit/miss/eviction counters per level (host bytes->coords FIFO vs
@@ -451,6 +486,10 @@ class PubKeyCache:
 
     def lookup_or_decompress(self, pubs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
         """Host-level: (ok (N,) bool, coords (N, 4, 20) int32)."""
+        with self._tlock:
+            return self._lookup_locked(pubs)
+
+    def _lookup_locked(self, pubs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
         uniq = dict.fromkeys(pubs)
         missing = [p for p in uniq if p not in self._map]
         self._count("host", "misses", len(missing))
@@ -478,7 +517,14 @@ class PubKeyCache:
     ) -> tuple[np.ndarray, tuple]:
         """(ok_a (N,) host bool, (ax, ay, az, at) device arrays (20, bucket)).
         `put` overrides jax.device_put (the mesh path passes a sharded put;
-        put_key disambiguates cache entries across shardings/meshes)."""
+        put_key disambiguates cache entries across shardings/meshes).
+        Serialized on the cache lock: a device-level miss pays its
+        checksummed upload under it, which is the price of never caching a
+        half-written entry a concurrent stager could read."""
+        with self._tlock:
+            return self._stage_locked(pubs, bucket, put, put_key)
+
+    def _stage_locked(self, pubs, bucket, put, put_key):
         digest = hashlib.sha256(put_key.encode() + b"".join(pubs)).digest() + bytes(
             [bucket.bit_length()]
         )
@@ -488,14 +534,8 @@ class PubKeyCache:
             return hit[0], hit[1]
         self._count("device", "misses")
         ok_a, coords = self.lookup_or_decompress(pubs)
-        pad = bucket - len(pubs)
-        if pad:
-            id_coords = np.zeros((pad, 4, L.NLIMBS), dtype=np.int32)
-            id_coords[:, 1, 0] = 1  # Y = 1
-            id_coords[:, 2, 0] = 1  # Z = 1
-            coords = np.concatenate([coords, id_coords])
         put = put or jax.device_put
-        host_arrs = tuple(np.ascontiguousarray(coords[:, i].T) for i in range(4))
+        host_arrs = pad_coords_batch_minor(coords, bucket)
         expected = _host_checksum(*host_arrs)
         dev = None
         for attempt in (1, 2):
@@ -543,26 +583,36 @@ def _gather_coords(dev_u, idx):
 
 
 def _stage_gather(cache: "PubKeyCache", pubs: list[bytes], bucket: int,
-                  put_key: str = "") -> tuple[np.ndarray, tuple]:
+                  put_key: str = "", device=None) -> tuple[np.ndarray, tuple]:
     """(ok_a (N,), (ax, ay, az, at) device arrays (20, bucket)) via a
     device-side gather from the UNIQUE pubkey table. A batch that repeats a
     validator set W times (the coalesced blocksync window) uploads ONE copy
     of the coordinates (digest-cached across windows, since the unique set
     is stable even when window composition changes) plus a 4-byte/lane index
-    vector — not W copies keyed on the exact concatenation."""
+    vector — not W copies keyed on the exact concatenation.
+
+    `device` targets a specific chip (the mesh path stages each shard's
+    coordinate table on its own fault domain; put_key must then carry the
+    chip index so cache entries never alias across devices)."""
     uniq = list(dict.fromkeys(pubs))
     # an identity pad slot is needed only when padding lanes exist; when the
     # batch fills its bucket exactly (n == bucket == cap is legal) the +1
     # would overflow the lane cap
     need_pad = bucket > len(pubs)
     bu = bucket_size(len(uniq) + 1 if need_pad else len(uniq))
-    ok_u, dev_u = cache.stage(uniq, bu, put_key=put_key)
+    put = None
+    if device is not None:
+        import functools as _functools
+
+        put = _functools.partial(jax.device_put, device=device)
+    ok_u, dev_u = cache.stage(uniq, bu, put=put, put_key=put_key)
     pos = {p: i for i, p in enumerate(uniq)}
     idx = np.full(bucket, len(uniq), dtype=np.int32)  # padding -> identity
     idx[: len(pubs)] = [pos[p] for p in pubs]
     ok_a = np.asarray(ok_u)[idx[: len(pubs)]]
     t0 = _time.perf_counter()
-    idx_dev = jax.device_put(idx)
+    idx_dev = (jax.device_put(idx) if device is None
+               else jax.device_put(idx, device))
     # the 4 B/lane index vector is the steady-state small upload — the
     # tunnel model's h2d RTT probe (no pending compute to entangle with;
     # blocked before t1 so async dispatch can't record enqueue time)
@@ -992,7 +1042,8 @@ def verify_batch_async(
             _linkmodel.tunnel().observe_transfer(
                 nbytes, _time.perf_counter() - t0)
             sp.add_bytes(tx=nbytes)
-        with _trace.span("ed25519.dispatch", cat="compute", lanes=b):
+        with _trace.span("ed25519.dispatch", cat="compute", lanes=b,
+                         device=default_device_index()):
             mask, allok = _dispatch_verify(a_dev, rw, sw, kw)
             parts = _integrity_parts(mask, allok, rw, sw, kw, expected)
         _count_device_batch("ed25519", b)

@@ -171,27 +171,23 @@ class SrPubKeyCache(PubKeyCache):
 _default_cache = SrPubKeyCache()
 
 
-def stage_batch_sr(
+def stage_rows_sr(
     pubs: list[bytes],
     msgs: list[bytes],
     sigs: list[bytes],
-    cache: SrPubKeyCache | None = None,
+    bucket: int,
     out: np.ndarray | None = None,
-):
-    """Host staging only: marker/canonicity checks, Merlin challenges,
-    ristretto pubkey decode, packed device arrays. Returns
-    (pre_ok, ok_a, n, a_dev, r_words, s_words, k_words) with the word
-    arrays already device-resident — verify_batch dispatches them; the
-    bench harness rep-differences verify_math_sr over them.
-
-    All batch-axis: vectorized length/marker/s<L checks, the whole
-    commit's Merlin challenges through the batch STROBE transcript
-    (srm.batch_challenge_words — N sponges under one Keccak permutation
-    per duplex boundary), r/s/k packed in place into `out` (a leased
-    StagingPool block) when given."""
+) -> tuple[np.ndarray, list[bytes], np.ndarray, np.ndarray, np.ndarray]:
+    """Host-only sr25519 staging, the scheme's analog of
+    ed25519_kernel.stage_batch (the mesh path shards it per chip):
+    vectorized length/marker/s<L checks, the whole batch's Merlin
+    challenges through the batch STROBE transcript
+    (srm.batch_challenge_words_rows — N sponges under one Keccak
+    permutation per duplex boundary), r/s/k packed batch-minor
+    (8, bucket) into `out` (a leased StagingPool block) when given.
+    Returns (pre_ok, safe_pubs, r_words, s_words, k_words) — no device
+    arrays; pubkey staging is the dispatcher's (per-chip) concern."""
     n = len(sigs)
-    assert len(pubs) == n and len(msgs) == n
-    cache = cache or _default_cache
     from cometbft_tpu.ops import ed25519_kernel as EK
 
     ok_len = np.fromiter(map(len, sigs), np.int64, n) == 64
@@ -223,7 +219,38 @@ def stage_batch_sr(
     k_rows = srm.batch_challenge_words_rows(safe_pubs, r_rows, list(msgs))
     k_rows[~pre_ok] = 0
 
+    if out is None:
+        out = np.empty((3, 8, bucket), dtype=np.uint32)
+    r_words, s_words, k_words = out[0], out[1], out[2]
+    r_words[:, :n] = np.ascontiguousarray(r_rows).view("<u4").T
+    s_words[:, :n] = s_rows.view("<u4").T
+    k_words[:, :n] = k_rows.T
+    if bucket > n:
+        r_words[:, n:] = 0
+        s_words[:, n:] = 0
+        k_words[:, n:] = 0
+    return pre_ok, safe_pubs, r_words, s_words, k_words
+
+
+def stage_batch_sr(
+    pubs: list[bytes],
+    msgs: list[bytes],
+    sigs: list[bytes],
+    cache: SrPubKeyCache | None = None,
+    out: np.ndarray | None = None,
+):
+    """Full staging for the single-chip dispatch path: stage_rows_sr host
+    staging plus ristretto pubkey decode and device residency. Returns
+    (pre_ok, ok_a, n, a_dev, r_words, s_words, k_words) with the word
+    arrays still host-resident — verify_batch dispatches them; the
+    bench harness rep-differences verify_math_sr over them."""
+    n = len(sigs)
+    assert len(pubs) == n and len(msgs) == n
+    cache = cache or _default_cache
+
     b = bucket_size(n)
+    pre_ok, safe_pubs, r_words, s_words, k_words = stage_rows_sr(
+        pubs, msgs, sigs, b, out=out)
     # device-resident A-coordinate staging: digest cache over the UNIQUE
     # key set + device-side gather (a stable sr25519 valset uploads its
     # decoded coords once; repeated/tiled keys cost 4 bytes/lane)
@@ -231,16 +258,6 @@ def stage_batch_sr(
 
     with _trace.span("sr25519.stage_pubkeys", cat="transfer", lanes=b):
         ok_a, a_dev = _stage_gather(cache, safe_pubs, b, put_key="sr")
-    if out is None:
-        out = np.empty((3, 8, b), dtype=np.uint32)
-    r_words, s_words, k_words = out[0], out[1], out[2]
-    r_words[:, :n] = np.ascontiguousarray(r_rows).view("<u4").T
-    s_words[:, :n] = s_rows.view("<u4").T
-    k_words[:, :n] = k_rows.T
-    if b > n:
-        r_words[:, n:] = 0
-        s_words[:, n:] = 0
-        k_words[:, n:] = 0
     # r/s/k stay HOST arrays (batch-minor (8, B)): the dispatcher checksums
     # them before the transfer and re-transfers on an integrity retry
     return pre_ok, ok_a, n, a_dev, r_words, s_words, k_words
@@ -326,7 +343,8 @@ def verify_batch_async(
                 nbytes, _time.perf_counter() - t0)
             sp.add_bytes(tx=nbytes)
         with _trace.span("sr25519.dispatch", cat="compute",
-                         lanes=r_np.shape[1]):
+                         lanes=r_np.shape[1],
+                         device=EK.default_device_index()):
             with KERNEL_DISPATCH_LOCK:
                 from cometbft_tpu.ops import pallas_verify as PV
 

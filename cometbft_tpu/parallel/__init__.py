@@ -1,15 +1,24 @@
-"""Multi-chip scaling of signature mega-batches.
+"""Multi-chip scaling of signature verification.
 
-The reference's only scaling dimension is signatures-per-verification-call
-(SURVEY.md §5.7): validator-set size (cap 10k) x commits in flight
-(blocksync pipelines up to 600 heights). Here a mega-batch is sharded over a
-1-D `jax.sharding.Mesh` along the batch ("sig") axis with shard_map — each
-chip verifies its slice of lanes independently (verification is
-embarrassingly parallel; the only collective is the implicit result
-gather). ICI carries the shards; DCN is irrelevant at <=10k-sig batches.
+Two planes (parallel/mesh.py):
+
+- `sharded_verify_batch` — the SPMD shard_map data plane: one program
+  over a 1-D "sig" mesh, fastest for one healthy batch over N healthy
+  chips (the bench scaling probe), fragile to any single device fault.
+- `VerifyMesh` — the fault-tolerant production plane the VerifyScheduler
+  routes through: per-chip fault domains (one DeviceSupervisor/
+  CircuitBreaker per chip), class-aware placement, shrink/grow
+  re-sharding with in-flight shard redispatch, and an all-chips-dead
+  fallback onto the single-chip TPU->XLA->CPU ladder.
+
+The reference's only scaling dimension is signatures-per-verification-
+call (SURVEY.md §5.7); here the batch ("sig") axis is the scaling
+dimension — verification is embarrassingly parallel, so every chip
+verifies its slice of lanes independently.
 """
 
 from cometbft_tpu.parallel.mesh import (  # noqa: F401
+    VerifyMesh,
     batch_mesh,
     shard_verify_kernel,
     sharded_verify_batch,

@@ -93,10 +93,12 @@ class Environment:
         """The device-fault resilience snapshot (no reference analog):
         active verify backend, breaker states, retry/failure counters,
         the verify scheduler's `verify_sched` section (batch fill,
-        per-class queue depth, deadline misses — sched/scheduler.py) and
-        any armed chaos schedule (ops/dispatch.py health_snapshot). Served
-        in inspect mode too — a crashed node's disk plus the process-global
-        device state remain examinable."""
+        per-class queue depth, deadline misses — sched/scheduler.py),
+        the multi-chip `mesh` section (live size, per-chip fault-domain
+        breakers, eviction/readmission/redispatch churn —
+        parallel/mesh.py) and any armed chaos schedule (ops/dispatch.py
+        health_snapshot). Served in inspect mode too — a crashed node's
+        disk plus the process-global device state remain examinable."""
         from cometbft_tpu.ops import dispatch
 
         return dispatch.health_snapshot()

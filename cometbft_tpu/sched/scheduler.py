@@ -353,6 +353,36 @@ class VerifyScheduler:
         self._dispatch(groups)
         return sum(len(g.rows) for g in groups)
 
+    @staticmethod
+    def _mesh(build: bool = False):
+        """The active multi-chip verify mesh, or None (disabled, too few
+        devices, not yet built, or the parallel plane failed to import).
+        Only the dispatch path builds (build=True); telemetry and
+        rider-budget math peek, so a health poll never registers
+        per-chip supervisors. Never raises — the scheduler must dispatch
+        with the mesh module broken."""
+        try:
+            from cometbft_tpu.parallel import mesh as _mesh_mod
+
+            return (_mesh_mod.active() if build
+                    else _mesh_mod.peek_active())
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _effective_max_lanes(self) -> int:
+        """The lane budget one flush may coalesce: per-chip max_lanes
+        times the LIVE mesh size — the scheduler fills per-chip lanes
+        against the current topology, so an 8-chip mesh absorbs 8x the
+        filler and a shrunken mesh stops over-coalescing into its
+        survivors. Single-chip (mesh off) keeps the classic budget."""
+        mesh = self._mesh()
+        if mesh is None:
+            return self.max_lanes
+        from cometbft_tpu.ops import ed25519_kernel as EK
+
+        return min(self.max_lanes * max(1, mesh.live_size_hint()),
+                   1 << EK.MAX_BUCKET_LOG2)
+
     def _take_riders(self, n_own: int) -> list[_Group]:
         """Pop queued groups to fill the bucket the inline batch will
         dispatch at anyway. Starvation guard first: any group overdue
@@ -361,7 +391,8 @@ class VerifyScheduler:
             queued = sum(self._depth.values())
             if queued == 0:
                 return []
-            target = self.bucket_lanes(min(n_own + queued, self.max_lanes))
+            target = self.bucket_lanes(
+                min(n_own + queued, self._effective_max_lanes()))
             space = target - n_own
             out: list[_Group] = []
             now = self._clock()
@@ -416,17 +447,19 @@ class VerifyScheduler:
                 except Exception:  # noqa: BLE001 - group's futures failed;
                     pass           # later groups must still dispatch
             return
-        # chunk: groups are never split; a chunk holds up to max_lanes
-        # rows unless a single group alone exceeds it (a 10k mega-commit
+        # chunk: groups are never split; a chunk holds up to the
+        # effective lane budget (per-chip max_lanes x live mesh size)
+        # unless a single group alone exceeds it (a 10k mega-commit
         # dispatches alone — the kernel's lane cap is far above it).
         # A failing chunk fails ITS futures (in _dispatch_core) and must
         # not strand the remaining chunks' futures — a hung future would
         # wedge a mempool admission await forever.
+        lane_budget = self._effective_max_lanes()
         chunks: list[list[_Group]] = []
         chunk: list[_Group] = []
         chunk_rows = 0
         for g in groups:
-            if chunk and chunk_rows + len(g.rows) > self.max_lanes:
+            if chunk and chunk_rows + len(g.rows) > lane_budget:
                 chunks.append(chunk)
                 chunk, chunk_rows = [], 0
             chunk.append(g)
@@ -513,7 +546,15 @@ class VerifyScheduler:
         """The scheme-grouped verification core. Device thunks for every
         scheme resolve together (one device->host fetch); per-group row
         boundaries become the kernel's recheck groups so each producer
-        keeps its own host-oracle recheck budget."""
+        keeps its own host-oracle recheck budget.
+
+        Topology routing: on the tpu backend with an active multi-chip
+        mesh (parallel/mesh.py), each scheme's sub-batch is sharded over
+        the live mesh with class-aware placement — the batch's highest
+        priority class decides (consensus pins to the least-loaded chip
+        for latency; sync/mempool spread for throughput). A chip dying
+        mid-flush re-shards inside the mesh; only an all-chips-dead mesh
+        degrades to the single-chip ladder this method otherwise uses."""
         from cometbft_tpu.crypto import batch as crypto_batch
         from cometbft_tpu.ops import ed25519_kernel
 
@@ -525,6 +566,10 @@ class VerifyScheduler:
         with trace.span("sched.group_rows", cat="stage",
                         rows=sum(len(g.rows) for g in groups)):
             backend = crypto_batch.resolve_backend()
+            mesh = self._mesh(build=True) if backend == "tpu" else None
+            klasses = {g.klass for g in groups}
+            # the batch's placement class: its highest-priority member
+            batch_klass = next(k for k in CLASSES if k in klasses)
             for gi, g in enumerate(groups):
                 for ri, (pub, msg, sig) in enumerate(g.rows):
                     scheme = pub.type_()
@@ -553,10 +598,18 @@ class VerifyScheduler:
         # (host_verify here, the kernels' stage/transfer/fetch spans on
         # the device path) subtract from its self time, leaving only the
         # true glue attributed as compute
+        mesh_thunks: list[tuple[str, object]] = []
         with trace.span("sched.dispatch", cat="compute",
                         schemes=len(per)):
             for scheme, d in per.items():
-                if backend == "tpu" and scheme == "ed25519":
+                if mesh is not None and scheme in ("ed25519", "sr25519"):
+                    # mesh shards dispatch eagerly inside verify_async;
+                    # both schemes' shards are in flight before any join
+                    mesh_thunks.append((scheme, mesh.verify_async(
+                        scheme, [p.bytes_() for p in d["pubs"]],
+                        d["msgs"], d["sigs"], klass=batch_klass,
+                        recheck_groups=d["bounds"])))
+                elif backend == "tpu" and scheme == "ed25519":
                     thunks.append(ed25519_kernel.verify_batch_async(
                         [p.bytes_() for p in d["pubs"]], d["msgs"],
                         d["sigs"], recheck_groups=d["bounds"]))
@@ -580,6 +633,17 @@ class VerifyScheduler:
                 resolved = ed25519_kernel.resolve_batches(thunks)
                 for scheme, mask in zip(thunk_schemes, resolved):
                     host_masks[scheme] = np.asarray(mask, dtype=bool)
+            # every mesh thunk must be JOINED even if an earlier one
+            # raises — a skipped join would strand its shards' inflight
+            # accounting and skew placement for the process lifetime
+            mesh_err: Exception | None = None
+            for scheme, thunk in mesh_thunks:
+                try:
+                    host_masks[scheme] = np.asarray(thunk(), dtype=bool)
+                except Exception as exc:  # noqa: BLE001
+                    mesh_err = mesh_err or exc
+            if mesh_err is not None:
+                raise mesh_err
         with trace.span("sched.slice_masks", cat="resolve"):
             out = [np.zeros(len(g.rows), dtype=bool) for g in groups]
             for scheme, d in per.items():
@@ -742,9 +806,27 @@ class VerifyScheduler:
             "worker_flushes": self.worker_flushes,
             "worker_alive": bool(self._worker and self._worker.is_alive()),
             "max_lanes": self.max_lanes,
+            "effective_max_lanes": self._effective_max_lanes(),
+            "mesh": self._mesh_view(),
             "deadlines": dict(self.class_deadline),
             "link": self._link_view(),
         }
+
+    def _mesh_view(self) -> dict:
+        """The scheduler's live view of the multi-chip topology it fills
+        lanes against (never raises — telemetry)."""
+        mesh = self._mesh()
+        if mesh is None:
+            return {"active": False}
+        try:
+            return {
+                "active": True,
+                "devices": len(mesh.chips),
+                "live": mesh.live_size(),
+                "placement": mesh.placement,
+            }
+        except Exception:  # noqa: BLE001
+            return {"active": True}
 
     def _link_view(self) -> dict:
         """The scheduler's live view of the host<->device link

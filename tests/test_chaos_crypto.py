@@ -27,16 +27,24 @@ from cometbft_tpu.ops import ed25519_kernel as EK
 @pytest.fixture(autouse=True)
 def _clean_device_state():
     """Every case starts with no chaos armed, fresh breakers, tight retry
-    timings (no real backoff sleeps), and ends back on the cpu backend."""
+    timings (no real backoff sleeps), and ends back on the cpu backend.
+    The multi-chip mesh is disabled for this module: these cases pin the
+    SINGLE-chip supervisor/ladder semantics (the mesh plane has its own
+    matrix in test_mesh.py)."""
+    from cometbft_tpu.parallel import mesh as vmesh
+
     chaos.reset()
     D.reset_supervision()
     D.configure(failure_threshold=3, cooldown=30.0, retry_attempts=2,
                 retry_base=0.0, retry_cap=0.0, watchdog_timeout=120.0)
+    vmesh.configure(enabled=False)
     yield
     chaos.reset()
     D.reset_supervision()
     D.configure(failure_threshold=3, cooldown=30.0, retry_attempts=2,
                 retry_base=0.05, retry_cap=1.0, watchdog_timeout=120.0)
+    vmesh.configure(enabled=True)
+    vmesh.reset()
     crypto_batch.set_backend("cpu")
 
 

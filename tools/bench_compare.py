@@ -67,6 +67,17 @@ TRACKED: dict[str, tuple[str, float]] = {
     # scheduler batching quality (ratio of the same load, not wall time)
     "sched.fill_ratio_mean": (HIGHER, 25.0),
     "sched.fill_gain": (HIGHER, 25.0),
+    # multi-chip mesh scenario (forced-host devices: CPU-bound and box-
+    # contention-sensitive, so thresholds are wide; the SHAPE of the
+    # scaling curve is the contract, not the absolute rate). The same
+    # keys appear bare when diffing MULTICHIP_rNN records directly and
+    # under "mesh." when the section rides a full bench record.
+    "device_sigs_per_s_8dev": (HIGHER, 40.0),
+    "mesh.device_sigs_per_s_8dev": (HIGHER, 40.0),
+    "scaling_x8": (HIGHER, 30.0),
+    "mesh.scaling_x8": (HIGHER, 30.0),
+    "mega_commit_sigs_per_s": (HIGHER, 40.0),
+    "mesh.mega_commit_sigs_per_s": (HIGHER, 40.0),
 }
 
 # informational-by-design (wire/tunnel-bound): listed so the verdict can
