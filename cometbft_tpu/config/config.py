@@ -119,6 +119,17 @@ class CryptoConfig:
     # least-loaded chip (latency) and spreads sync/mempool (throughput);
     # "spread"/"pinned" force one behavior for every class
     mesh_placement: str = "class_aware"
+    # --- reduced-send wire protocol (ops/residency.py) ---
+    # keep the active validator set's decompressed coordinates resident
+    # on device keyed by set hash: steady-state flushes send 2-byte
+    # validator indices instead of key/coordinate material, and set
+    # churn ships only the evict/insert delta. Off = every batch rides
+    # the full-key digest-cache path (the pre-reduced-send protocol)
+    wire_indexed_sends: bool = True
+    # per-scheme device validator-table capacity in rows (320 B/row of
+    # device memory; one row is reserved for the padding identity).
+    # Must fit a uint16 index: [64, 65536]
+    wire_table_rows: int = 16384
     # --- device-fault supervision (ops/dispatch.py DeviceSupervisor) ---
     # transient failures: retries per dispatch, with backoff doubling from
     # retry_backoff_base up to retry_backoff_cap (plus jitter)
@@ -169,6 +180,10 @@ class CryptoConfig:
             raise ValueError(
                 f"unknown mesh_placement {self.mesh_placement!r} "
                 "(expected \"class_aware\", \"spread\", or \"pinned\")")
+        if not 64 <= self.wire_table_rows <= 65536:
+            raise ValueError(
+                "wire_table_rows must be in [64, 65536] (uint16 indices; "
+                "one row reserved for the padding identity)")
         if self.chaos:
             from cometbft_tpu.libs import chaos as _chaos
 

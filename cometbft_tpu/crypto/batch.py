@@ -12,6 +12,7 @@ from typing import Callable, Optional
 
 from cometbft_tpu import crypto
 from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.libs.prefixrows import PrefixedMsg
 
 _BACKEND = "auto"
 _tpu_available: Optional[bool] = None
@@ -109,6 +110,12 @@ def configure(crypto_cfg) -> None:
         enabled=crypto_cfg.mesh_enabled,
         min_devices=crypto_cfg.mesh_min_devices,
         placement=crypto_cfg.mesh_placement,
+    )
+    from cometbft_tpu.ops import residency
+
+    residency.configure(
+        enabled=crypto_cfg.wire_indexed_sends,
+        rows=crypto_cfg.wire_table_rows,
     )
     if crypto_cfg.chaos:
         from cometbft_tpu.libs import chaos
@@ -220,7 +227,12 @@ class ScheduledBatchVerifier(crypto.BatchVerifier):
                 f"key type {pub_key.type_()!r} has no batch verifier")
         if len(sig) != self.SIGNATURE_SIZE:
             raise crypto.ErrInvalidSignature("bad signature length")
-        self._rows.append((pub_key, bytes(msg), bytes(sig)))
+        # shared-prefix rows (libs/prefixrows.py) ride to the scheduler
+        # factored — kernel staging broadcasts each run's prefix once
+        self._rows.append((
+            pub_key,
+            msg if isinstance(msg, PrefixedMsg) else bytes(msg),
+            bytes(sig)))
 
     def verify(self) -> tuple[bool, list[bool]]:
         if not self._rows:

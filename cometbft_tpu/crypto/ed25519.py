@@ -61,6 +61,8 @@ class PubKey(crypto.PubKey):
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
         if len(sig) != SIGNATURE_SIZE:
             return False
+        if type(msg) is not bytes:
+            msg = bytes(msg)  # shared-prefix factored rows (prefixrows)
         if not _HAVE_OPENSSL:
             from cometbft_tpu.crypto import _libcrypto
 

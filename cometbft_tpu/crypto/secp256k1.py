@@ -67,6 +67,8 @@ class PubKey(crypto.PubKey):
         matching secp256k1.go:192-210 VerifyBytes."""
         if len(sig) != SIGNATURE_SIZE:
             return False
+        if type(msg) is not bytes:
+            msg = bytes(msg)  # shared-prefix factored rows (prefixrows)
         r = int.from_bytes(sig[:32], "big")
         s = int.from_bytes(sig[32:], "big")
         if not (0 < r < N and 0 < s <= _HALF_N):

@@ -46,6 +46,8 @@ class PubKey(crypto.PubKey):
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
         if len(sig) != SIGNATURE_SIZE:
             return False
+        if type(msg) is not bytes:
+            msg = bytes(msg)  # shared-prefix factored rows (prefixrows)
         return srm.verify(self._bytes, msg, sig)
 
     def __repr__(self) -> str:

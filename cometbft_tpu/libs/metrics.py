@@ -431,6 +431,19 @@ class CryptoMetrics:
             "Decompressed-pubkey cache hits/misses/evictions per level "
             "(host bytes->coords FIFO; device-resident digest slots)",
             labels=("level", "event"))
+        # send-side wire accounting (reduced-send protocol,
+        # ops/residency.py), the twin of verify_fetch_bytes{path}:
+        # indexed = 2-byte validator indices + staged r/s/k words
+        # (steady state); delta = validator-set churn row uploads;
+        # full = full-key fallback (coordinate tables + 4-byte indices)
+        self.verify_sends = reg.counter(
+            "crypto", "verify_sends",
+            "Host->device verify staging transfers by send path",
+            labels=("path",))
+        self.verify_send_bytes = reg.counter(
+            "crypto", "verify_send_bytes",
+            "Bytes transferred by host->device verify staging, by send "
+            "path", labels=("path",))
 
 
 class MeshMetrics:

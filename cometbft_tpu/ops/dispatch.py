@@ -470,11 +470,16 @@ def health_snapshot() -> dict:
         from cometbft_tpu.ops import ed25519_kernel as _ek
         from cometbft_tpu.ops import hashvec as _hv
         from cometbft_tpu.ops import limbs as _limbs
+        from cometbft_tpu.ops import residency as _residency
 
         snap["staging"] = {
             "hashvec_native": _hv.native_available(),
             "hashvec_rows": _hv.stats(),
             "fetch": _ek.fetch_stats(),
+            # send-side twin of `fetch` (reduced-send protocol): per-path
+            # wire accounting + steady-state bytes/sig + per-replica
+            # validator-table counters
+            "wire": _residency.stats(),
             "pubkey_cache": _ek.cache_stats(),
             "staging_pool": _limbs.POOL.stats(),
         }

@@ -231,8 +231,7 @@ OK_MAGIC = np.uint32(0x600DFA57)
 _BAD_MAGIC = np.uint32(~0x600DFA57 & 0xFFFFFFFF)
 
 
-@jax.jit
-def _integrity_parts(mask, allok, rw, sw, kw, expected):
+def _integrity_parts_expr(mask, allok, rw, sw, kw, expected):
     """-> ((2,) uint32 reduced-fetch header, (2B+1,) bool full payload
     [mask, ~mask (echo), staging-checksum ok])."""
     chk = _device_checksum_expr((rw, sw, kw))
@@ -240,6 +239,16 @@ def _integrity_parts(mask, allok, rw, sw, kw, expected):
     payload = jnp.concatenate([mask, ~mask, ok[None]])
     tok = chk ^ jnp.where(allok & ok, OK_MAGIC, _BAD_MAGIC)
     return jnp.stack([tok, ~tok]), payload
+
+
+# NOT donated: the header/payload outputs are tiny (2 words + 2B+1
+# bools), so no donated staged-word buffer could ever be reused for an
+# output — XLA would warn "donated buffers were not usable" on every
+# batch and copy anyway. Device-buffer recycling comes instead from the
+# staged block dying with the dispatch closure (one (3,8,B) array per
+# in-flight batch, freed at resolution) and the host-side StagingPool
+# reuse underneath it.
+_integrity_parts = jax.jit(_integrity_parts_expr)
 
 
 def decode_header(header: np.ndarray, expected) -> str:
@@ -295,11 +304,15 @@ def reset_fetch_stats() -> None:
 def host_oracle_mask(n, pre_ok, ok_a, rows, info) -> np.ndarray:
     """The CPU rung of the verify ladder: the scheme's exact host oracle
     over the batch rows. Counts the lanes as fallback verifies."""
+    from cometbft_tpu.libs.prefixrows import as_bytes
+
     verify_fn = info[0]
+    ok_a = _ok_arr(ok_a)  # may be a _LateOkA cell (pooled pubkey staging)
     pubs, msgs, sigs = rows
     with _trace.span("host_oracle", cat="compute", scheme=info[1], rows=n):
         host = np.fromiter(
-            (verify_fn(p, m, s) for p, m, s in zip(pubs, msgs, sigs)),
+            (verify_fn(p, as_bytes(m), s)
+             for p, m, s in zip(pubs, msgs, sigs)),
             dtype=bool, count=n)
     _count_fallback(info[1], n)
     return host & pre_ok & ok_a
@@ -310,6 +323,7 @@ def decode_payload(payload: np.ndarray, n, pre_ok, ok_a, rows, info,
     """Validate the integrity payload and produce the final (N,) mask.
     On checksum/echo failure: count, log, retry once with a fresh transfer
     (redo), then fall back to the exact host oracle for the whole batch."""
+    ok_a = _ok_arr(ok_a)  # may be a _LateOkA cell (pooled pubkey staging)
     b = (payload.shape[0] - 1) // 2
     mask = payload[:b].copy()
     echo = payload[b:2 * b]
@@ -451,6 +465,9 @@ class PubKeyCache:
     # subclasses (sr25519) swap in their scheme's device decompressor;
     # staticmethod so instances share one slot
     _decompress = staticmethod(lambda enc: decompress_points(enc))
+    # scheme tag consumed by the reduced-send residency layer
+    # (ops/residency.py) to key device validator tables per scheme
+    scheme = "ed25519"
 
     def __init__(self, capacity: int = 65536, device_slots: int = 8):
         self.capacity = capacity
@@ -551,6 +568,11 @@ class PubKeyCache:
             _linkmodel.tunnel().observe_transfer(
                 nbytes, _time.perf_counter() - t0)
             _trace.add_bytes(tx=nbytes)
+            # full-key-path wire accounting: the coordinate-table upload
+            # the reduced-send residency exists to amortize away
+            from cometbft_tpu.ops import residency as _residency
+
+            _residency.record_send("full", nbytes)
             # upload-time integrity check: a corrupted coordinate table
             # would poison EVERY batch against this valset until eviction,
             # so the one extra round trip per cache miss is paid here
@@ -583,17 +605,33 @@ def _gather_coords(dev_u, idx):
 
 
 def _stage_gather(cache: "PubKeyCache", pubs: list[bytes], bucket: int,
-                  put_key: str = "", device=None) -> tuple[np.ndarray, tuple]:
-    """(ok_a (N,), (ax, ay, az, at) device arrays (20, bucket)) via a
-    device-side gather from the UNIQUE pubkey table. A batch that repeats a
-    validator set W times (the coalesced blocksync window) uploads ONE copy
-    of the coordinates (digest-cached across windows, since the unique set
-    is stable even when window composition changes) plus a 4-byte/lane index
-    vector — not W copies keyed on the exact concatenation.
+                  put_key: str = "", device=None
+                  ) -> tuple[np.ndarray, tuple, str, int]:
+    """(ok_a (N,), (ax, ay, az, at) device arrays (20, bucket), send
+    path, pubkey-staging wire bytes).
+
+    Indexed path first (ops/residency.py): when the batch's keys fit the
+    device-resident validator table, the wire carries a 2-byte uint16
+    row index per lane (unseen keys delta-insert, counted separately) —
+    the reduced-send steady state. path="indexed".
+
+    Full-key path otherwise: a device-side gather from the UNIQUE pubkey
+    table. A batch that repeats a validator set W times (the coalesced
+    blocksync window) uploads ONE copy of the coordinates (digest-cached
+    across windows, since the unique set is stable even when window
+    composition changes) plus a 4-byte/lane index vector — not W copies
+    keyed on the exact concatenation. path="full".
 
     `device` targets a specific chip (the mesh path stages each shard's
     coordinate table on its own fault domain; put_key must then carry the
-    chip index so cache entries never alias across devices)."""
+    chip index so cache/table entries never alias across devices)."""
+    from cometbft_tpu.ops import residency as _residency
+
+    got = _residency.stage(cache, pubs, bucket, put_key=put_key,
+                           device=device)
+    if got is not None:
+        ok_a, a_dev, staging_tx = got
+        return ok_a, a_dev, "indexed", staging_tx
     uniq = list(dict.fromkeys(pubs))
     # an identity pad slot is needed only when padding lanes exist; when the
     # batch fills its bucket exactly (n == bucket == cap is legal) the +1
@@ -620,7 +658,7 @@ def _stage_gather(cache: "PubKeyCache", pubs: list[bytes], bucket: int,
     _linkmodel.tunnel().observe_transfer(
         idx.nbytes, _time.perf_counter() - t0)
     _trace.add_bytes(tx=idx.nbytes)
-    return ok_a, _gather_coords(dev_u, idx_dev)
+    return ok_a, _gather_coords(dev_u, idx_dev), "full", idx.nbytes
 
 
 _default_cache = PubKeyCache()
@@ -682,19 +720,21 @@ def _challenge_words(r_rows, pub_rows, msgs, mlens, pre_ok) -> np.ndarray:
     hash as ONE (N, 64+mlen) batch call; ragged messages group inside
     sha512_many. Rows with pre_ok False get k = 0 (their placeholder
     R/A content is hashed but discarded)."""
+    from cometbft_tpu.libs.prefixrows import as_bytes
     from cometbft_tpu.ops import hashvec
 
     n = r_rows.shape[0]
     if n and (mlens == mlens[0]).all():
-        msg_rows = np.frombuffer(
-            b"".join(msgs), dtype=np.uint8).reshape(n, int(mlens[0]))
+        # batch-axis reassembly: shared-prefix vote rows broadcast their
+        # per-commit prefix once instead of joining N full copies
+        msg_rows = hashvec.assemble_prefixed_rows(msgs, int(mlens[0]))
         data = np.concatenate([r_rows, pub_rows, msg_rows], axis=1)
         digests = hashvec.sha512_rows(data)
     else:
         r_blob, p_blob = r_rows.tobytes(), pub_rows.tobytes()
         digests = hashvec.sha512_many(
-            [r_blob[32 * i:32 * i + 32] + p_blob[32 * i:32 * i + 32] + m
-             for i, m in enumerate(msgs)])
+            [r_blob[32 * i:32 * i + 32] + p_blob[32 * i:32 * i + 32]
+             + as_bytes(m) for i, m in enumerate(msgs)])
     k_words = hashvec.reduce512_mod_l(digests)
     k_words[~pre_ok] = 0
     return k_words
@@ -790,12 +830,14 @@ def recheck_failed_lanes(mask, eligible, pubs, msgs, sigs,
     verify_fn is the scheme's exact host oracle."""
     import numpy as _np
 
+    from cometbft_tpu.libs.prefixrows import as_bytes
+
     bad = _np.flatnonzero(~mask & eligible)
     if len(bad) == 0 or len(bad) > _RECHECK_MAX:
         return mask
     flipped = []
     for i in bad:
-        if verify_fn(pubs[i], msgs[i], sigs[i]):
+        if verify_fn(pubs[i], as_bytes(msgs[i]), sigs[i]):
             mask[i] = True
             flipped.append(int(i))
     if flipped:
@@ -846,6 +888,31 @@ def make_host_thunk(n, pre_ok, rows, info):
 
     result.device_parts = lambda: (None, n, pre_ok, ones, rows, info, None)
     return result
+
+
+class _LateOkA:
+    """Pubkey-validity mask resolved ON THE TRANSFER POOL: the
+    reduced-send pipeline moved pubkey staging (residency/index upload)
+    off the caller thread into the dispatch closure, so batch N+1's
+    host staging overlaps batch N's pubkey RTT instead of serializing
+    behind it. The cell is set by the closure before dispatch returns;
+    a read before that only happens on ladder paths that already failed
+    device dispatch — there the host oracle is ground truth and needs
+    no device decompress mask, so the all-eligible default is exact."""
+
+    __slots__ = ("n", "value")
+
+    def __init__(self, n: int):
+        self.n = n
+        self.value = None
+
+    def resolve(self) -> np.ndarray:
+        v = self.value
+        return v if v is not None else np.ones(self.n, dtype=bool)
+
+
+def _ok_arr(ok_a) -> np.ndarray:
+    return ok_a.resolve() if isinstance(ok_a, _LateOkA) else ok_a
 
 
 def supervised_device_thunk(scheme: str, sup, submit_fn, fetch_site: str,
@@ -939,12 +1006,13 @@ def supervised_device_thunk(scheme: str, sup, submit_fn, fetch_site: str,
             header = _fetch_np(header_dev)
         except (_dispatch.DeviceOpFailed, _dispatch.DeviceUnavailable):
             _release()
-            return host_oracle_mask(n, pre_ok, ok_a, rows, info)
+            return host_oracle_mask(n, pre_ok, _ok_arr(ok_a), rows, info)
+        ok = _ok_arr(ok_a)  # staging completed: the cell is resolved
         verdict = decode_header(header, expected)
         if verdict == "happy":
             _count_fetch(True, header.nbytes)
             _release()
-            return pre_ok & ok_a  # no failed lanes -> nothing to recheck
+            return pre_ok & ok  # no failed lanes -> nothing to recheck
         if verdict == "echo_corrupt":
             _count_integrity("mask_echo_mismatch")
             from cometbft_tpu.libs import log as _log
@@ -956,12 +1024,12 @@ def supervised_device_thunk(scheme: str, sup, submit_fn, fetch_site: str,
             payload = _fetch_np(payload_dev, pure_transfer=True)
         except (_dispatch.DeviceOpFailed, _dispatch.DeviceUnavailable):
             _release()
-            return host_oracle_mask(n, pre_ok, ok_a, rows, info)
+            return host_oracle_mask(n, pre_ok, ok, rows, info)
         _count_fetch(False, header.nbytes + payload.nbytes)
         try:
             with _trace.span(f"{scheme}.decode", cat="resolve", rows=n):
                 return decode_payload(
-                    payload, n, pre_ok, ok_a, rows, info, redo=_redo)
+                    payload, n, pre_ok, ok, rows, info, redo=_redo)
         finally:
             _release()
 
@@ -1010,38 +1078,47 @@ def verify_batch_async(
     info = (oracle.verify_zip215, "ed25519", recheck_groups)
     sup = _dispatch.supervisor("device")
 
-    a_dev = None
-    if _dispatch.device_allowed():
-        try:
-            with _trace.span("ed25519.stage_pubkeys", cat="transfer",
-                             lanes=b):
-                ok_a, a_dev = _stage_gather(cache, safe_pubs, b)
-        except Exception as exc:  # noqa: BLE001 - device died in staging
-            sup.record_op_failure(exc)
-    if a_dev is None:
+    if not _dispatch.device_allowed():
         L.POOL.release(block)
         return make_host_thunk(n, pre_ok, rows, info)
     expected = np.uint32(_host_checksum(r_words, s_words, k_words))
+    ok_cell = _LateOkA(n)
 
     def _transfer_and_dispatch():
         from cometbft_tpu.libs import chaos
+        from cometbft_tpu.ops import residency as _residency
 
         chaos.fire("ed25519.dispatch")
+        # pubkey staging rides the transfer pool too (reduced-send
+        # pipeline): the caller thread never blocks on the index/table
+        # round trip, so host staging of batch N+1 overlaps batch N's
+        # transfers instead of serializing behind the tunnel RTT. A
+        # staging failure here feeds the supervisor/breaker exactly
+        # like a dispatch failure (the batch lands on the host oracle).
+        with _trace.span("ed25519.stage_pubkeys", cat="transfer",
+                         lanes=b):
+            ok_a, a_dev, path, staging_tx = _stage_gather(
+                cache, safe_pubs, b)
+        ok_cell.value = ok_a
         with _trace.span("ed25519.h2d", cat="transfer", lanes=b) as sp:
             t0 = _time.perf_counter()
-            rw = jnp.asarray(r_words)
-            sw = jnp.asarray(s_words)
-            kw = jnp.asarray(k_words)
-            # block before t1: device_put can dispatch asynchronously, and
-            # an enqueue-only timing would feed the link model microsecond
-            # "transfers" instead of wire time. The verify dispatch below
-            # needs these arrays resident anyway, and this thread is the
-            # transfer pool — blocking it is the design.
-            jax.block_until_ready((rw, sw, kw))
-            nbytes = r_words.nbytes + s_words.nbytes + k_words.nbytes
+            # ONE transfer for the whole (3, 8, B) staged block — the
+            # r/s/k planes were three separate puts (three tunnel round
+            # trips) before the reduced-send protocol; the planes are
+            # sliced apart on device where the copy is HBM-cheap.
+            # Blocking before t1 keeps the link-model sample honest
+            # (async dispatch would record enqueue time, not wire time);
+            # the verify dispatch below needs the words resident anyway,
+            # and this thread is the transfer pool — blocking it is the
+            # design.
+            dev_block = jnp.asarray(block)
+            jax.block_until_ready(dev_block)
+            nbytes = block.nbytes
             _linkmodel.tunnel().observe_transfer(
                 nbytes, _time.perf_counter() - t0)
             sp.add_bytes(tx=nbytes)
+        _residency.record_send(path, staging_tx + nbytes, sigs=n)
+        rw, sw, kw = dev_block[0], dev_block[1], dev_block[2]
         with _trace.span("ed25519.dispatch", cat="compute", lanes=b,
                          device=default_device_index()):
             mask, allok = _dispatch_verify(a_dev, rw, sw, kw)
@@ -1055,7 +1132,7 @@ def verify_batch_async(
     # and parallel puts multiplex the tunnel.
     return supervised_device_thunk(
         "ed25519", sup, _transfer_and_dispatch, "ed25519.fetch",
-        n, pre_ok, ok_a, rows, info, expected=expected, lease=block)
+        n, pre_ok, ok_cell, rows, info, expected=expected, lease=block)
 
 
 def resolve_batches(thunks) -> list[np.ndarray]:
@@ -1144,6 +1221,7 @@ def resolve_batches(thunks) -> list[np.ndarray]:
     off = 0
     for pr, p, v in zip(pairs, parts, verdicts):
         acquire, n, pre_ok, ok_a, rows, info, redo = p
+        ok_a = _ok_arr(ok_a)  # late cell: resolved once dispatch ran
         if pr is None and acquire is None and n == 0:
             out.append(np.zeros(0, dtype=bool))
         elif pr is None or pr is False or v is None:

@@ -96,13 +96,21 @@ def scalars_to_words(scalars) -> np.ndarray:
 class StagingPool:
     """Per-bucket pool of (3, 8, bucket) uint32 staging blocks — the r/s/k
     word arrays of one device batch, batch-minor, preallocated. The
-    stagers (ed25519_kernel.stage_batch / sr25519_kernel.stage_batch_sr)
+    stagers (ed25519_kernel.stage_batch / sr25519_kernel.stage_rows_sr)
     pack rows in place into a leased block instead of allocating, joining
     and transposing fresh arrays per batch; the verify thunk releases the
     block once its batch resolves. A block that is never released (error
     paths, bench callers that keep the arrays) is simply garbage-collected
     — the pool is a bounded free list, not a ledger. Leased blocks are
-    dirty: stagers overwrite every word, padding lanes included."""
+    dirty: stagers overwrite every word, padding lanes included.
+
+    Double-buffer contract (reduced-send protocol): a block is ONE
+    contiguous array, so the whole r/s/k payload crosses the tunnel as a
+    single transfer (`jnp.asarray(block)` in the dispatch closures), and
+    a block stays leased for its batch's full flight — so the steady
+    state holds two blocks per bucket (batch N in transfer/compute while
+    batch N+1 stages), which is why warm() preallocates pairs and
+    MAX_FREE_PER_BUCKET is sized above 2."""
 
     MAX_FREE_PER_BUCKET = 4
 
@@ -128,6 +136,15 @@ class StagingPool:
             free = self._free.setdefault(block.shape[2], [])
             if len(free) < self.MAX_FREE_PER_BUCKET:
                 free.append(block)
+
+    def warm(self, bucket: int, pairs: int = 2) -> None:
+        """Preallocate `pairs` blocks for a bucket so the first flushes
+        of the double-buffered steady state never allocate on the hot
+        path (scheduler warmup calls this along the bucket ladder)."""
+        with self._lock:
+            free = self._free.setdefault(bucket, [])
+            while len(free) < min(pairs, self.MAX_FREE_PER_BUCKET):
+                free.append(np.empty((3, 8, bucket), dtype=np.uint32))
 
     def stats(self) -> dict:
         with self._lock:

@@ -166,6 +166,7 @@ class SrPubKeyCache(PubKeyCache):
     per commit."""
 
     _decompress = staticmethod(lambda enc: decompress_points(enc))
+    scheme = "sr25519"  # reduced-send residency table key (ops/residency)
 
 
 _default_cache = SrPubKeyCache()
@@ -216,7 +217,14 @@ def stage_rows_sr(
         safe_pubs = [p if pre_ok[i] else _ID_ENC32
                      for i, p in enumerate(safe_pubs)]
     r_rows = sig_rows[:, :32]
-    k_rows = srm.batch_challenge_words_rows(safe_pubs, r_rows, list(msgs))
+    # Merlin transcripts absorb the exact message bytes: materialize any
+    # shared-prefix factored rows here (the batch STROBE sponge keeps its
+    # own per-mlen transcript-prefix snapshots, so the prefix work is
+    # still shared inside srm)
+    from cometbft_tpu.libs.prefixrows import as_bytes
+
+    k_rows = srm.batch_challenge_words_rows(
+        safe_pubs, r_rows, [as_bytes(m) for m in msgs])
     k_rows[~pre_ok] = 0
 
     if out is None:
@@ -257,7 +265,8 @@ def stage_batch_sr(
     from cometbft_tpu.ops.ed25519_kernel import _stage_gather
 
     with _trace.span("sr25519.stage_pubkeys", cat="transfer", lanes=b):
-        ok_a, a_dev = _stage_gather(cache, safe_pubs, b, put_key="sr")
+        ok_a, a_dev, _path, _tx = _stage_gather(
+            cache, safe_pubs, b, put_key="sr")
     # r/s/k stay HOST arrays (batch-minor (8, B)): the dispatcher checksums
     # them before the transfer and re-transfers on an integrity retry
     return pre_ok, ok_a, n, a_dev, r_words, s_words, k_words
@@ -290,20 +299,22 @@ def verify_batch_async(
     info = (srm.verify, "sr25519", None)
     sup = D.supervisor("device")
 
+    b = bucket_size(n)
     staged = None
     stage_counted = False
-    block = L.POOL.lease(bucket_size(n))
+    block = L.POOL.lease(b)
     if D.device_allowed():
         try:
             # sig_rows: THE attribution row-counting site for this batch
-            # (mirrors ed25519_kernel.verify_batch_async)
+            # (mirrors ed25519_kernel.verify_batch_async). Host-only
+            # staging: pubkey residency/upload moved into the dispatch
+            # closure (reduced-send overlap — the caller thread never
+            # blocks on a device round trip).
             with _trace.span("sr25519.stage", cat="stage", sig_rows=n,
-                             lanes=bucket_size(n),
-                             hash_rung=EK._staging_rung()):
+                             lanes=b, hash_rung=EK._staging_rung()):
                 stage_counted = True  # span finishes (and counts) even
-                staged = stage_batch_sr(pubs, msgs, sigs, cache=cache,
-                                        out=block)
-        except Exception as exc:  # noqa: BLE001 - device died in staging
+                staged = stage_rows_sr(pubs, msgs, sigs, b, out=block)
+        except Exception as exc:  # noqa: BLE001 - hashvec died in staging
             sup.record_op_failure(exc)
     if staged is None:
         L.POOL.release(block)
@@ -320,30 +331,40 @@ def verify_batch_async(
                 (len(p) == 32 and srm.parse_signature(s) is not None
                  for p, s in zip(pubs, sigs)), dtype=bool, count=n)
         return EK.make_host_thunk(n, pre_ok, rows, info)
-    pre_ok, ok_a, n, a_dev, r_np, s_np, k_np = staged
+    pre_ok, safe_pubs, r_np, s_np, k_np = staged
     expected = np.uint32(EK._host_checksum(r_np, s_np, k_np))
+    ok_cell = EK._LateOkA(n)
 
     def _dispatch():
         from cometbft_tpu.libs import chaos
+        from cometbft_tpu.ops import residency as _residency
 
         chaos.fire("sr25519.dispatch")
+        # ristretto pubkey staging on the transfer pool: indexed
+        # reduced-send when the resident table covers the keys, the
+        # digest-cached full-key path otherwise
+        with _trace.span("sr25519.stage_pubkeys", cat="transfer",
+                         lanes=b):
+            ok_a, a_dev, path, staging_tx = EK._stage_gather(
+                cache, safe_pubs, b, put_key="sr")
+        ok_cell.value = ok_a
         # any curve-kernel trace swaps field/curve module constants under
         # this lock (ops/dispatch.py); never trace concurrently
-        with _trace.span("sr25519.h2d", cat="transfer",
-                         lanes=r_np.shape[1]) as sp:
+        with _trace.span("sr25519.h2d", cat="transfer", lanes=b) as sp:
             t0 = _time.perf_counter()
-            r_w = jnp.asarray(r_np)
-            s_w = jnp.asarray(s_np)
-            k_w = jnp.asarray(k_np)
-            # block before t1: async dispatch would record enqueue time,
-            # not wire time (the kernel needs these resident anyway)
-            jax.block_until_ready((r_w, s_w, k_w))
-            nbytes = r_np.nbytes + s_np.nbytes + k_np.nbytes
+            # one transfer for the (3, 8, B) staged block (was three
+            # separate puts); planes sliced apart on device. Block
+            # before t1: async dispatch would record enqueue time, not
+            # wire time (the kernel needs the words resident anyway).
+            dev_block = jnp.asarray(block)
+            jax.block_until_ready(dev_block)
+            nbytes = block.nbytes
             _linkmodel.tunnel().observe_transfer(
                 nbytes, _time.perf_counter() - t0)
             sp.add_bytes(tx=nbytes)
-        with _trace.span("sr25519.dispatch", cat="compute",
-                         lanes=r_np.shape[1],
+        _residency.record_send(path, staging_tx + nbytes, sigs=n)
+        r_w, s_w, k_w = dev_block[0], dev_block[1], dev_block[2]
+        with _trace.span("sr25519.dispatch", cat="compute", lanes=b,
                          device=EK.default_device_index()):
             with KERNEL_DISPATCH_LOCK:
                 from cometbft_tpu.ops import pallas_verify as PV
@@ -352,12 +373,12 @@ def verify_batch_async(
                     PV.verify_pallas_sr_ok, _verify_kernel_ok,
                     (*a_dev, r_w, s_w, k_w), r_w.shape[1])
             parts = EK._integrity_parts(mask, allok, r_w, s_w, k_w, expected)
-        EK._count_device_batch("sr25519", r_w.shape[1])
+        EK._count_device_batch("sr25519", b)
         return parts
 
     return EK.supervised_device_thunk(
         "sr25519", sup, _dispatch, "sr25519.fetch",
-        n, pre_ok, ok_a, rows, info, expected=expected, lease=block)
+        n, pre_ok, ok_cell, rows, info, expected=expected, lease=block)
 
 
 def verify_batch(

@@ -354,6 +354,16 @@ class VerifyMesh:
                     self.readmissions += 1
                     _trace.event("mesh.readmit", cat="device",
                                  device=chip.index)
+                    # re-seed ONLY this fault domain's reduced-send
+                    # replicas: a healed chip must not serve validator
+                    # tables staged before its fault, and its mesh-mates'
+                    # resident sets stay untouched
+                    try:
+                        from cometbft_tpu.ops import residency
+
+                        residency.invalidate_device(chip.index)
+                    except Exception:  # noqa: BLE001 - never block healing
+                        pass
                     if mm is not None:
                         try:
                             mm.mesh_readmissions_total.inc()
@@ -445,12 +455,17 @@ class VerifyMesh:
                          lanes=b, device=chip.index):
             pre_ok, safe_pubs, rw, sw, kw = ops["stage"](pubs, msgs, sigs, b)
         host_arrs = None
+        send_path, staging_tx = "full", 0
         # the scheme cache serializes itself (PubKeyCache._tlock): shard
         # workers, scheduler drains, and blocksync stagers all share it
         with _trace.span(f"{scheme}.stage_pubkeys", cat="transfer",
                          lanes=b, device=chip.index):
             if self._device_cache:
-                ok_a, a_dev = K._stage_gather(
+                # per-chip reduced-send replica: put_key carries the
+                # fault-domain index, so each chip holds its own
+                # resident validator table (residency.invalidate_device
+                # drops exactly one replica on readmission)
+                ok_a, a_dev, send_path, staging_tx = K._stage_gather(
                     ops["cache"](), safe_pubs, b,
                     put_key=f"dev{chip.index}", device=chip.device)
             else:
@@ -471,6 +486,12 @@ class VerifyMesh:
             _linkmodel.tunnel().observe_transfer(
                 nbytes, _time.perf_counter() - t0)
             sp.add_bytes(tx=nbytes)
+        try:
+            from cometbft_tpu.ops import residency as _residency
+
+            _residency.record_send(send_path, staging_tx + nbytes, sigs=n)
+        except Exception:  # noqa: BLE001 - accounting must not break shards
+            pass
         with _trace.span(f"{scheme}.dispatch", cat="compute", lanes=b,
                          device=chip.index):
             with KERNEL_DISPATCH_LOCK:

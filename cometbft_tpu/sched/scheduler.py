@@ -556,6 +556,7 @@ class VerifyScheduler:
         mid-flush re-shards inside the mesh; only an all-chips-dead mesh
         degrades to the single-chip ladder this method otherwise uses."""
         from cometbft_tpu.crypto import batch as crypto_batch
+        from cometbft_tpu.libs.prefixrows import PrefixedMsg
         from cometbft_tpu.ops import ed25519_kernel
 
         # scheme -> (pubs, msgs, sigs, bounds, [(group_idx, row_idx)])
@@ -583,7 +584,11 @@ class VerifyScheduler:
                         d["open"] = gi
                         d["_b0"] = len(d["sigs"])
                     d["pubs"].append(pub)
-                    d["msgs"].append(bytes(msg))
+                    # shared-prefix rows stay FACTORED through the
+                    # scheduler (the kernel staging fast path broadcasts
+                    # each run's prefix once — libs/prefixrows.py)
+                    d["msgs"].append(msg if isinstance(msg, PrefixedMsg)
+                                     else bytes(msg))
                     d["sigs"].append(bytes(sig))
                     d["where"].append((gi, ri))
             for d in per.values():
@@ -659,6 +664,7 @@ class VerifyScheduler:
         key type — secp256k1 — must still verify, not crash the batch).
         A structurally-bad row fails alone instead of raising."""
         from cometbft_tpu.crypto import batch as crypto_batch
+        from cometbft_tpu.libs.prefixrows import as_bytes
 
         n = len(d["sigs"])
         backends = crypto_batch._REGISTRY.get(scheme)
@@ -668,7 +674,8 @@ class VerifyScheduler:
             mask = np.zeros(n, dtype=bool)
             for i in range(n):
                 try:
-                    bv.add(d["pubs"][i], d["msgs"][i], d["sigs"][i])
+                    bv.add(d["pubs"][i], as_bytes(d["msgs"][i]),
+                           d["sigs"][i])
                     staged.append(i)
                 except Exception:  # noqa: BLE001 - structural reject
                     pass
@@ -681,7 +688,7 @@ class VerifyScheduler:
         for i in range(n):
             try:
                 mask[i] = bool(d["pubs"][i].verify_signature(
-                    d["msgs"][i], d["sigs"][i]))
+                    as_bytes(d["msgs"][i]), d["sigs"][i]))
             except Exception:  # noqa: BLE001
                 mask[i] = False
         return mask
@@ -747,9 +754,13 @@ class VerifyScheduler:
         if crypto_batch.resolve_backend() != "tpu":
             return []
         from cometbft_tpu.ops import ed25519_kernel as EK
+        from cometbft_tpu.ops import limbs as _limbs
 
         traced: list[int] = []
         for b in self.bucket_ladder(max_lanes or 2048):
+            # double-buffer pair per rung: the first real flushes must
+            # not allocate staging blocks on the hot path
+            _limbs.POOL.warm(b)
             # identity-point rows: pub = the identity encoding, s = 0 —
             # structurally valid, decompress trivially, verify cheap
             pubs = [EK._ID_ENC32] * b
@@ -828,20 +839,51 @@ class VerifyScheduler:
         except Exception:  # noqa: BLE001
             return {"active": True}
 
+    @staticmethod
+    def planning_bytes_per_sig() -> float:
+        """The live wire cost of one signature used for flush planning:
+        the reduced-send accounting's measured rate (ops/residency.py —
+        the number PR 6's trace attribution also records), falling back
+        to the rolling attribution model, then to the pre-reduced-send
+        96 B/sig constant only when the process has not sent a single
+        batch yet."""
+        try:
+            from cometbft_tpu.ops import residency
+
+            measured = residency.measured_bytes_per_sig()
+            if measured:
+                return float(measured)
+        except Exception:  # noqa: BLE001 - planning must never raise
+            pass
+        try:
+            from cometbft_tpu.libs import trace as _trace
+
+            attr = _trace.attribution()
+            bps = attr.get("bytes_per_sig_tx")
+            if bps:
+                return float(bps)
+        except Exception:  # noqa: BLE001
+            pass
+        return 96.0
+
     def _link_view(self) -> dict:
         """The scheduler's live view of the host<->device link
         (libs/linkmodel.py, fed by the kernels' measured transfers):
         estimated bandwidth/RTT plus the predicted wall cost of a
-        full-lane flush at ~96 B/sig — the planning primitive the
-        reduced-send work will shrink. Never raises (telemetry)."""
+        full-lane flush at the MEASURED bytes-per-sig (reduced-send
+        accounting; the hardcoded 96 B/sig planning constant is gone —
+        it is only the cold-start fallback before any batch has been
+        sent). Never raises (telemetry)."""
         try:
             from cometbft_tpu.libs import linkmodel
 
             tun = linkmodel.tunnel()
             out = tun.snapshot()
+            bps = self.planning_bytes_per_sig()
+            out["planning_bytes_per_sig"] = round(bps, 2)
             # current wire cost of one maximally-coalesced flush
-            est = tun.transfer_seconds(96 * self.max_lanes)
-            out["full_flush_wire_ms_at_96B_per_sig"] = (
+            est = tun.transfer_seconds(int(bps * self.max_lanes))
+            out["full_flush_wire_ms_at_measured_bytes_per_sig"] = (
                 round(est * 1e3, 2) if est is not None else None)
             return out
         except Exception:  # noqa: BLE001

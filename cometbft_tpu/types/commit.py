@@ -128,16 +128,25 @@ class Commit:
         """types/block.go:880-883 — the batch-verification row builder."""
         return self.get_vote(val_idx).sign_bytes(chain_id)
 
-    def vote_sign_bytes_all(self, chain_id: str) -> list[bytes]:
-        """All signatures' canonical sign-bytes in one pass. Byte-identical
-        to vote_sign_bytes(chain_id, i) per index (asserted by tests) but
-        built from a shared per-commit prefix: the CanonicalVote rows of one
-        commit differ only in the timestamp field and the NIL-vote block_id
-        omission, so the type/height/round/block_id prefix and the chain_id
-        suffix are encoded once, not once per validator. This is the
-        row-builder behind every batched commit verification — per-row
-        Writer construction was the dominant host cost of blocksync staging.
-        """
+    def vote_sign_bytes_all(self, chain_id: str):
+        """All signatures' canonical sign-bytes in one pass, as a
+        SharedPrefixRows container (libs/prefixrows.py) — indexing is
+        byte-identical to vote_sign_bytes(chain_id, i) per index
+        (asserted by tests). The CanonicalVote rows of one commit differ
+        only in the timestamp field and the NIL-vote block_id omission,
+        so the length varint + type/height/round/block_id head is built
+        ONCE and kept FACTORED: COMMIT rows whose timestamp encodes to
+        the commit's modal length store only their ~17-byte suffix
+        (timestamp + chain tail); NIL votes and odd-length timestamps
+        materialize as exception rows. The factored form flows through
+        validation into kernel staging, where the whole run reassembles
+        on the batch axis with one prefix broadcast instead of N row
+        copies (the reduced-send protocol's host half) — per-row Writer
+        construction was the dominant host cost of blocksync staging,
+        and the prefix copies were most of what remained."""
+        from collections import Counter
+
+        from cometbft_tpu.libs.prefixrows import SharedPrefixRows
         from cometbft_tpu.types import canonical
         from cometbft_tpu.utils.protobuf import encode_uvarint
 
@@ -155,12 +164,34 @@ class Commit:
         head_commit = w.output()
         tail = pb.Writer().string(6, chain_id).output()
         ts_tag = bytes([5 << 3 | 2])  # field 5, wire 2 (timestamp message)
-        rows: list[bytes] = []
-        for cs in self.signatures:
-            ts = pb.timestamp_bytes(cs.timestamp.seconds, cs.timestamp.nanos)
-            head = head_commit if cs.block_id_flag == BlockIDFlag.COMMIT else head_nil
+        ts_all = [pb.timestamp_bytes(cs.timestamp.seconds,
+                                     cs.timestamp.nanos)
+                  for cs in self.signatures]
+        # the shared prefix covers COMMIT rows at the commit's modal
+        # timestamp-encoding length (the length varint in front of the
+        # body pins the total row length, so an off-length timestamp
+        # cannot share it)
+        commit_lens = Counter(
+            len(ts) for ts, cs in zip(ts_all, self.signatures)
+            if cs.block_id_flag == BlockIDFlag.COMMIT)
+        modal_ts_len = commit_lens.most_common(1)[0][0] if commit_lens else 0
+        modal_body = (len(head_commit) + len(ts_tag)
+                      + len(encode_uvarint(modal_ts_len)) + modal_ts_len
+                      + len(tail))
+        prefix = encode_uvarint(modal_body) + head_commit
+        suffixes: list = []
+        exceptions: dict[int, bytes] = {}
+        for i, (ts, cs) in enumerate(zip(ts_all, self.signatures)):
+            if (cs.block_id_flag == BlockIDFlag.COMMIT
+                    and len(ts) == modal_ts_len):
+                suffixes.append(ts_tag + encode_uvarint(len(ts)) + ts + tail)
+                continue
+            head = (head_commit if cs.block_id_flag == BlockIDFlag.COMMIT
+                    else head_nil)
             body = head + ts_tag + encode_uvarint(len(ts)) + ts + tail
-            rows.append(encode_uvarint(len(body)) + body)
+            suffixes.append(None)
+            exceptions[i] = encode_uvarint(len(body)) + body
+        rows = SharedPrefixRows(prefix, suffixes, exceptions)
         if len(self._sign_rows) >= self._MAX_SIGN_ROW_CHAINS:
             self._sign_rows.pop(next(iter(self._sign_rows)))
         self._sign_rows[chain_id] = rows

@@ -87,11 +87,19 @@ def _commit_rows(
     raises ErrNotEnoughVotingPowerSigned below threshold."""
     seen_vals: dict[int, int] = {}
     pubs: list = []
-    msgs: list[bytes] = []
     sigs: list[bytes] = []
     idxs: list[int] = []
     tallied = 0
     sign_rows = commit.vote_sign_bytes_all(chain_id)
+    # epoch-keyed device residency (reduced-send protocol): announce the
+    # active validator set so the kernels' resident key tables pin its
+    # rows and churn ships only deltas (ops/residency.py; never raises)
+    try:
+        from cometbft_tpu.ops import residency as _residency
+
+        _residency.announce_validator_set(vals)
+    except Exception:  # noqa: BLE001 - residency is an optimization layer
+        pass
     for idx, cs in enumerate(commit.signatures):
         if ignore_sig(cs):
             continue
@@ -107,7 +115,6 @@ def _commit_rows(
                 )
             seen_vals[val_idx] = idx
         pubs.append(val.pub_key)
-        msgs.append(sign_rows[idx])
         sigs.append(cs.signature)
         idxs.append(idx)
         if count_sig(cs):
@@ -116,6 +123,13 @@ def _commit_rows(
             break
     if tallied <= voting_power_needed:
         raise ErrNotEnoughVotingPowerSigned(got=tallied, needed=voting_power_needed)
+    # factored (shared-prefix) rows when the builder supports them: the
+    # staging fast path reassembles whole runs with one prefix broadcast
+    # instead of N per-row copies (libs/prefixrows.py)
+    if hasattr(sign_rows, "rows_for"):
+        msgs = sign_rows.rows_for(idxs)
+    else:
+        msgs = [sign_rows[i] for i in idxs]
     return pubs, msgs, sigs, idxs
 
 
@@ -329,7 +343,11 @@ class StagedCommitVerification:
                         bv.add(p, m, s)
                     _, mask = bv.verify()
                 except Exception:  # noqa: BLE001 - unbatchable key type
-                    mask = [p.verify_signature(m, s)
+                    from cometbft_tpu.libs.prefixrows import as_bytes
+
+                    # materialize factored rows: schemes outside the
+                    # batch registry (secp256k1) take raw bytes only
+                    mask = [p.verify_signature(as_bytes(m), s)
                             for p, m, s in zip(pubs, msgs, sigs)]
         _raise_first_bad(self.commit, self.sig_idxs, mask)
         self._passed = True

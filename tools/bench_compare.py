@@ -64,6 +64,12 @@ TRACKED: dict[str, tuple[str, float]] = {
     "fetch_bytes_happy_path": (LOWER, 10.0),
     "attribution.bytes_per_sig_tx": (LOWER, 25.0),
     "attribution.bytes_per_sig_rx": (LOWER, 25.0),
+    # reduced-send protocol: measured steady-state send cost per
+    # signature (ops/residency.py accounting) — enforced lower-is-better
+    # because bytes on the wire are a property of the protocol, not of
+    # tunnel contention
+    "wire_bytes_per_sig": (LOWER, 25.0),
+    "wire.steady_state_bytes_per_sig": (LOWER, 25.0),
     # scheduler batching quality (ratio of the same load, not wall time)
     "sched.fill_ratio_mean": (HIGHER, 25.0),
     "sched.fill_gain": (HIGHER, 25.0),
@@ -81,7 +87,11 @@ TRACKED: dict[str, tuple[str, float]] = {
 }
 
 # informational-by-design (wire/tunnel-bound): listed so the verdict can
-# say WHY they are not enforced instead of silently defaulting
+# say WHY they are not enforced instead of silently defaulting.
+# stream_sigs_per_s stays here DELIBERATELY after the reduced-send PR:
+# it remains tunnel-contention-bound until a quiet-tunnel round confirms
+# the stream is reproducibly no longer send-bound — promote it to
+# TRACKED (higher_better) only then.
 WIRE_BOUND = {
     "stream_sigs_per_s", "blocksync_blocks_per_s", "blocksync_sigs_per_s",
     "blocksync_device_busy_fraction", "p50_batch_latency_ms",
@@ -98,10 +108,51 @@ class SnapshotError(Exception):
 
 
 def load_snapshot(path: str) -> dict:
-    """Load a bench record from either supported file shape."""
+    """Load a bench record from either supported file shape. For a
+    DRIVER snapshot, an out-file written by `bench.py --out` (the
+    untruncatable full record) is consulted: one named by the
+    snapshot's explicit `out` key always wins (the driver opted in);
+    the `<stem>.out.json` naming convention is used only when the
+    snapshot's own `parsed` content is unusable (the BENCH_r05
+    `"parsed": null` truncation shape) — a stale leftover sibling must
+    never silently shadow a good parsed record."""
+    import os
+
     with open(path) as f:
         doc = json.load(f)
+    if isinstance(doc, dict) and "detail" not in doc and "metric" not in doc:
+        snapshot_ok = isinstance(doc.get("parsed"), dict)
+        for cand in _out_file_candidates(path, doc,
+                                         include_siblings=not snapshot_ok):
+            if cand and os.path.exists(cand):
+                try:
+                    with open(cand) as f:
+                        return coerce_record(json.load(f))
+                except (OSError, json.JSONDecodeError, SnapshotError):
+                    pass  # fall back to the snapshot's own content
     return coerce_record(doc)
+
+
+def _out_file_candidates(path: str, doc: dict,
+                         include_siblings: bool = True) -> list[str]:
+    """Where `bench.py --out` full records live next to a driver
+    snapshot: an explicit `out` key in the snapshot, then (only when
+    the caller needs recovery) the `<stem>.out.json` convention."""
+    import os
+
+    out = []
+    if isinstance(doc.get("out"), str):
+        # a relative `out` resolves against the SNAPSHOT's directory
+        # first — the CWD may hold a stale same-named artifact from an
+        # earlier round
+        if not os.path.isabs(doc["out"]):
+            out.append(os.path.join(os.path.dirname(path) or ".",
+                                    doc["out"]))
+        out.append(doc["out"])
+    if include_siblings:
+        stem = os.path.splitext(path)[0]
+        out += [stem + ".out.json", path + ".out"]
+    return out
 
 
 def coerce_record(doc: dict) -> dict:
