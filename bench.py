@@ -49,6 +49,10 @@ BS_HEIGHTS = int(os.environ.get("BENCH_BS_HEIGHTS", "1000"))
 BS_VALS = int(os.environ.get("BENCH_BS_VALS", "150"))
 LC_HEIGHT = int(os.environ.get("BENCH_LC_HEIGHT", "100000"))
 LC_VALS = int(os.environ.get("BENCH_LC_VALS", "500"))
+# light-client fleet serving scenario (bench_light_fleet)
+FLEET_CLIENTS = int(os.environ.get("BENCH_FLEET_CLIENTS", "10000"))
+FLEET_HEIGHT = int(os.environ.get("BENCH_FLEET_HEIGHT", "20000"))
+FLEET_VALS = int(os.environ.get("BENCH_FLEET_VALS", "64"))
 MIXED_BATCH = int(os.environ.get("BENCH_MIXED", "10240"))
 PINNED_VOI_BATCH_FACTOR = 4.0
 VS_BATCH_NOTE = (
@@ -597,6 +601,245 @@ def bench_light_client(detail: dict) -> None:
             (wall - gen_s - fetch_s) / max(hops, 1) * 1e3, 1),
     }
     detail["lc_shape"] = f"height {LC_HEIGHT}, {LC_VALS} validators, churn every {CHURN_EVERY}"
+
+
+def bench_light_fleet(detail: dict) -> None:
+    """Serving-plane scenario (light/fleet.py): FLEET_CLIENTS simulated
+    concurrent light clients hit ONE LightFleet over a provider link
+    degraded by the armed netchaos profile (latency+jitter+drop sampled
+    from p2p/netchaos's link config — the same model the conn wrapper
+    applies to real sockets). Requests follow a serving mix: most
+    clients want the head, a tail bisects random history. Mid-soak the
+    link suffers a full outage (the partition analog) and heals; the
+    post-heal p99 is reported. Headline numbers: lc_amortized_ms
+    (total wall / clients — the millions-of-users metric, enforced
+    lower-is-better by the sentinel) and lc_cache_hit_rate
+    (informational: a workload-mix property)."""
+    import asyncio
+    import random as _random
+
+    from cometbft_tpu import light
+    from cometbft_tpu.crypto import ed25519
+    from cometbft_tpu.light.provider import Provider
+    from cometbft_tpu.p2p import netchaos
+    from cometbft_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+    from cometbft_tpu.types.block import Header
+    from cometbft_tpu.types.light import LightBlock, SignedHeader
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+    from cometbft_tpu.types.vote import Vote
+    from cometbft_tpu.types.vote_set import VoteSet
+    from cometbft_tpu.utils import cmttime
+
+    CHURN_EVERY = max(FLEET_HEIGHT // 8, 1)
+    base_time = cmttime.now().seconds - FLEET_HEIGHT - 1000
+    pool = [ed25519.gen_priv_key() for _ in range(FLEET_VALS * 4)]
+
+    class LazyChain(Provider):
+        def __init__(self):
+            self._valsets: dict[int, tuple] = {}
+            self._blocks: dict[int, LightBlock] = {}
+            self.calls = 0
+
+        def _valset(self, h):
+            ver = h // CHURN_EVERY
+            got = self._valsets.get(ver)
+            if got is None:
+                start = (ver * (FLEET_VALS // 2)) % (len(pool) - FLEET_VALS)
+                privs = pool[start:start + FLEET_VALS]
+                vs = ValidatorSet(
+                    [Validator.new(p.pub_key(), 10) for p in privs])
+                by_addr = {p.pub_key().address(): p for p in privs}
+                got = (vs, [by_addr[v.address] for v in vs.validators])
+                self._valsets[ver] = got
+            return got
+
+        def _block(self, h):
+            lb = self._blocks.get(h)
+            if lb is None:
+                vs, privs = self._valset(h)
+                nvs, _ = self._valset(h + 1)
+                header = Header(
+                    chain_id="bench-fleet", height=h,
+                    time=cmttime.Timestamp(base_time + h, 0),
+                    last_block_id=BlockID(
+                        hash=b"\x07" * 32,
+                        part_set_header=PartSetHeader(total=1, hash=b"\x08" * 32)),
+                    validators_hash=vs.hash(), next_validators_hash=nvs.hash(),
+                    consensus_hash=b"\x01" * 32, app_hash=b"\x02" * 32,
+                    last_results_hash=b"\x03" * 32, data_hash=b"\x04" * 32,
+                    last_commit_hash=b"\x05" * 32, evidence_hash=b"\x06" * 32,
+                    proposer_address=vs.validators[0].address,
+                )
+                bid = BlockID(hash=header.hash(),
+                              part_set_header=PartSetHeader(total=1,
+                                                            hash=b"\x09" * 32))
+                vote_set = VoteSet("bench-fleet", h, 1,
+                                   SignedMsgType.PRECOMMIT, vs)
+                for i, p in enumerate(privs):
+                    v = Vote(type_=SignedMsgType.PRECOMMIT, height=h, round_=1,
+                             block_id=bid, timestamp=cmttime.canonical_now_ms(),
+                             validator_address=p.pub_key().address(),
+                             validator_index=i)
+                    v.signature = p.sign(v.sign_bytes("bench-fleet"))
+                    vote_set.add_vote(v)
+                lb = LightBlock(
+                    signed_header=SignedHeader(header=header,
+                                               commit=vote_set.make_commit()),
+                    validator_set=vs)
+                self._blocks[h] = lb
+            return lb
+
+        async def light_block(self, height):
+            self.calls += 1
+            return self._block(height if height else FLEET_HEIGHT)
+
+        async def report_evidence(self, ev):
+            pass
+
+    class DegradedLink(Provider):
+        """The provider behind a lossy wire: per-fetch delay and drop
+        sampled from the ARMED netchaos link config (the fleet pays the
+        same latency model real sockets would under ChaosConn)."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.rng = _random.Random(7)
+            self.outage = False
+            self.dropped = 0
+
+        @property
+        def calls(self):
+            return self.inner.calls
+
+        async def light_block(self, height):
+            if self.outage:
+                raise light.errors.ErrLightBlockNotFound("link outage")
+            cfg = (netchaos.snapshot().get("config") or {})
+            delay = cfg.get("latency", 0.0) + self.rng.uniform(
+                0, cfg.get("jitter", 0.0))
+            if delay:
+                await asyncio.sleep(delay)
+            if cfg.get("drop", 0.0) and self.rng.random() < cfg["drop"]:
+                self.dropped += 1
+                raise light.errors.ErrLightBlockNotFound(
+                    "netchaos: fetch dropped")
+            return await self.inner.light_block(height)
+
+        async def report_evidence(self, ev):
+            pass
+
+    async def run():
+        netchaos.reset()
+        # armed for this scenario only: the finally below must clear it
+        # even on a mid-soak failure, or every later bench section runs
+        # over silently degraded in-process links
+        netchaos.arm_spec("latency=0.002,jitter=0.002,drop=0.002,seed=7")
+        try:
+            return await _soak()
+        finally:
+            netchaos.reset()
+
+    async def _soak():
+        chain = LazyChain()
+        link = DegradedLink(chain)
+        first = chain._block(1)
+        fleet = light.LightFleet(
+            "bench-fleet", link,
+            light.TrustOptions(period_ns=10 ** 18, height=1,
+                               hash_=first.hash()),
+            cache_capacity=4096, skip_base=16, trust_period_ns=10 ** 18,
+            max_inflight=4096)
+        await fleet.initialize()
+        rng = _random.Random(11)
+        # serving mix: 70% want the head, 20% a hot recent window, 10%
+        # bisect random history
+        heights = []
+        for _ in range(FLEET_CLIENTS):
+            r = rng.random()
+            if r < 0.70:
+                heights.append(FLEET_HEIGHT)
+            elif r < 0.90:
+                heights.append(FLEET_HEIGHT - rng.randint(1, 64))
+            else:
+                heights.append(rng.randint(FLEET_HEIGHT // 2, FLEET_HEIGHT))
+        lat: list[float] = []
+        errors = 0
+
+        async def one(h):
+            # a real client retries a failed request once (the degraded
+            # link drops ~0.2% of fetches, and one drop mid-bisection
+            # fails every coalesced waiter on that flight)
+            nonlocal errors
+            t0 = time.perf_counter()
+            for attempt in (0, 1):
+                try:
+                    await fleet.verify_height(h)
+                    lat.append(time.perf_counter() - t0)
+                    return
+                except light.LightClientError:
+                    if attempt:
+                        errors += 1
+
+        # clients arrive in waves (the serving arrival process), not as
+        # one synchronized burst: the first wave coalesces onto shared
+        # flights, later waves hit the checkpoint cache
+        wave = max(256, FLEET_CLIENTS // 20)
+        t0 = time.perf_counter()
+        for i in range(0, len(heights), wave):
+            await asyncio.gather(*(one(h) for h in heights[i:i + wave]))
+        wall = time.perf_counter() - t0
+
+        # ---- outage + heal: the partition analog on the provider link.
+        # Requests during the outage fail fast; after the heal a fresh
+        # burst must recover to a serving p99
+        link.outage = True
+        out_err = 0
+        for h in range(FLEET_HEIGHT - 200, FLEET_HEIGHT - 180):
+            try:
+                await fleet.verify_height(h)
+            except light.LightClientError:
+                out_err += 1
+        link.outage = False
+        heal_lat: list[float] = []
+        for h in range(FLEET_HEIGHT - 200, FLEET_HEIGHT - 100):
+            t1 = time.perf_counter()
+            try:
+                await fleet.verify_height(h)
+                heal_lat.append(time.perf_counter() - t1)
+            except light.LightClientError:
+                pass
+        return fleet, link, wall, lat, errors, out_err, heal_lat
+
+    fleet, link, wall, lat, errors, out_err, heal_lat = asyncio.run(run())
+    h = fleet.health()
+    lat.sort()
+    heal_lat.sort()
+    detail["lc_amortized_ms"] = round(wall / max(FLEET_CLIENTS, 1) * 1e3, 3)
+    detail["lc_cache_hit_rate"] = h["cache"]["hit_rate"]
+    detail["fleet"] = {
+        "clients": FLEET_CLIENTS,
+        "wall_s": round(wall, 2),
+        "requests": h["requests"],
+        "cache_hits": h["cache_hits"],
+        "coalesced": h["coalesced"],
+        "verified": h["verified"],
+        "amortization": h["amortization"],
+        "errors": errors,
+        "provider_fetches": link.calls,
+        "fetches_dropped": link.dropped,
+        "hops_per_verification": round(link.calls / h["verified"], 2)
+        if h["verified"] else None,
+        "p50_ms": round(lat[len(lat) // 2] * 1e3, 3) if lat else None,
+        "p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3,
+                        3) if lat else None,
+        "outage_errors": out_err,
+        "p99_heal_ms": round(
+            heal_lat[min(len(heal_lat) - 1, int(len(heal_lat) * 0.99))]
+            * 1e3, 3) if heal_lat else None,
+        "shape": f"height {FLEET_HEIGHT}, {FLEET_VALS} validators, "
+                 f"churn every {CHURN_EVERY}, netchaos "
+                 f"latency=2ms jitter=2ms drop=0.2%",
+    }
 
 
 def bench_consensus_tpu(detail: dict) -> None:
@@ -1185,8 +1428,8 @@ def main() -> dict:
 
     # -- subsystem benches (each guarded: a failure reports, not aborts)
     for fn in (bench_blocksync, bench_mixed_megacommit, bench_attribution,
-               bench_light_client, bench_consensus_tpu, bench_scheduler,
-               bench_mesh):
+               bench_light_client, bench_light_fleet, bench_consensus_tpu,
+               bench_scheduler, bench_mesh):
         try:
             _progress(fn.__name__)
             fn(detail)

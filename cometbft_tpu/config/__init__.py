@@ -3,6 +3,7 @@ from cometbft_tpu.config.config import (
     BlockSyncConfig,
     Config,
     InstrumentationConfig,
+    LightConfig,
     P2PConfig,
     RPCConfig,
     StateSyncConfig,
@@ -17,6 +18,7 @@ __all__ = [
     "BlockSyncConfig",
     "Config",
     "InstrumentationConfig",
+    "LightConfig",
     "P2PConfig",
     "RPCConfig",
     "StateSyncConfig",

@@ -88,9 +88,11 @@ class CryptoConfig:
     # cap on rows coalesced into one scheduler batch (groups never split)
     sched_max_lanes: int = 16384
     # flush deadlines per class: consensus is always 0 (inline drain);
-    # sync/mempool work waits at most this long for a ride before the
-    # deadline worker flushes it
+    # sync/light/mempool work waits at most this long for a ride before
+    # the deadline worker flushes it (light = the serving plane's fleet
+    # bisections, sched/scheduler.py LIGHT)
     sched_sync_deadline: float = 0.002
+    sched_light_deadline: float = 0.004
     sched_mempool_deadline: float = 0.010
     # mempool-class admission rejected past this many queued rows (also
     # rejected while consensus/sync backlog alone exceeds it)
@@ -166,7 +168,8 @@ class CryptoConfig:
             raise ValueError("watchdog_timeout must be positive")
         if self.sched_max_lanes < 8:
             raise ValueError("sched_max_lanes must be >= 8")
-        if self.sched_sync_deadline < 0 or self.sched_mempool_deadline < 0:
+        if (self.sched_sync_deadline < 0 or self.sched_light_deadline < 0
+                or self.sched_mempool_deadline < 0):
             raise ValueError("scheduler deadlines cannot be negative")
         if self.sched_queue_limit < 1:
             raise ValueError("sched_queue_limit must be >= 1")
@@ -188,6 +191,66 @@ class CryptoConfig:
             from cometbft_tpu.libs import chaos as _chaos
 
             _chaos.parse_spec(self.chaos)  # raises ValueError on any part
+
+
+@dataclass
+class LightConfig:
+    """The light-client serving plane (light/fleet.py — no reference
+    analog): a witness-side verification service that coalesces many
+    concurrent skipping-verification requests into shared verification
+    futures, caches verified headers in a trust-period-bounded skip list,
+    and streams verified headers to subscribed clients over the
+    `light_subscribe` WS route. All knobs are fleet_* because the plain
+    single-flight light client (light/client.py) needs none of them."""
+
+    # serve the light_verify / light_subscribe routes (opt-in: the fleet
+    # holds a verified-header cache and a head watcher task)
+    fleet_enabled: bool = False
+    # checkpoint skip-list cache capacity in headers (~2-5 KB/header for
+    # small valsets; eviction drops the lowest non-anchor heights first)
+    fleet_cache_capacity: int = 4096
+    # skip-list fanout: heights divisible by fleet_skip_base^k live on
+    # lane k, so nearest-checkpoint lookups walk O(log_base height) lanes
+    fleet_skip_base: int = 16
+    # seconds a cached checkpoint is served before it must be re-verified
+    # (the light-client trusting period applied to the CACHE: an expired
+    # entry is a miss, never a stale answer)
+    fleet_trust_period: float = 168 * 3600.0
+    # comma-separated witness RPC endpoints for divergence cross-checks;
+    # empty = the fleet's own primary doubles as witness (a node serving
+    # its own chain)
+    fleet_witnesses: str = ""
+    # concurrent UNIQUE verification requests before new ones are shed
+    # with FleetSaturated (coalesced duplicates never count)
+    fleet_max_inflight: int = 1024
+    # streaming-subscriber bounds: per-client queued-header high water
+    # (a subscriber this far behind is dropped — backpressure), total
+    # headers a client may be sent before its subscription closes
+    # (0 = unlimited), and the subscriber cap
+    fleet_subscriber_queue: int = 64
+    fleet_send_budget: int = 0
+    fleet_max_subscribers: int = 10000
+    # head-watcher poll cadence when no event bus feeds the fleet
+    fleet_poll_interval: float = 0.25
+
+    def validate_basic(self) -> None:
+        if self.fleet_cache_capacity < 2:
+            raise ValueError("fleet_cache_capacity must be >= 2 "
+                             "(trust root + at least one checkpoint)")
+        if self.fleet_skip_base < 2:
+            raise ValueError("fleet_skip_base must be >= 2")
+        if self.fleet_trust_period <= 0:
+            raise ValueError("fleet_trust_period must be positive")
+        if self.fleet_max_inflight < 1:
+            raise ValueError("fleet_max_inflight must be >= 1")
+        if self.fleet_subscriber_queue < 1:
+            raise ValueError("fleet_subscriber_queue must be >= 1")
+        if self.fleet_send_budget < 0:
+            raise ValueError("fleet_send_budget cannot be negative")
+        if self.fleet_max_subscribers < 1:
+            raise ValueError("fleet_max_subscribers must be >= 1")
+        if self.fleet_poll_interval <= 0:
+            raise ValueError("fleet_poll_interval must be positive")
 
 
 @dataclass
@@ -408,6 +471,7 @@ class Config:
 
     base: BaseConfig = field(default_factory=BaseConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
+    light: LightConfig = field(default_factory=LightConfig)
     rpc: RPCConfig = field(default_factory=RPCConfig)
     grpc: GRPCConfig = field(default_factory=GRPCConfig)
     p2p: P2PConfig = field(default_factory=P2PConfig)
@@ -423,9 +487,10 @@ class Config:
 
     def validate_basic(self) -> None:
         """config.go:318 ValidateBasic: every section that defines one."""
-        for section in (self.base, self.crypto, self.rpc, self.p2p,
-                        self.mempool, self.block_sync, self.state_sync,
-                        self.tx_index, self.instrumentation):
+        for section in (self.base, self.crypto, self.light, self.rpc,
+                        self.p2p, self.mempool, self.block_sync,
+                        self.state_sync, self.tx_index,
+                        self.instrumentation):
             section.validate_basic()
 
     # ------------------------------------------------------------ paths
@@ -456,6 +521,7 @@ class Config:
     _SECTIONS = (
         ("base", ""),  # base fields live at top level, like the reference
         ("crypto", "crypto"),
+        ("light", "light"),
         ("rpc", "rpc"),
         ("grpc", "grpc"),
         ("p2p", "p2p"),

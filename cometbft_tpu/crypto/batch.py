@@ -100,6 +100,7 @@ def configure(crypto_cfg) -> None:
         enabled=crypto_cfg.scheduler,
         max_lanes=crypto_cfg.sched_max_lanes,
         sync_deadline=crypto_cfg.sched_sync_deadline,
+        light_deadline=crypto_cfg.sched_light_deadline,
         mempool_deadline=crypto_cfg.sched_mempool_deadline,
         queue_limit=crypto_cfg.sched_queue_limit,
         starvation_limit=crypto_cfg.sched_starvation_limit,

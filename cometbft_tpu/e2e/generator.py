@@ -27,15 +27,20 @@ VOTE_EXT_HEIGHT_OFFSETS = (0, 2)  # 0 = disabled
 # partition splits the net 2-2 at runtime (unsafe_net_chaos route);
 # byzantine/flood restart the node adversarially (consensus/byzantine.py)
 # and assert detection via evidence_committed / peer_bans metrics.
+# light-fleet restarts a node with the serving plane enabled, drives a
+# client swarm at light_verify, partitions the fleet node mid-soak, and
+# asserts post-heal p99 via the light_fleet metrics.
 PERTURBATIONS = {"kill": 0.1, "pause": 0.1, "restart": 0.1,
                  "device-kill": 0.05, "device-flap": 0.05,
                  "chip-kill:1": 0.05, "chip-flap:1": 0.05,
-                 "partition": 0.05, "byzantine": 0.05, "flood": 0.05}
+                 "partition": 0.05, "byzantine": 0.05, "flood": 0.05,
+                 "light-fleet": 0.05}
 # perturbations that kill + respawn the OS process (a memdb node would
 # lose its stores while its out-of-process app keeps state); compared by
 # BASE name (chip-kill:N respawns too)
 RESPAWN_PERTURBATIONS = {"kill", "restart", "device-kill", "device-flap",
-                         "chip-kill", "chip-flap", "byzantine", "flood"}
+                         "chip-kill", "chip-flap", "byzantine", "flood",
+                         "light-fleet"}
 
 
 def generate_manifest(rng: random.Random, index: int) -> Manifest:

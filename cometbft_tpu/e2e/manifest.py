@@ -38,13 +38,17 @@ class NodeManifest:
     # unsafe_net_chaos route — no progress while split, heal resumes),
     # byzantine (restart equivocating — honest nodes must commit
     # DuplicateVoteEvidence), flood (restart invalid-signature flooding —
-    # honest nodes must ban the peer)
+    # honest nodes must ban the peer);
+    # serving faults: light-fleet (restart with the light-client fleet
+    # service enabled, drive a simulated client swarm against
+    # light_verify, partition the fleet node away mid-soak, and assert
+    # post-heal p99 recovery via the light_fleet metrics)
     perturb: list[str] = field(default_factory=list)
 
     PERTURBATIONS = ("kill", "pause", "restart", "disconnect",
                      "device-kill", "device-flap",
                      "chip-kill", "chip-flap",
-                     "partition", "byzantine", "flood")
+                     "partition", "byzantine", "flood", "light-fleet")
     # perturbations that take a ":<device-index>" argument
     INDEXED_PERTURBATIONS = ("chip-kill", "chip-flap")
 

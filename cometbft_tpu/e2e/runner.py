@@ -201,6 +201,35 @@ def _arm_chip_chaos(home: str, spec: str, kill: bool) -> None:
     cfg.save()
 
 
+def _arm_light_fleet(home: str) -> None:
+    """Enable the light-client fleet service (light/fleet.py) on the
+    node's on-disk config — the serving plane boots with the node."""
+    from cometbft_tpu.config import Config
+
+    cfg = Config.load(home)
+    cfg.light.fleet_enabled = True
+    cfg.save()
+
+
+def _fleet_swarm(net: _Net, i: int, requests: int, seed: int = 0) -> list[float]:
+    """A simulated light-client swarm against node i's light_verify
+    route: `requests` calls over a deterministic spread of committed
+    heights. Returns sorted per-request latencies; raises RunError on a
+    failed verification (a cache-served header the fleet could not
+    produce is a serving-plane bug, not a flake)."""
+    lats: list[float] = []
+    top = max(1, _height(net, i) - 1)
+    for j in range(requests):
+        hq = 1 + (seed + j * 7) % top
+        t0 = time.time()
+        doc = _rpc(net, i, f"light_verify?height={hq}", timeout=15.0)
+        if "result" not in doc:
+            raise RunError(f"light_verify failed at height {hq}: {doc}")
+        lats.append(time.time() - t0)
+    lats.sort()
+    return lats
+
+
 def _arm_byzantine(home: str, behavior: str) -> None:
     """Point the node's on-disk config at an adversarial consensus mode
     (consensus/byzantine.py); empty behavior disarms."""
@@ -429,6 +458,58 @@ def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
                             "cometbft_p2p_partition_heal_seconds") > 0
                             for j in range(n)):
                         raise RunError("partition_heal_seconds not recorded")
+                elif p == "light-fleet":
+                    # restart the node with the serving plane enabled,
+                    # drive a client swarm at light_verify, partition the
+                    # fleet node away MID-SOAK (already-committed heights
+                    # must keep serving from the checkpoint cache), heal,
+                    # and assert post-heal p99 + the light_fleet metrics
+                    log(f"[{manifest.name}] light-fleet {name}")
+                    _kill(net.node_procs[i])
+                    _arm_light_fleet(net.homes[i])
+                    net.node_procs[i] = _spawn_node(net.homes[i])
+                    _wait(lambda: _height(net, i) >= h0, 150,
+                          "the fleet node serving again")
+                    _fleet_swarm(net, i, 40)  # soak phase 1: warm cache
+                    ids = _node_ids(net)
+                    spec = ("partition=" + ids[i] + "|"
+                            + ".".join(ids[j] for j in range(n) if j != i))
+                    log(f"[{manifest.name}] partitioning fleet node "
+                        f"{name} mid-soak")
+                    arg = urllib.parse.quote(f'"{spec}"')
+                    for j in range(n):
+                        _rpc(net, j, f"unsafe_net_chaos?spec={arg}")
+                    time.sleep(2.0)
+                    # the cut fleet node still answers for committed
+                    # heights — the cache needs no quorum
+                    _fleet_swarm(net, i, 15, seed=3)
+                    for j in range(n):
+                        _rpc(net, j, "unsafe_net_chaos?heal=true")
+                    if others:
+                        _wait(lambda: _height(net, i)
+                              >= max(_height(net, j) for j in others) - 1,
+                              150, "the fleet node rejoining after heal")
+                    healed = _fleet_swarm(net, i, 60, seed=11)
+                    p99 = healed[min(len(healed) - 1,
+                                     int(len(healed) * 0.99))]
+                    if p99 > 5.0:
+                        raise RunError(
+                            f"light-fleet on {name}: post-heal p99 "
+                            f"{p99:.2f}s (> 5s budget)")
+                    text = _metrics_text(net, i, timeout=5.0)
+                    served = _metric_value(
+                        text, "cometbft_light_fleet_requests_total")
+                    if served < 100:
+                        raise RunError(
+                            f"light-fleet on {name}: only {served} fleet "
+                            f"requests on /metrics (swarm ran 115)")
+                    hits = _metric_value(
+                        text,
+                        'cometbft_light_fleet_cache_events{event="hit"}')
+                    if hits < 1:
+                        raise RunError(
+                            f"light-fleet on {name}: checkpoint cache "
+                            f"recorded no hits")
                 elif p in ("byzantine", "flood"):
                     # restart the node adversarially; the honest majority
                     # must DETECT it: equivocation -> DuplicateVoteEvidence

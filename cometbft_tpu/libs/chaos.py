@@ -32,6 +32,13 @@ p2p/netchaos.py):
   net.accept         p2p inbound connection intake (before upgrade)
   net.handshake      the secret-connection + node-info upgrade
 
+plus the light-client provider seam (light/rpc_provider.py):
+
+  light.fetch        one light_block RPC attempt against a provider; a
+                     transient/timeout fault here exercises the capped
+                     backoff+jitter retry instead of failing the whole
+                     bisection on one flaky witness hop
+
 Arming, via env (`CBFT_CHAOS`) or `arm()`/`arm_spec()`:
 
   CBFT_CHAOS="ed25519.dispatch=transient:3,pallas.trace=permanent"
@@ -79,6 +86,7 @@ SITES = (
     "net.dial",
     "net.accept",
     "net.handshake",
+    "light.fetch",
 ) + _MESH_SITES
 
 KINDS = ("timeout", "transient", "permanent", "corrupt")

@@ -516,6 +516,46 @@ class SchedMetrics:
                      0.25, 1.0))
 
 
+class LightFleetMetrics:
+    """Light-client serving-plane observability (light/fleet.py — no
+    reference analog): how requests resolve (cache hit / coalesced onto
+    an in-flight verification / freshly verified / shed / error), the
+    checkpoint-cache churn, and the streaming-subscriber lifecycle.
+    Process-global like SchedMetrics — the fleet rides the process's
+    verify plane."""
+
+    def __init__(self, reg: Registry):
+        self.requests = reg.counter(
+            "light_fleet", "requests_total",
+            "Fleet verification requests by result (hit = checkpoint "
+            "cache; coalesced = shared an in-flight bisection; verified "
+            "= ran a fresh bisection; saturated = shed at admission)",
+            labels=("result",))
+        self.cache_events = reg.counter(
+            "light_fleet", "cache_events",
+            "Checkpoint skip-list cache events (hit/miss/evict/prune; "
+            "prune = trusting-period expiry)", labels=("event",))
+        self.request_seconds = reg.histogram(
+            "light_fleet", "request_seconds",
+            "Wall seconds per UNIQUE fleet verification (cache hits and "
+            "coalesced waits excluded)",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 10.0))
+        self.inflight = reg.gauge(
+            "light_fleet", "inflight",
+            "Unique verifications currently in flight")
+        self.subscribers = reg.gauge(
+            "light_fleet", "subscribers", "Live streaming subscribers")
+        self.streamed = reg.counter(
+            "light_fleet", "streamed_headers_total",
+            "Verified headers streamed to subscribers")
+        self.subscriber_drops = reg.counter(
+            "light_fleet", "subscriber_drops_total",
+            "Subscriptions the fleet closed, by reason (backpressure = "
+            "queue high water; budget = per-client send budget spent)",
+            labels=("reason",))
+
+
 _global: Optional[Registry] = None
 
 
@@ -583,6 +623,20 @@ def mesh_metrics() -> MeshMetrics:
             if _mesh is None:
                 _mesh = MeshMetrics(global_registry())
     return _mesh
+
+
+_light_fleet: Optional[LightFleetMetrics] = None
+
+
+def light_fleet_metrics() -> LightFleetMetrics:
+    """Process-global LightFleetMetrics on the global registry (same
+    double-checked init discipline as crypto_metrics)."""
+    global _light_fleet
+    if _light_fleet is None:
+        with _crypto_lock:
+            if _light_fleet is None:
+                _light_fleet = LightFleetMetrics(global_registry())
+    return _light_fleet
 
 
 _netchaos: Optional[NetChaosMetrics] = None

@@ -4,9 +4,18 @@ verifier — stateless VerifyAdjacent / VerifyNonAdjacent / Verify / backwards
 client   — trusted store + bisection + fork detection + attack evidence
 provider — light-block sources (in-memory; node-backed lives with statesync)
 store    — persisted trusted light blocks
+fleet    — the serving plane (no reference analog): coalesced skipping
+           verification, checkpoint skip-list cache, streaming
+           verified-header subscriptions (light/fleet.py)
 """
 
 from cometbft_tpu.light import errors, verifier
+from cometbft_tpu.light.fleet import (
+    CheckpointCache,
+    FleetSaturated,
+    LightFleet,
+    SubscriptionClosed,
+)
 from cometbft_tpu.light.client import (
     SEQUENTIAL,
     SKIPPING,
@@ -36,6 +45,7 @@ from cometbft_tpu.light.verifier import (
 
 __all__ = [
     "errors", "verifier", "Client", "TrustOptions", "SEQUENTIAL", "SKIPPING",
+    "CheckpointCache", "FleetSaturated", "LightFleet", "SubscriptionClosed",
     "make_attack_evidence", "MemProvider", "Provider", "LightStore",
     "DEFAULT_TRUST_LEVEL", "header_expired", "validate_trust_level",
     "verify", "verify_adjacent", "verify_backwards", "verify_non_adjacent",

@@ -18,7 +18,7 @@ the rest of the framework — no locks.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Optional
 
 from cometbft_tpu.libs import log as cmtlog
 from cometbft_tpu.types.evidence import LightClientAttackEvidence
@@ -99,6 +99,22 @@ class Client:
         self.pruning_size = pruning_size
         self.logger = logger or cmtlog.nop()
         self.latest_trusted: Optional[LightBlock] = trusted_store.latest_light_block()
+        # in-flight dedup (libs/singleflight.py — the mempool CheckTx
+        # pattern, extracted): concurrent verify_light_block_at_height/
+        # update calls for the same height share ONE bisection — the
+        # first caller runs it, the rest await its future (height 0 keys
+        # the update() latest-head flight)
+        from cometbft_tpu.libs.singleflight import SingleFlight
+
+        self._flights = SingleFlight()
+        # optional shared-checkpoint source (light/fleet.py points this at
+        # the fleet's skip-list cache): height -> trusted LightBlock at
+        # the greatest cached height <= the requested one, or None. The
+        # default consults this client's own store, so even a plain
+        # client's bisection fast-forwards through heights it has already
+        # verified instead of re-verifying hops above them.
+        self.checkpoint_source: Callable[[int], Optional[LightBlock]] = (
+            lambda h: self.store.light_block_before(h + 1))
 
     # ----------------------------------------------------------- bootstrap
 
@@ -154,26 +170,39 @@ class Client:
     async def verify_light_block_at_height(
         self, height: int, now: cmttime.Timestamp | None = None
     ) -> LightBlock:
-        """client.go:474-523."""
+        """client.go:474-523 — plus in-flight dedup: concurrent calls for
+        the same height share the FIRST caller's bisection instead of each
+        running their own (the store check alone cannot catch this — the
+        store only fills once a flight completes)."""
         if height <= 0:
             raise ValueError("negative or zero height")
         now = now or cmttime.now()
         existing = self.store.light_block(height)
         if existing is not None:
             return existing
+        _, lb = await self._flights.do(
+            height, lambda: self._verify_at_height(height, now))
+        return lb
+
+    async def _verify_at_height(self, height: int, now) -> LightBlock:
         lb = await self._light_block_from_primary(height)
         await self._verify_light_block(lb, now)
         return lb
 
     async def update(self, now: cmttime.Timestamp | None = None) -> Optional[LightBlock]:
         """client.go:436-470: fetch + verify the primary's latest header if
-        newer than the last trusted one."""
+        newer than the last trusted one. Concurrent update() calls share
+        one flight (dedup key 0)."""
         now = now or cmttime.now()
-        last = self.latest_trusted
-        if last is None:
+        if self.latest_trusted is None:
             raise LightClientError("no headers exist yet")
+        _, lb = await self._flights.do(0, lambda: self._update_flight(now))
+        return lb
+
+    async def _update_flight(self, now) -> Optional[LightBlock]:
+        last = self.latest_trusted
         latest = await self._light_block_from_primary(0)
-        if latest.height > last.height:
+        if last is not None and latest.height > last.height:
             await self._verify_light_block(latest, now)
             return latest
         return None
@@ -229,7 +258,15 @@ class Client:
         now: cmttime.Timestamp,
     ) -> list[LightBlock]:
         """client.go:706-775 — bisection. Returns the verification trace
-        (every block the client had to fully verify, in height order)."""
+        (every block the client had to fully verify, in height order).
+
+        Shared-cache fast-forward: before fetching a pivot from the
+        provider, `checkpoint_source` is consulted for an already-trusted
+        block in (verified, pivot] — a hit advances `verified` directly
+        (no fetch, no signature work for the hops below it). The fleet
+        service points this at its skip-list checkpoint cache, so a cold
+        client's bisection restarts from the nearest cached checkpoint
+        instead of walking all the way up from its own trust root."""
         block_cache = [new_lb]
         depth = 0
         verified = trusted
@@ -250,6 +287,11 @@ class Client:
                         verified.height
                         + (target.height - verified.height) * _PIVOT_NUM // _PIVOT_DEN
                     )
+                    cached = self._trusted_checkpoint(pivot, verified, now)
+                    if cached is not None:
+                        verified = cached
+                        trace.append(verified)
+                        continue
                     interim = await source.light_block(pivot)
                     block_cache.append(interim)
                 depth += 1
@@ -418,6 +460,24 @@ class Client:
         raise LightClientError("no divergence found in trace (contract violation)")
 
     # ----------------------------------------------------------- plumbing
+
+    def _trusted_checkpoint(
+        self, pivot: int, verified: LightBlock, now: cmttime.Timestamp
+    ) -> Optional[LightBlock]:
+        """An already-trusted block in (verified.height, pivot] from the
+        shared checkpoint source, still within its trusting period —
+        or None. Never raises: a broken cache degrades to a plain fetch."""
+        try:
+            cached = self.checkpoint_source(pivot)
+        except Exception:  # noqa: BLE001 - cache trouble must not fail verify
+            return None
+        if (cached is None or cached.height <= verified.height
+                or cached.height > pivot):
+            return None
+        if verifier.header_expired(
+                cached.signed_header, self.trusting_period_ns, now):
+            return None
+        return cached
 
     async def _light_block_from_primary(self, height: int) -> LightBlock:
         """client.go:990-1017 (without the primary-replacement dance: a

@@ -33,6 +33,46 @@ class Provider(ABC):
         return repr(self)
 
 
+class NodeBackedProvider(Provider):
+    """The fleet's primary on a serving node: wire-exact LightBlocks
+    straight from the node's own stores — the rpc/core `light_block`
+    route without the HTTP hop. `calls` counts fetches (the fleet's
+    per-request bisection-budget accounting reads it)."""
+
+    def __init__(self, node):
+        self.node = node
+        self.calls = 0
+
+    async def light_block(self, height: int) -> LightBlock:
+        from cometbft_tpu.types.light import SignedHeader
+
+        self.calls += 1
+        n = self.node
+        h = height or n.block_store.height()
+        if height and height > n.block_store.height():
+            raise ErrHeightTooHigh(
+                f"node head is {n.block_store.height()}, want {height}")
+        meta = n.block_store.load_block_meta(h)
+        commit = (n.block_store.load_block_commit(h)
+                  or n.block_store.load_seen_commit(h))
+        vals = n.state_store.load_validators(h)
+        if meta is None or commit is None or vals is None:
+            raise ErrLightBlockNotFound(
+                f"no light-block material at height {h}")
+        return LightBlock(
+            signed_header=SignedHeader(header=meta.header, commit=commit),
+            validator_set=vals,
+        )
+
+    async def report_evidence(self, ev) -> None:
+        pool = getattr(self.node, "evidence_pool", None)
+        if pool is not None:
+            pool.add_evidence(ev)
+
+    def id_(self) -> str:
+        return f"node:{getattr(getattr(self.node, 'node_info', None), 'moniker', '?')}"
+
+
 class MemProvider(Provider):
     """light/provider/mock/mock.go: a provider over an in-memory chain map.
     Mutable so tests can fork it (serve conflicting headers past a height)."""

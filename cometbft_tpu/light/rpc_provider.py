@@ -11,6 +11,9 @@ from __future__ import annotations
 import asyncio
 import base64
 import json
+import random
+import socket
+import urllib.error
 import urllib.request
 
 from cometbft_tpu.types.light import LightBlock
@@ -23,6 +26,24 @@ from cometbft_tpu.light.errors import (
 from cometbft_tpu.light.provider import Provider
 
 
+def _transient(e: BaseException) -> bool:
+    """Worth retrying? Timeouts, connection resets, and 5xx server
+    errors are one flaky hop; 4xx, malformed bodies, and RPC-level
+    errors are the provider's answer and retrying cannot change it.
+    The chaos taxonomy maps the same way (libs/chaos.py): transient and
+    timeout retry, permanent does not."""
+    from cometbft_tpu.libs import chaos as _chaos
+
+    if isinstance(e, urllib.error.HTTPError):
+        return 500 <= e.code < 600
+    if isinstance(e, (_chaos.ChaosTransientError, _chaos.ChaosTimeout)):
+        return True
+    if isinstance(e, _chaos.ChaosPermanentError):
+        return False
+    return isinstance(e, (urllib.error.URLError, socket.timeout,
+                          TimeoutError, ConnectionError, OSError))
+
+
 def normalize_rpc_url(base_url: str) -> str:
     """tcp://host:port or bare host:port -> http URL (shared by the RPC
     provider and the light proxy's primary client)."""
@@ -33,22 +54,55 @@ def normalize_rpc_url(base_url: str) -> str:
 
 
 class RPCProvider(Provider):
-    """light/provider/http/http.go shape over the framework's JSON-RPC."""
+    """light/provider/http/http.go shape over the framework's JSON-RPC.
 
-    def __init__(self, chain_id: str, base_url: str, timeout: float = 10.0):
+    Transient provider errors (timeouts, connection resets, 5xx) retry
+    with capped exponential backoff + jitter instead of failing the
+    whole bisection on one flaky witness hop — the PR 2 supervisor
+    retry policy applied to the light provider seam. The `light.fetch`
+    chaos site (libs/chaos.py) fires once per ATTEMPT, so a
+    deterministic schedule (`light.fetch=transient:2`) exercises
+    exactly two retries; netchaos-shaped real links exercise the same
+    path through genuine socket timeouts."""
+
+    def __init__(self, chain_id: str, base_url: str, timeout: float = 10.0,
+                 retry_attempts: int = 3, backoff_base: float = 0.05,
+                 backoff_cap: float = 1.0):
         self.chain_id = chain_id
         self.base_url = normalize_rpc_url(base_url)
         self.timeout = timeout
+        self.retry_attempts = retry_attempts
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.retries = 0  # lifetime transient retries (test/health surface)
 
     def _get(self, route: str) -> dict:
+        from cometbft_tpu.libs import chaos as _chaos
+
+        _chaos.fire("light.fetch")
         with urllib.request.urlopen(
                 f"{self.base_url}/{route}", timeout=self.timeout) as r:
             return json.load(r)
 
+    async def _get_retrying(self, route: str) -> dict:
+        attempt = 0
+        while True:
+            try:
+                return await asyncio.to_thread(self._get, route)
+            except Exception as e:  # noqa: BLE001 - classified below
+                if attempt >= self.retry_attempts or not _transient(e):
+                    raise
+                delay = min(self.backoff_base * (2 ** attempt),
+                            self.backoff_cap)
+                delay += random.uniform(0, delay)  # full jitter
+                attempt += 1
+                self.retries += 1
+                await asyncio.sleep(delay)
+
     async def light_block(self, height: int) -> LightBlock:
         route = "light_block" + (f"?height={height}" if height else "")
         try:
-            doc = await asyncio.to_thread(self._get, route)
+            doc = await self._get_retrying(route)
         except Exception as e:  # noqa: BLE001 - network/HTTP failures
             raise ErrLightBlockNotFound(f"{self.base_url}: {e}") from e
         if "error" in doc:

@@ -79,5 +79,23 @@ class LightStore:
         while len(self._heights) > size:
             self.delete_light_block(self._heights[0])
 
+    def prune_expired(self, trusting_period_ns: int, now) -> int:
+        """Drop every block whose trusting period has lapsed at `now` —
+        an expired header can no longer anchor any verification, so
+        keeping it only wastes the size budget. Returns the count pruned.
+        (The serving plane's checkpoint cache applies the same rule
+        in-memory; this is the persistent-store twin.)"""
+        pruned = 0
+        for h in list(self._heights):
+            lb = self.light_block(h)
+            if lb is None:
+                continue
+            if lb.time.unix_ns() + trusting_period_ns <= now.unix_ns():
+                self.delete_light_block(h)
+                pruned += 1
+            else:
+                break  # heights ascend and so do header times
+        return pruned
+
     def size(self) -> int:
         return len(self._heights)

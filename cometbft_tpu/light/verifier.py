@@ -117,11 +117,15 @@ def verify_adjacent(
             f"those from new header ({untrusted_header.header.validators_hash.hex()})"
         )
     try:
-        # sync class: a light hop must not preempt consensus flushes in
-        # the global verify scheduler
+        # sync class by default: a light hop must not preempt consensus
+        # flushes in the global verify scheduler. The fleet service
+        # (light/fleet.py) sets the ambient LIGHT class around its
+        # bisections — external serving traffic yields to a catching-up
+        # node's own sync windows too — and that choice is respected here.
         from cometbft_tpu import sched
 
-        with sched.work_class(sched.SYNC):
+        klass = sched.LIGHT if sched.current_class() == sched.LIGHT else sched.SYNC
+        with sched.work_class(klass):
             verify_commit_light(
                 trusted_header.chain_id,
                 untrusted_vals,
@@ -188,7 +192,11 @@ def verify_non_adjacent(
         )
     except Exception as e:  # noqa: BLE001 - verifier.go:69-72 wrapping
         raise ErrInvalidHeader(e) from e
-    prefetch_staged([staged_trust, staged_new], klass="sync")
+    from cometbft_tpu import sched as _sched
+
+    prefetch_staged([staged_trust, staged_new],
+                    klass=_sched.LIGHT
+                    if _sched.current_class() == _sched.LIGHT else "sync")
     try:
         staged_trust.finish()
     except ErrNotEnoughVotingPowerSigned as e:

@@ -70,6 +70,11 @@ class UriStr(str):
     hex are never misdecoded."""
 
 
+def _ws_err(rid, code: int, message: str) -> dict:
+    return {"jsonrpc": "2.0", "id": rid,
+            "error": {"code": code, "message": message}}
+
+
 class Environment:
     """rpc/core/env.go: the handlers' view of the node."""
 
@@ -77,6 +82,10 @@ class Environment:
         self.node = node
         self._bg_tasks: set = set()
         self._gen_chunks: list[str] | None = None
+        # lazily-built light-client fleet service (light/fleet.py) behind
+        # the light_verify / light_subscribe routes
+        self._light_fleet = None
+        self._fleet_lock = None  # created on the serving loop
 
     # ------------------------------------------------------------- info
 
@@ -498,6 +507,176 @@ class Environment:
         )
         return {"height": str(height), "light_block": _b64(lb.to_proto())}
 
+    # ------------------------------------------------------- light fleet
+    # The serving plane (light/fleet.py): coalesced skipping
+    # verification + checkpoint skip-list cache behind `light_verify`,
+    # streaming verified headers behind the WS `light_subscribe` route
+    # (rpc/server.py hands that one to ws_light_subscribe below).
+
+    async def _ensure_fleet(self):
+        import asyncio
+
+        from cometbft_tpu.light.fleet import LightFleet
+
+        cfg = getattr(self.node, "config", None)
+        lc = getattr(cfg, "light", None)
+        if lc is None or not lc.fleet_enabled:
+            raise RPCError(
+                -32601, "light fleet disabled (set light.fleet_enabled)")
+        if self._fleet_lock is None:
+            self._fleet_lock = asyncio.Lock()
+        async with self._fleet_lock:
+            if self._light_fleet is not None:
+                return self._light_fleet
+            from cometbft_tpu.light.client import TrustOptions
+            from cometbft_tpu.light.provider import NodeBackedProvider
+            from cometbft_tpu.light.rpc_provider import RPCProvider
+
+            chain_id = self.node.genesis_doc.chain_id
+            provider = NodeBackedProvider(self.node)
+            base = self.node.block_store.base() or 1
+            try:
+                root = await provider.light_block(base)
+            except Exception as e:  # noqa: BLE001 - no material yet
+                raise RPCError(
+                    -32001, f"no light-block material to anchor the "
+                            f"fleet yet: {e}") from e
+            period_ns = int(lc.fleet_trust_period * 1e9)
+            witnesses = [
+                RPCProvider(chain_id, u.strip())
+                for u in lc.fleet_witnesses.split(",") if u.strip()
+            ]
+            fleet = LightFleet(
+                chain_id, provider,
+                TrustOptions(period_ns=period_ns, height=root.height,
+                             hash_=root.hash()),
+                witnesses=witnesses or None,
+                cache_capacity=lc.fleet_cache_capacity,
+                skip_base=lc.fleet_skip_base,
+                trust_period_ns=period_ns,
+                max_inflight=lc.fleet_max_inflight,
+                subscriber_queue=lc.fleet_subscriber_queue,
+                send_budget=lc.fleet_send_budget,
+                max_subscribers=lc.fleet_max_subscribers,
+                poll_interval=lc.fleet_poll_interval,
+                logger=getattr(self.node, "logger", None),
+            )
+            await fleet.initialize()
+            self._light_fleet = fleet
+            return fleet
+
+    async def light_verify(self, params: dict) -> dict:
+        """Fleet-served skipping verification (no reference analog): the
+        header at `height` verified through the shared checkpoint cache
+        and coalesced in-flight bisections — thousands of concurrent
+        clients asking for overlapping ranges cost one verification per
+        unique height. Returns the wire-exact LightBlock proto (base64)
+        plus a fleet accounting snapshot."""
+        from cometbft_tpu.light.errors import LightClientError
+        from cometbft_tpu.light.fleet import FleetSaturated
+
+        fleet = await self._ensure_fleet()
+        try:
+            height = int(params.get("height") or 0)
+        except (TypeError, ValueError) as e:
+            raise RPCError(-32602, f"bad height param: {e}") from e
+        if height <= 0:
+            height = self.node.block_store.height()
+        # optional client pin: hex hash of the validator set the client
+        # expects at that height — a mismatch errors instead of serving
+        pin = params.get("valset_hash") or ""
+        try:
+            pin_bytes = bytes.fromhex(pin) if pin else b""
+        except ValueError as e:
+            raise RPCError(-32602, f"bad valset_hash param (want hex): "
+                                   f"{e}") from e
+        try:
+            lb = await fleet.verify_height(height, pin_bytes)
+        except FleetSaturated as e:
+            raise RPCError(-32005, str(e)) from e
+        except LightClientError as e:
+            raise RPCError(-32001, f"light verification failed: {e}") from e
+        # counters() not health(): the response's accounting block must
+        # be O(1) — health() sorts the latency sample buffer, which a
+        # cache-hit-heavy serving load would pay on EVERY request
+        return {
+            "height": str(lb.height),
+            "light_block": _b64(lb.to_proto()),
+            "fleet": fleet.counters(),
+        }
+
+    async def ws_light_subscribe(self, req: dict, client_id: str, tasks,
+                                 send_json) -> None:
+        """WS half of the serving plane (rpc/server.py dispatches the
+        `light_subscribe` method here): register the client with the
+        fleet and pump verified headers at it until it falls behind
+        (backpressure drop), spends its send budget, or disconnects."""
+        from cometbft_tpu.light.fleet import FleetSaturated
+
+        rid = req.get("id", -1)
+        params = req.get("params") or {}
+        try:
+            fleet = await self._ensure_fleet()
+        except RPCError as e:
+            await send_json(_ws_err(rid, e.code, str(e)))
+            return
+        try:
+            sub = fleet.subscribe(
+                client_id, int(params.get("from_height") or 0))
+        except FleetSaturated as e:
+            await send_json(_ws_err(rid, -32005, str(e)))
+            return
+        tasks.spawn(self._pump_light(sub, rid, send_json),
+                    name=f"light-sub-{client_id}")
+        await send_json({"jsonrpc": "2.0", "id": rid, "result": {}})
+
+    async def ws_light_unsubscribe(self, req: dict, client_id: str, _tasks,
+                                   send_json) -> None:
+        if self._light_fleet is not None:
+            self._light_fleet.unsubscribe(client_id)
+        await send_json({"jsonrpc": "2.0", "id": req.get("id", -1),
+                         "result": {}})
+
+    async def _pump_light(self, sub, rid, send_json) -> None:
+        """Drain one subscription's queue onto the socket. The close
+        reason is sent before the stream goes quiet (the ws_handler.go
+        cancellation-notice convention)."""
+        import asyncio as _aio
+
+        from cometbft_tpu.light.fleet import SubscriptionClosed
+
+        while True:
+            try:
+                lb = await sub.next()
+            except SubscriptionClosed as e:
+                try:
+                    await send_json(_ws_err(
+                        f"{rid}#header", -32000,
+                        f"light subscription closed: {e.reason}"))
+                except (ConnectionError, _aio.IncompleteReadError, OSError):
+                    pass
+                return
+            await send_json({
+                "jsonrpc": "2.0",
+                "id": f"{rid}#header",
+                "result": {
+                    "height": str(lb.height),
+                    "light_block": _b64(lb.to_proto()),
+                },
+            })
+
+    async def ws_client_closed(self, client_id: str) -> None:
+        """rpc/server.py calls this when a WS connection dies: release
+        the client's fleet subscription alongside its event-bus subs."""
+        if self._light_fleet is not None:
+            self._light_fleet.unsubscribe(client_id)
+
+    async def close(self) -> None:
+        """Server shutdown hook: stop the fleet's head watcher so no
+        task outlives the RPC plane."""
+        if self._light_fleet is not None:
+            await self._light_fleet.stop()
+
     async def validators(self, params: dict) -> dict:
         """rpc/core/consensus.go Validators. Unlike block queries, validator
         sets are known one block ahead (state store holds V at H+1), so an
@@ -890,6 +1069,7 @@ class Environment:
             "check_tx": self.check_tx,
             "genesis_chunked": self.genesis_chunked,
             "light_block": self.light_block,
+            "light_verify": self.light_verify,
             "validators": self.validators,
             "consensus_state": self.consensus_state,
             "abci_info": self.abci_info,

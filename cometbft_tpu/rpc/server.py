@@ -76,6 +76,14 @@ class RPCServer(BaseService):
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        # env-held services (the light fleet's head watcher) must not
+        # outlive the RPC plane
+        closer = getattr(self.env, "close", None)
+        if closer is not None:
+            try:
+                await closer()
+            except Exception:  # noqa: BLE001 - shutdown is best-effort
+                pass
 
     # ------------------------------------------------------------- serving
 
@@ -154,6 +162,7 @@ class RPCServer(BaseService):
                     cmtmetrics.crypto_metrics()    # ensure series exist
                     cmtmetrics.netchaos_metrics()  # (net-chaos plane too)
                     cmtmetrics.sched_metrics()     # (verify scheduler)
+                    cmtmetrics.light_fleet_metrics()  # (serving plane)
                     body += cmtmetrics.global_registry().render()
                 return 200, _RawText(body)
             if route == "openapi.yaml":
@@ -277,6 +286,22 @@ class RPCServer(BaseService):
         rid = req.get("id", -1)
         method = req.get("method", "")
         params = req.get("params") or {}
+        if method in ("light_subscribe", "light_unsubscribe"):
+            # the serving plane's streaming route (light/fleet.py):
+            # verified headers pushed as heights commit, with
+            # backpressure and per-client send budgets enforced by the
+            # fleet — independent of the event bus
+            handler = getattr(
+                self.env,
+                "ws_light_subscribe" if method == "light_subscribe"
+                else "ws_light_unsubscribe", None)
+            if handler is None:
+                await send_json(_err_envelope(
+                    rid, -32601, "light streaming unavailable on this "
+                                 "endpoint"))
+                return
+            await handler(req, client_id, tasks, send_json)
+            return
         bus = getattr(self.node, "event_bus", None)
         if bus is None:
             # node-less servers (light proxy) may relay subscriptions

@@ -13,6 +13,7 @@ import threading
 from cometbft_tpu.sched.scheduler import (  # noqa: F401 - public re-exports
     CLASSES,
     CONSENSUS,
+    LIGHT,
     MEMPOOL,
     SYNC,
     SchedulerSaturated,
@@ -50,8 +51,8 @@ def configure(enabled: bool | None = None, **kwargs) -> None:
     directly). Unknown knobs raise. Live instance updated in place so a
     reconfig doesn't orphan queued work."""
     global _enabled
-    allowed = {"max_lanes", "sync_deadline", "mempool_deadline",
-               "queue_limit", "starvation_limit"}
+    allowed = {"max_lanes", "sync_deadline", "light_deadline",
+               "mempool_deadline", "queue_limit", "starvation_limit"}
     bad = set(kwargs) - allowed
     if bad:
         raise ValueError(f"unknown scheduler knob(s) {sorted(bad)}")
@@ -64,6 +65,8 @@ def configure(enabled: bool | None = None, **kwargs) -> None:
                 _sched.max_lanes = kwargs["max_lanes"]
             if "sync_deadline" in kwargs:
                 _sched.class_deadline[SYNC] = kwargs["sync_deadline"]
+            if "light_deadline" in kwargs:
+                _sched.class_deadline[LIGHT] = kwargs["light_deadline"]
             if "mempool_deadline" in kwargs:
                 _sched.class_deadline[MEMPOOL] = kwargs["mempool_deadline"]
             if "queue_limit" in kwargs:

@@ -51,6 +51,7 @@ condition variable and only exists once something queues with a deadline.
 from __future__ import annotations
 
 import concurrent.futures
+import contextvars
 import threading
 import time
 from contextlib import contextmanager
@@ -61,11 +62,16 @@ import numpy as np
 from cometbft_tpu.libs import trace
 
 # priority classes, highest first (the wire values appear in metrics
-# labels and the crypto_health snapshot — keep in sync with README)
+# labels and the crypto_health snapshot — keep in sync with README).
+# LIGHT is the serving plane's class (light/fleet.py): fleet bisections
+# ride below node-critical sync (a catching-up node beats external
+# clients) but above mempool filler — and unlike mempool they are never
+# rejected at admission (the fleet applies its own saturation gate).
 CONSENSUS = "consensus"
 SYNC = "sync"
+LIGHT = "light"
 MEMPOOL = "mempool"
-CLASSES = (CONSENSUS, SYNC, MEMPOOL)
+CLASSES = (CONSENSUS, SYNC, LIGHT, MEMPOOL)
 
 # grace beyond a group's deadline before its flush counts as a miss (the
 # worker wakes AT the deadline; only contention pushes past this)
@@ -85,30 +91,36 @@ class SchedulerSaturated(Exception):
 # parameter — its callers predate the scheduler). Consensus-critical is
 # the safe default: unlabeled paths (LastCommit reconstruction on
 # restart, RPC-triggered verifies) must never be starved behind filler.
+#
+# A ContextVar, NOT threading.local: the fleet service holds
+# work_class(LIGHT) across awaits (provider fetches suspend mid-extent),
+# and a thread-local would leak the class to every other coroutine
+# interleaving on the loop thread — worse, two overlapping extents
+# exiting non-LIFO would poison the ambient class permanently.
+# ContextVars are per-task under asyncio and per-thread otherwise, and
+# token-based reset is exact under any interleaving.
 
-_ambient = threading.local()
+_ambient: contextvars.ContextVar = contextvars.ContextVar(
+    "verify_work_class", default=None)
 
 
 def current_class() -> str:
-    return getattr(_ambient, "klass", CONSENSUS)
+    return _ambient.get() or CONSENSUS
 
 
 @contextmanager
 def work_class(klass: str):
     """Set the ambient priority class for verifiers created in this
-    thread's dynamic extent (blocksync/light/evidence label their
-    verification SYNC through this)."""
+    dynamic extent — per-task under asyncio, per-thread otherwise
+    (blocksync/light/evidence label their verification SYNC through
+    this; the fleet labels its bisections LIGHT)."""
     if klass not in CLASSES:
         raise ValueError(f"unknown verify class {klass!r} (classes: {CLASSES})")
-    prev = getattr(_ambient, "klass", None)
-    _ambient.klass = klass
+    token = _ambient.set(klass)
     try:
         yield
     finally:
-        if prev is None:
-            del _ambient.klass
-        else:
-            _ambient.klass = prev
+        _ambient.reset(token)
 
 
 # ------------------------------------------------------------------- groups
@@ -155,6 +167,7 @@ class VerifyScheduler:
         self,
         max_lanes: int = 16384,
         sync_deadline: float = 0.002,
+        light_deadline: float = 0.004,
         mempool_deadline: float = 0.010,
         queue_limit: int = 16384,
         starvation_limit: float = 0.25,
@@ -162,7 +175,8 @@ class VerifyScheduler:
     ):
         self.max_lanes = max_lanes
         self.class_deadline = {
-            CONSENSUS: 0.0, SYNC: sync_deadline, MEMPOOL: mempool_deadline,
+            CONSENSUS: 0.0, SYNC: sync_deadline, LIGHT: light_deadline,
+            MEMPOOL: mempool_deadline,
         }
         self.queue_limit = queue_limit
         self.starvation_limit = starvation_limit
@@ -281,7 +295,8 @@ class VerifyScheduler:
             if klass == MEMPOOL:
                 # reject when this class is full OR when higher-priority
                 # backlog already fills the next buckets without filler
-                higher = self._depth[CONSENSUS] + self._depth[SYNC]
+                higher = (self._depth[CONSENSUS] + self._depth[SYNC]
+                          + self._depth[LIGHT])
                 if depth + len(rows) > self.queue_limit or higher >= self.queue_limit:
                     self.rejected += 1
                     raise SchedulerSaturated(

@@ -111,6 +111,33 @@ class TestDirectionAwareCompare:
         assert bc.compare(old, worse,
                           threshold_scale=1.5)["verdict"] == "pass"
 
+    def test_fleet_amortized_is_enforced_lower_better(self):
+        """Serving-plane sentinel wiring: lc_amortized_ms regressing UP
+        past 50% fails; the same delta as an improvement passes; the
+        hit rate is informational with a stated why."""
+        old = _record(lc_amortized_ms=4.0, lc_cache_hit_rate=0.85)
+        worse = _record(lc_amortized_ms=9.0, lc_cache_hit_rate=0.2)
+        v = bc.compare(old, worse)
+        assert "lc_amortized_ms" in v["regressions"]
+        assert bc.compare(worse, old)["verdict"] == "pass"
+        row = v["metrics"]["lc_cache_hit_rate"]
+        assert row["verdict"] == "info"
+        assert "workload-mix" in row["why_info"]
+
+    def test_fleet_sentinel_self_test_case(self):
+        """The --self-test contract holds on a fleet-shaped record: an
+        injected lc_amortized_ms regression is flagged, the identical
+        snapshot and the improvement direction are not."""
+        rec = _record(lc_amortized_ms=4.0, lc_cache_hit_rate=0.85)
+        worse, metric, pct = bc.inject_regression(
+            rec, metric="lc_amortized_ms")
+        assert metric == "lc_amortized_ms" and pct > 50.0
+        caught = bc.compare(rec, worse)
+        assert caught["verdict"] == "fail"
+        assert "lc_amortized_ms" in caught["regressions"]
+        assert bc.compare(rec, rec)["verdict"] == "pass"
+        assert bc.compare(worse, rec)["verdict"] == "pass"
+
 
 class TestSnapshotShapes:
     def test_driver_record_with_parsed(self):

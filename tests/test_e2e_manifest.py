@@ -80,6 +80,28 @@ def test_device_fault_perturbations_are_legal_and_roundtrip():
             assert fault.partition(":")[0] in chaos.KINDS
 
 
+def test_light_fleet_perturbation_is_legal_and_roundtrips():
+    """light-fleet (runner.py: restart with the serving plane enabled,
+    swarm light_verify, partition mid-soak, assert post-heal p99) is a
+    first-class matrix cell that respawns — so a memdb node drawing it
+    must be promoted to persistent storage by the generator rule."""
+    m = Manifest(nodes={
+        "a": NodeManifest(perturb=["light-fleet"]),
+        "b": NodeManifest(),
+        "c": NodeManifest(),
+        "d": NodeManifest(),
+    })
+    m.validate()
+    assert Manifest.from_toml(m.to_toml()) == m
+    from cometbft_tpu.e2e.generator import (
+        PERTURBATIONS,
+        RESPAWN_PERTURBATIONS,
+    )
+
+    assert "light-fleet" in PERTURBATIONS
+    assert "light-fleet" in RESPAWN_PERTURBATIONS
+
+
 def test_runner_setup_materializes_manifest(tmp_path):
     from cometbft_tpu.config import Config
     from cometbft_tpu.e2e.runner import setup

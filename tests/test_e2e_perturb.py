@@ -252,3 +252,28 @@ def test_runner_partition_byzantine_flood_matrix(tmp_path):
     )
     m.validate()
     run_manifest(m, str(tmp_path / "net"), base_port=30500)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_runner_light_fleet_perturbation(tmp_path):
+    """The serving-plane perturbation on real OS processes: one node is
+    restarted with the light fleet enabled, a client swarm drives
+    light_verify, the fleet node is partitioned away MID-SOAK (committed
+    heights keep serving from the checkpoint cache), and after the heal
+    the post-heal swarm p99 and the light_fleet metrics are asserted by
+    the runner."""
+    from cometbft_tpu.e2e.manifest import Manifest, NodeManifest
+    from cometbft_tpu.e2e.runner import run_manifest
+
+    m = Manifest(
+        name="light-fleet-soak",
+        nodes={
+            "node0": NodeManifest(perturb=["light-fleet"]),
+            "node1": NodeManifest(),
+            "node2": NodeManifest(),
+            "node3": NodeManifest(),
+        },
+    )
+    m.validate()
+    run_manifest(m, str(tmp_path / "net"), base_port=30700)

@@ -1,0 +1,145 @@
+"""LightStore (light/store.py) coverage: save/retrieve semantics,
+lowest/highest scans, before-height lookups at the edges, size pruning
+bounds, trust-period pruning, hash lookup, and restart persistence over
+the SQLite backend — the satellite the store never had."""
+
+import pytest
+
+from cometbft_tpu.light.store import LightStore
+from cometbft_tpu.store import MemDB
+from cometbft_tpu.store.db import SQLiteDB
+from cometbft_tpu.utils import cmttime
+
+from light_harness import LightChain
+
+CHAIN_ID = "store-chain"
+
+
+@pytest.fixture(scope="module")
+def chain():
+    return LightChain(CHAIN_ID, 20, n_vals=3)
+
+
+class TestBasics:
+    def test_save_and_get(self, chain):
+        s = LightStore(MemDB())
+        s.save_light_block(chain.blocks[7])
+        got = s.light_block(7)
+        assert got is not None
+        assert got.to_proto() == chain.blocks[7].to_proto()
+        assert s.light_block(8) is None
+
+    def test_rejects_nonpositive_height(self):
+        class _ZeroHeight:
+            height = 0
+
+        s = LightStore(MemDB())
+        with pytest.raises(ValueError):
+            s.save_light_block(_ZeroHeight())
+
+    def test_save_is_idempotent_for_heights(self, chain):
+        s = LightStore(MemDB())
+        s.save_light_block(chain.blocks[4])
+        s.save_light_block(chain.blocks[4])
+        assert s.size() == 1
+
+    def test_lowest_highest_and_before(self, chain):
+        s = LightStore(MemDB())
+        for h in (3, 9, 14, 18):
+            s.save_light_block(chain.blocks[h])
+        assert s.first_light_block().height == 3
+        assert s.latest_light_block().height == 18
+        assert s.light_block_before(18).height == 14
+        assert s.light_block_before(15).height == 14
+        assert s.light_block_before(9).height == 3
+        assert s.light_block_before(3) is None
+        assert s.light_block_before(2) is None
+
+    def test_empty_store_edges(self):
+        s = LightStore(MemDB())
+        assert s.size() == 0
+        assert s.first_light_block() is None
+        assert s.latest_light_block() is None
+        assert s.light_block_before(10) is None
+
+    def test_by_hash(self, chain):
+        s = LightStore(MemDB())
+        for h in (2, 5):
+            s.save_light_block(chain.blocks[h])
+        got = s.light_block_by_hash(chain.blocks[5].hash())
+        assert got is not None and got.height == 5
+        assert s.light_block_by_hash(b"\x00" * 32) is None
+
+    def test_delete(self, chain):
+        s = LightStore(MemDB())
+        s.save_light_block(chain.blocks[6])
+        s.delete_light_block(6)
+        assert s.size() == 0 and s.light_block(6) is None
+        s.delete_light_block(6)  # deleting a missing height is a no-op
+
+
+class TestPruning:
+    def test_prune_keeps_newest(self, chain):
+        s = LightStore(MemDB())
+        for h in range(1, 11):
+            s.save_light_block(chain.blocks[h])
+        s.prune(3)
+        assert s.size() == 3
+        assert s.first_light_block().height == 8
+        assert s.latest_light_block().height == 10
+        s.prune(5)  # pruning to a LARGER size is a no-op
+        assert s.size() == 3
+
+    def test_prune_by_trust_period(self, chain):
+        """prune_expired drops exactly the headers whose trusting period
+        lapsed: with now pinned just past block 5's expiry, blocks 1-5 go
+        and 6+ stay (header times ascend 1s per height)."""
+        s = LightStore(MemDB())
+        for h in range(1, 11):
+            s.save_light_block(chain.blocks[h])
+        period_ns = 10 * 1_000_000_000  # 10s
+        t5 = chain.blocks[5].time
+        now = cmttime.Timestamp(t5.seconds + 10, 1)  # 1ns past expiry of 5
+        assert s.prune_expired(period_ns, now) == 5
+        assert s.size() == 5
+        assert s.first_light_block().height == 6
+        # a second sweep at the same instant prunes nothing
+        assert s.prune_expired(period_ns, now) == 0
+
+    def test_prune_expired_all_and_none(self, chain):
+        s = LightStore(MemDB())
+        for h in (1, 2, 3):
+            s.save_light_block(chain.blocks[h])
+        # everything still fresh under a huge period
+        assert s.prune_expired(10 ** 18, cmttime.now()) == 0
+        # everything expired under a 1ns period
+        assert s.prune_expired(1, cmttime.now()) == 3
+        assert s.size() == 0
+
+
+class TestPersistence:
+    def test_restart_reloads_heights_and_blocks(self, chain, tmp_path):
+        """The store's height index is rebuilt from the DB on restart:
+        everything saved before the 'crash' is retrievable after, with
+        identical bytes, and pruning state carries over."""
+        path = str(tmp_path / "light.db")
+        db = SQLiteDB(path)
+        s = LightStore(db)
+        for h in (2, 7, 13, 19):
+            s.save_light_block(chain.blocks[h])
+        s.prune(3)  # drops height 2
+        db.close()
+
+        db2 = SQLiteDB(path)
+        s2 = LightStore(db2)
+        assert s2.size() == 3
+        assert s2.first_light_block().height == 7
+        assert s2.latest_light_block().height == 19
+        assert s2.light_block(2) is None
+        got = s2.light_block(13)
+        assert got.to_proto() == chain.blocks[13].to_proto()
+        assert s2.light_block_before(19).height == 13
+        # writes keep working against the reloaded index
+        s2.save_light_block(chain.blocks[20])
+        assert s2.latest_light_block().height == 20
+        db2.close()

@@ -84,6 +84,12 @@ TRACKED: dict[str, tuple[str, float]] = {
     "mesh.scaling_x8": (HIGHER, 30.0),
     "mega_commit_sigs_per_s": (HIGHER, 40.0),
     "mesh.mega_commit_sigs_per_s": (HIGHER, 40.0),
+    # light-client fleet serving plane (bench_light_fleet): amortized
+    # per-client cost of the 10k-client soak — the millions-of-users
+    # headline. Wide threshold: the soak runs on a shared host, but the
+    # amortization (coalescing + cache) is a code property and an
+    # order-of-magnitude regression means the serving plane broke.
+    "lc_amortized_ms": (LOWER, 50.0),
 }
 
 # informational-by-design (wire/tunnel-bound): listed so the verdict can
@@ -97,6 +103,15 @@ WIRE_BOUND = {
     "blocksync_device_busy_fraction", "p50_batch_latency_ms",
     "mixed_megacommit_ms", "mixed_colocated_estimate_ms",
     "lc_bisection_s", "lc_client_s", "consensus_tpu_height_p50_ms",
+}
+
+# informational-by-design for OTHER reasons than tunnel contention —
+# same contract as WIRE_BOUND (reported with a why, never enforced)
+INFORMATIONAL = {
+    "lc_cache_hit_rate": "workload-mix property (request distribution), "
+                         "not a code property — tracked for trend only",
+    "fleet.p99_heal_ms": "post-outage recovery latency: depends on the "
+                         "injected outage shape and host contention",
 }
 
 
@@ -270,6 +285,8 @@ def compare(old_record: dict, new_record: dict,
                 if name in WIRE_BOUND:
                     row["why_info"] = "wire-bound: swings with tunnel " \
                                       "contention, not code"
+                elif name in INFORMATIONAL:
+                    row["why_info"] = INFORMATIONAL[name]
             else:
                 direction, threshold = spec
                 threshold *= threshold_scale
