@@ -1072,6 +1072,144 @@ def bench_mesh(detail: dict) -> None:
     detail["mesh"] = record["detail"]
 
 
+def bench_fleet(detail: dict) -> None:
+    """Fleet-size curves over REAL OS-process testnets (ISSUE 12): for
+    each size in BENCH_FLEET_SIZES (default "4,16"; the acceptance curve
+    adds 50), boot a regional topology with WAN cross-region links, soak,
+    and report per size:
+
+      heights_per_s                    committed heights per wall second
+      wire_bytes_per_height_per_node   p2p send bytes per height per node
+      gossip_votes_per_vote_needed     vote amplification (lower = the
+                                       reconciliation plane is working)
+      partition_heal_p99_ms            worst partition-heal latency over
+                                       BENCH_FLEET_HEAL_CYCLES cycles
+
+    The largest size's amplification + heal numbers are lifted to the
+    record top level under the sentinel's names. Env knobs:
+    BENCH_FLEET=0 skips, BENCH_FLEET_SIZES, BENCH_FLEET_SOAK_S,
+    BENCH_FLEET_HEAL_CYCLES, BENCH_FLEET_BASE_PORT."""
+    if os.environ.get("BENCH_FLEET", "1") == "0":
+        detail["fleet"] = "skipped: BENCH_FLEET=0"
+        return
+    import tempfile
+    import urllib.parse
+
+    from cometbft_tpu.e2e import runner as R
+    from cometbft_tpu.e2e.generator import generate_fleet_manifest
+
+    sizes = [int(s) for s in
+             os.environ.get("BENCH_FLEET_SIZES", "4,16").split(",")
+             if s.strip()]
+    heal_cycles = int(os.environ.get("BENCH_FLEET_HEAL_CYCLES", "2"))
+    soak_s = float(os.environ.get("BENCH_FLEET_SOAK_S", "12"))
+    # port spans must stay BELOW the kernel ephemeral range (the guard
+    # enforces it for big sizes; this container's range starts at
+    # 16000): stride 2100 covers the p2p/rpc/abci port strides
+    base_port = int(os.environ.get("BENCH_FLEET_BASE_PORT", "8000"))
+    curve: dict = {}
+    for n in sizes:
+        R._resource_guard(n, base_port)
+        regions = 2 if n < 8 else 4
+        m = generate_fleet_manifest(n, topology="regional", regions=regions,
+                                    link_profile="wan",
+                                    name=f"bench-fleet-{n}")
+        d = tempfile.mkdtemp(prefix=f"bench-fleet-{n}-")
+        net = R.setup(m, d, base_port)
+        base_port += 2100
+        names = sorted(m.nodes)
+        row: dict = {}
+        try:
+            net.app_procs = [None] * n
+            R._boot_staggered(net)
+            R._wait(lambda: all(R._height(net, i) >= 3 for i in range(n)),
+                    150 + 4 * n, f"{n}-node bench fleet booting")
+
+            def _tele():
+                return [R._rpc(net, i, "net_telemetry", timeout=10.0)
+                        .get("result", {}) for i in range(n)]
+
+            _progress(f"fleet {n}: soaking {soak_s:.0f}s")
+            h0 = max(R._height(net, i) for i in range(n))
+            tele0 = _tele()
+            t0 = time.perf_counter()
+            time.sleep(soak_s)
+            h1 = max(R._height(net, i) for i in range(n))
+            dt = time.perf_counter() - t0
+            tele1 = _tele()
+            dh = max(1, h1 - h0)
+            send = (sum(t.get("totals", {}).get("send_bytes", 0)
+                        for t in tele1)
+                    - sum(t.get("totals", {}).get("send_bytes", 0)
+                          for t in tele0))
+            row["heights_per_s"] = round((h1 - h0) / dt, 3)
+            row["wire_bytes_per_height_per_node"] = round(send / dh / n, 1)
+            g: dict = {}
+            for t in tele1:
+                for k, v in ((t.get("gossip") or {})
+                             .get("totals") or {}).items():
+                    g[k] = g.get(k, 0) + v
+            needed = g.get("votes_recv_needed", 0)
+            row["gossip_votes_per_vote_needed"] = (
+                round(g.get("votes_recv", 0) / needed, 3) if needed
+                else None)
+            row["gossip_totals"] = g
+
+            # partition/heal cycles: region 0 vs. the rest
+            _progress(f"fleet {n}: {heal_cycles} partition-heal cycles")
+            ids = R._node_ids(net)
+            regs = [m.nodes[nm].region for nm in names]
+            cut = [i for i in range(n) if regs[i] == 0]
+            spec = ("partition=" + ".".join(ids[i] for i in cut) + "|"
+                    + ".".join(ids[i] for i in range(n) if regs[i] != 0))
+            arg = urllib.parse.quote(f'"{spec}"')
+            heals = []
+
+            def _heal_gauges():
+                return [R._metric_value(
+                    R._metrics_text(net, j),
+                    "cometbft_p2p_partition_heal_seconds")
+                    for j in range(n)]
+
+            for _ in range(heal_cycles):
+                # the heal gauge PERSISTS per node across cycles, so each
+                # cycle's sample is the max over gauges that CHANGED from
+                # their pre-cycle value — never a stale max from an
+                # earlier cycle
+                pre = _heal_gauges()
+                for j in range(n):
+                    R._rpc(net, j, f"unsafe_net_chaos?spec={arg}",
+                           timeout=10.0)
+                time.sleep(2.0)
+                hq = max(R._height(net, i) for i in range(n))
+                for j in range(n):
+                    R._rpc(net, j, "unsafe_net_chaos?heal=true",
+                           timeout=10.0)
+                R._wait(lambda: min(R._height(net, i) for i in range(n))
+                        >= hq + 1, 120 + 2 * n, "post-heal catch-up")
+                post = _heal_gauges()
+                changed = [v for v, p in zip(post, pre) if v != p]
+                if changed:
+                    heals.append(round(max(changed) * 1e3, 1))
+            heals.sort()
+            row["heal_samples_ms"] = heals
+            row["partition_heal_p99_ms"] = heals[-1] if heals else None
+        finally:
+            for p in net.node_procs:
+                R._kill(p)
+        curve[str(n)] = row
+    detail["fleet"] = {"sizes": sizes, "curve": curve}
+    big = str(max(sizes))
+    # sentinel names (tools/bench_compare.py): amplification is ENFORCED
+    # lower-is-better; the fleet rate + heal latency stay informational
+    # until a quiet round establishes their variance
+    detail["gossip_votes_per_vote_needed"] = \
+        curve[big].get("gossip_votes_per_vote_needed")
+    detail["partition_heal_p99_ms"] = curve[big].get("partition_heal_p99_ms")
+    if "50" in curve:
+        detail["fleet_heights_per_s_50node"] = curve["50"]["heights_per_s"]
+
+
 def bench_scheduler(detail: dict) -> None:
     """Global verify scheduler under a mixed offered load (ISSUE 4
     acceptance): a 4-validator in-process net committing with batched
@@ -1429,7 +1567,7 @@ def main() -> dict:
     # -- subsystem benches (each guarded: a failure reports, not aborts)
     for fn in (bench_blocksync, bench_mixed_megacommit, bench_attribution,
                bench_light_client, bench_light_fleet, bench_consensus_tpu,
-               bench_scheduler, bench_mesh):
+               bench_scheduler, bench_mesh, bench_fleet):
         try:
             _progress(fn.__name__)
             fn(detail)
@@ -1492,6 +1630,9 @@ def _cli() -> int:
     p.add_argument("--mesh", action="store_true",
                    help="run ONLY the multi-chip mesh scenario (subprocess "
                         "on forced host devices) and print its record")
+    p.add_argument("--fleet", action="store_true",
+                   help="run ONLY the fleet-size-curve scenario (OS-process "
+                        "testnets at BENCH_FLEET_SIZES) and print its record")
     p.add_argument("--mesh-child", action="store_true",
                    help="internal: the in-process mesh scenario (must run "
                         "under JAX_PLATFORMS=cpu with forced host devices)")
@@ -1503,6 +1644,22 @@ def _cli() -> int:
         return 0
     if args.mesh:
         record = run_mesh_bench(int(os.environ.get("BENCH_MESH_DEVICES", "8")))
+        print(json.dumps(record))
+        if args.out:
+            _write_out(record, args.out)
+        return 0
+    if args.fleet:
+        detail: dict = {}
+        bench_fleet(detail)
+        # no top-level "value": the sentinel's generic value entry is
+        # higher-better (the main bench's sigs/s headline) — this
+        # record's headline, amplification, is LOWER-better and lives
+        # under its own correctly-directioned TRACKED name
+        record = {"metric": "fleet_testnet_curves",
+                  "value": None,
+                  "unit": "see detail.gossip_votes_per_vote_needed "
+                          "(amplification; lower is better) + fleet curve",
+                  "detail": detail}
         print(json.dumps(record))
         if args.out:
             _write_out(record, args.out)

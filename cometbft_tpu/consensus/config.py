@@ -31,6 +31,17 @@ class ConsensusConfig:
     # flush a staged batch once it reaches this many votes (flushes also
     # happen at speculative quorum boundaries and on timeouts)
     vote_batch_flush_size: int = 128
+    # compact vote-set reconciliation (consensus/reactor.py RECON channel):
+    # periodically send peers one VoteSummary frame (both vote bitmaps for
+    # the current height/round) so per-vote HasVote announcements lost to
+    # drops/full queues/churn are repaired in bulk and peers stop sending
+    # votes we already have. Negotiated per peer (a peer that never
+    # advertises the channel just gets classic full gossip) and checksum-
+    # guarded (a corrupt summary is ignored and counted, never applied).
+    gossip_vote_summaries: bool = True
+    # summary send cadence per peer; summaries are skipped while the vote
+    # view is unchanged, so a short interval costs little on a quiet net
+    vote_summary_interval: float = 0.5
     # TEST/E2E ONLY: run this validator adversarially (consensus/byzantine.py
     # behaviors: equivocation | amnesia | silence | flood). The node swaps
     # its privval for an unguarded signer — never set this in production.
@@ -60,4 +71,5 @@ def test_consensus_config() -> ConsensusConfig:
         skip_timeout_commit=True,
         peer_gossip_sleep_duration=0.005,
         peer_query_maj23_sleep_duration=0.25,
+        vote_summary_interval=0.02,
     )

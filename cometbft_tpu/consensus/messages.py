@@ -87,3 +87,22 @@ class VoteSetBitsMessage:
     type_: SignedMsgType
     block_id: BlockID = field(default_factory=BlockID)
     votes: BitArray | None = None
+
+
+@dataclass
+class VoteSummaryMessage:
+    """Compact vote-set reconciliation (no reference analog): one frame
+    carrying BOTH vote-presence bitmaps for (height, round) — the batch
+    form of per-vote HasVote announcements, so a peer whose HasVotes were
+    lost (drops, full queues, churn) re-learns our whole vote view in one
+    message and stops re-sending votes we already have. Rides its own
+    channel (reactor.RECON_CHANNEL) so nodes that never negotiated it
+    simply never see it, and carries an end-to-end checksum so a
+    corrupted summary degrades to plain full gossip instead of poisoning
+    the peer's bookkeeping."""
+
+    height: int
+    round_: int
+    prevotes: BitArray | None = None
+    precommits: BitArray | None = None
+    checksum: int = 0

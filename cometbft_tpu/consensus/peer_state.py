@@ -46,6 +46,25 @@ class PeerState:
     def __init__(self, peer_id: str):
         self.peer_id = peer_id
         self.prs = PeerRoundState()
+        # gossip accounting (fleet dimension): what this peer link cost.
+        # votes_sent = votes our gossip routines pushed at the peer;
+        # votes_recv_* = receiver-side classification of the peer's sends
+        # (needed / already_had / stale); summaries_* = the reconciliation
+        # plane. Rolled up by ConsensusReactor.gossip_accounting() into
+        # net_telemetry — bounded by live peers, no metric labels.
+        self.gossip: dict[str, int] = {
+            "votes_sent": 0,
+            "votes_recv": 0, "votes_recv_needed": 0,
+            "votes_recv_already_had": 0, "votes_recv_stale": 0,
+            "summaries_sent": 0, "summaries_applied": 0,
+            "summaries_degraded": 0,
+        }
+        # set once when the peer turns out not to speak the RECON channel
+        self.summary_unsupported = False
+        # last summary signature sent, so an unchanged vote view is not
+        # re-sent every interval: (height, round, prevote bytes, precommit
+        # bytes)
+        self.last_summary_sent: tuple | None = None
 
     # -------------------------------------------------------------- queries
 
@@ -236,6 +255,48 @@ class PeerState:
         if self.prs.height != msg.height:
             return
         self.set_has_vote(msg.height, msg.round_, msg.type_, msg.index)
+
+    def apply_vote_summary(self, msg: M.VoteSummaryMessage,
+                           expected_size: int | None = None) -> str:
+        """Compact vote-set reconciliation: merge the peer's whole vote
+        view for (height, round) into its bit arrays in ONE step — the
+        batch form of apply_has_vote. Returns "applied", "stale" (the
+        summary is for a height/round we no longer track for this peer —
+        ignored, not an error), or "shape" (bit sizes disagree with the
+        arrays we track or with `expected_size`, the caller's validator
+        count — degraded, ignored). Merging is a monotonic in-place OR:
+        a reordered older summary can never erase has-vote knowledge,
+        and aliases (catchup_commit may be the same object as
+        precommits) stay consistent.
+
+        `expected_size` guards the None-array window right after a round
+        change: without it a peer could install an arbitrary-size bitmap
+        (the crc32 is integrity, not authentication) that poisons this
+        peer's bookkeeping for the whole height — later correct-size
+        summaries would read as shape mismatches and set_has_vote would
+        silently drop out-of-range indices."""
+        prs = self.prs
+        if prs.height != msg.height or prs.round_ != msg.round_:
+            return "stale"
+        pairs = [(bits, attr) for bits, attr in
+                 ((msg.prevotes, "prevotes"), (msg.precommits, "precommits"))
+                 if bits is not None]
+        # validate every shape BEFORE mutating anything: a half-applied
+        # summary would be a new corruption mode of its own
+        for bits, attr in pairs:
+            if expected_size is not None and bits.size() != expected_size:
+                return "shape"
+            cur = getattr(prs, attr)
+            if cur is not None and cur.size() != bits.size():
+                return "shape"
+        for bits, attr in pairs:
+            cur = getattr(prs, attr)
+            if cur is None:
+                setattr(prs, attr, bits.copy())
+            else:
+                cur.or_update(bits)
+        self.gossip["summaries_applied"] += 1
+        return "applied"
 
     def apply_vote_set_bits(self, msg: M.VoteSetBitsMessage, our_votes: BitArray | None) -> None:
         """reactor.go:1412: if we know our votes for that block id, the

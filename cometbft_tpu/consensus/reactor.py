@@ -36,6 +36,13 @@ STATE_CHANNEL = 0x20
 DATA_CHANNEL = 0x21
 VOTE_CHANNEL = 0x22
 VOTE_SET_BITS_CHANNEL = 0x23
+# compact vote-set reconciliation (framework extension, no reference
+# analog): VoteSummary frames ride their OWN channel so support is
+# negotiated in the p2p handshake's channel list — a peer that never
+# advertises 0x24 simply never receives a summary and gets classic full
+# gossip (the mixed-fleet degradation path; an unknown frame would
+# otherwise cost the peer its connection)
+RECON_CHANNEL = 0x24
 
 PEER_STATE_KEY = "consensus.peer_state"
 
@@ -84,14 +91,19 @@ class ConsensusReactor(Reactor):
     # ------------------------------------------------------------- channels
 
     def get_channels(self) -> list[ChannelDescriptor]:
-        """reactor.go:154-192."""
-        return [
+        """reactor.go:154-192 (+ the negotiated reconciliation channel)."""
+        chans = [
             ChannelDescriptor(id=STATE_CHANNEL, priority=6, send_queue_capacity=64),
             ChannelDescriptor(id=DATA_CHANNEL, priority=10, send_queue_capacity=64,
                               recv_message_capacity=1 << 22),
             ChannelDescriptor(id=VOTE_CHANNEL, priority=7, send_queue_capacity=256),
             ChannelDescriptor(id=VOTE_SET_BITS_CHANNEL, priority=1, send_queue_capacity=8),
         ]
+        if getattr(self.cs.config, "gossip_vote_summaries", False):
+            # advertising the channel IS the capability announcement
+            chans.append(ChannelDescriptor(
+                id=RECON_CHANNEL, priority=2, send_queue_capacity=16))
+        return chans
 
     # ------------------------------------------------------------ lifecycle
 
@@ -184,6 +196,9 @@ class ConsensusReactor(Reactor):
             loop.create_task(self._gossip_votes_routine(peer, ps)),
             loop.create_task(self._query_maj23_routine(peer, ps)),
         ]
+        if getattr(self.cs.config, "gossip_vote_summaries", False):
+            tasks.append(
+                loop.create_task(self._gossip_summary_routine(peer, ps)))
         self._peer_tasks[peer] = tasks
         if not self.wait_sync:
             peer.try_send(
@@ -198,11 +213,18 @@ class ConsensusReactor(Reactor):
 
     async def receive(self, e: Envelope) -> None:
         """reactor.go:241-385."""
-        msg = codec.decode(e.message)
         peer = e.src
         ps: PeerState = peer.get(PEER_STATE_KEY)
         if ps is None:
             return
+        if e.channel_id == RECON_CHANNEL:
+            # the reconciliation channel is advisory: any malformed frame
+            # (codec mismatch, truncation, checksum failure) is COUNTED
+            # and ignored — full gossip continues untouched, never a
+            # liveness loss and never a banned peer
+            self._receive_vote_summary(e.message, ps)
+            return
+        msg = codec.decode(e.message)
         rs = self.cs.rs
 
         if e.channel_id == STATE_CHANNEL:
@@ -244,6 +266,7 @@ class ConsensusReactor(Reactor):
                 last_size = rs.last_commit.size() if rs.last_commit is not None else 0
                 ps.ensure_vote_bit_arrays(height, valsize)
                 ps.ensure_vote_bit_arrays(height - 1, last_size)
+                self._account_vote_received(ps, rs, msg.vote)
                 ps.set_has_vote(
                     msg.vote.height, msg.vote.round_, msg.vote.type_,
                     msg.vote.validator_index,
@@ -268,6 +291,178 @@ class ConsensusReactor(Reactor):
                 ps.apply_vote_set_bits(msg, our_votes)
             else:
                 raise ValueError(f"unexpected message on vote-set-bits channel: {type(msg)}")
+
+    # ------------------------------------------------- gossip accounting
+
+    def _gossip_metric(self, name: str, *labels) -> None:
+        m = getattr(self.cs, "metrics", None)
+        if m is None:
+            return
+        counter = getattr(m, name, None)
+        if counter is None:
+            return
+        if labels:
+            counter.labels(*labels).inc()
+        else:
+            counter.inc()
+
+    def _account_vote_received(self, ps: PeerState, rs, vote) -> None:
+        """Receiver-side gossip accounting: did we NEED this vote?
+        needed = it can still advance our view; already_had = the
+        matching vote set already holds this validator's vote (the peer
+        wasted a send); stale = for a height we committed past. The
+        ratio of received to needed IS the vote-amplification number the
+        fleet metrics grade."""
+        status = "needed"
+        if vote.height == rs.height:
+            vs = None
+            if rs.votes is not None:
+                vs = (rs.votes.prevotes(vote.round_)
+                      if vote.type_ == SignedMsgType.PREVOTE
+                      else rs.votes.precommits(vote.round_))
+            # get_by_index, not bit_array(): the latter copies the whole
+            # array per received vote on the hottest p2p path. Bounds
+            # guarded here — a malformed index is add_vote's problem to
+            # reject, not classification's to crash on (raw list
+            # indexing would wrap negatives and raise past the end)
+            idx = vote.validator_index
+            if (vs is not None and 0 <= idx < vs.size()
+                    and vs.get_by_index(idx) is not None):
+                status = "already_had"
+        elif vote.height == rs.height - 1:
+            lc = rs.last_commit
+            idx = vote.validator_index
+            if (lc is not None and vote.type_ == SignedMsgType.PRECOMMIT
+                    and vote.round_ == lc.round_ and 0 <= idx < lc.size()
+                    and lc.get_by_index(idx) is not None):
+                status = "already_had"
+        elif vote.height < rs.height - 1:
+            status = "stale"
+        g = ps.gossip
+        g["votes_recv"] += 1
+        g[f"votes_recv_{status}"] += 1
+        self._gossip_metric("gossip_votes_received", status)
+
+    def gossip_accounting(self) -> dict:
+        """The vote-amplification rollup net_telemetry serves: per-peer
+        sent/received/needed splits (bounded by live peers) plus totals
+        and the headline `votes_per_vote_needed` ratio — received votes
+        per vote that actually advanced this node's view (1.0 = perfect
+        reconciliation; the gap above 1.0 is pure amplification)."""
+        per_peer: dict[str, dict] = {}
+        totals = {"votes_sent": 0, "votes_recv": 0, "votes_recv_needed": 0,
+                  "votes_recv_already_had": 0, "votes_recv_stale": 0,
+                  "summaries_sent": 0, "summaries_applied": 0,
+                  "summaries_degraded": 0}
+        sw = self.switch
+        peers = list(sw.peers.values()) if sw is not None else []
+        for peer in peers:
+            ps = peer.get(PEER_STATE_KEY)
+            if ps is None:
+                continue
+            row = dict(ps.gossip)
+            row["summary_unsupported"] = ps.summary_unsupported
+            per_peer[peer.id[:10]] = row
+            for k in totals:
+                totals[k] += ps.gossip.get(k, 0)
+        needed = totals["votes_recv_needed"]
+        return {
+            "per_peer": per_peer,
+            "totals": totals,
+            "votes_per_vote_needed": (
+                round(totals["votes_recv"] / needed, 3) if needed else None),
+        }
+
+    # ------------------------------------------- vote-set reconciliation
+
+    def _receive_vote_summary(self, raw: bytes, ps: PeerState) -> None:
+        """Apply one reconciliation frame with the full degradation
+        ladder: codec error -> degraded_codec, wrong message type ->
+        degraded_codec, checksum mismatch -> degraded_checksum, bit-size
+        disagreement -> degraded_shape; stale summaries are ignored
+        silently. Degradation NEVER raises — the worst outcome of a bad
+        summary is the full gossip we already run."""
+        try:
+            msg = codec.decode(raw)
+        except Exception:  # noqa: BLE001 - corrupt frame, count and drop
+            ps.gossip["summaries_degraded"] += 1
+            self._gossip_metric("gossip_summaries", "degraded_codec")
+            return
+        if not isinstance(msg, M.VoteSummaryMessage):
+            ps.gossip["summaries_degraded"] += 1
+            self._gossip_metric("gossip_summaries", "degraded_codec")
+            return
+        want = codec.vote_summary_checksum(
+            msg.height, msg.round_, msg.prevotes, msg.precommits)
+        if msg.checksum != want:
+            ps.gossip["summaries_degraded"] += 1
+            self._gossip_metric("gossip_summaries", "degraded_checksum")
+            return
+        # when the summary is for OUR height we know the validator count
+        # and pin the bitmap size to it (crc32 is integrity, not
+        # authentication — a forged size must not install); for other
+        # heights the peer's existing arrays gate the shape
+        rs = self.cs.rs
+        expected = (len(rs.validators)
+                    if msg.height == rs.height and rs.validators else None)
+        verdict = ps.apply_vote_summary(msg, expected_size=expected)
+        if verdict == "applied":
+            self._gossip_metric("gossip_summaries", "applied")
+        elif verdict == "shape":
+            ps.gossip["summaries_degraded"] += 1
+            self._gossip_metric("gossip_summaries", "degraded_shape")
+
+    async def _gossip_summary_routine(self, peer, ps: PeerState) -> None:
+        """Periodically push one VoteSummary frame at the peer: both vote
+        bitmaps for our current (height, round). Skips resends while the
+        view is unchanged. A peer that never advertised RECON_CHANNEL is
+        detected once and the routine exits — that peer runs on classic
+        full gossip (the mixed-fleet path)."""
+        interval = getattr(self.cs.config, "vote_summary_interval", 0.5)
+        if RECON_CHANNEL not in (peer.node_info.channels or b""):
+            ps.summary_unsupported = True
+            self._gossip_metric("gossip_summaries", "peer_unsupported")
+            return
+        try:
+            while peer.is_running:
+                # send-first, THEN sleep: a freshly (re)connected peer —
+                # a churn storm makes many — learns our whole vote view
+                # in its first gossip exchange instead of re-sending us
+                # ~2 vote sets during the first interval
+                if self.wait_sync:
+                    await asyncio.sleep(interval)
+                    continue
+                rs = self.cs.rs
+                if rs.votes is None:
+                    await asyncio.sleep(interval)
+                    continue
+                pv = rs.votes.prevotes(rs.round_)
+                pc = rs.votes.precommits(rs.round_)
+                if pv is None and pc is None:
+                    await asyncio.sleep(interval)
+                    continue
+                pv_bits = pv.bit_array() if pv is not None else None
+                pc_bits = pc.bit_array() if pc is not None else None
+                sig = (rs.height, rs.round_,
+                       pv_bits.to_bytes() if pv_bits is not None else b"",
+                       pc_bits.to_bytes() if pc_bits is not None else b"")
+                if sig != ps.last_summary_sent:
+                    msg = M.VoteSummaryMessage(
+                        height=rs.height, round_=rs.round_,
+                        prevotes=pv_bits, precommits=pc_bits,
+                        checksum=codec.vote_summary_checksum(
+                            rs.height, rs.round_, pv_bits, pc_bits),
+                    )
+                    if peer.try_send(RECON_CHANNEL, codec.encode(msg)):
+                        ps.last_summary_sent = sig
+                        ps.gossip["summaries_sent"] += 1
+                        self._gossip_metric("gossip_summaries", "sent")
+                await asyncio.sleep(interval)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 - reconciliation is advisory
+            self.logger.error("gossip_summary routine failed",
+                              peer=peer.id[:10], err=str(e))
 
     async def _handle_vote_set_maj23(self, peer, ps: PeerState, msg: M.VoteSetMaj23Message) -> None:
         """reactor.go:316-361: record the peer's +2/3 claim, answer with our
@@ -476,6 +671,8 @@ class ConsensusReactor(Reactor):
         sent = await peer.send(VOTE_CHANNEL, codec.encode(M.VoteMessage(vote=vote)))
         if sent:
             ps.set_has_vote(vote.height, vote.round_, vote.type_, vote.validator_index)
+            ps.gossip["votes_sent"] += 1
+            self._gossip_metric("gossip_votes_sent")
         return sent
 
     async def _query_maj23_routine(self, peer, ps: PeerState) -> None:

@@ -6,11 +6,15 @@ envelope with a oneof keyed by field number:
 
   1 NewRoundStep  2 NewValidBlock  3 Proposal  4 ProposalPOL  5 BlockPart
   6 Vote          7 HasVote        8 VoteSetMaj23  9 VoteSetBits
+  10 VoteSummary (framework extension: compact vote-set reconciliation,
+     only ever sent on the negotiated RECON channel)
 
 BitArrays ride as {1: bits varint, 2: packed little-endian bytes}.
 """
 
 from __future__ import annotations
+
+import zlib
 
 from cometbft_tpu.consensus import messages as M
 from cometbft_tpu.libs.bits import BitArray
@@ -39,6 +43,23 @@ def _read_bits(r: Reader) -> BitArray:
         else:
             br.skip(w)
     return BitArray.from_bytes(bits, data)
+
+
+def vote_summary_checksum(height: int, round_: int,
+                          prevotes: BitArray | None,
+                          precommits: BitArray | None) -> int:
+    """End-to-end integrity word for a VoteSummaryMessage: crc32 over the
+    canonical payload. Transport framing already checks lengths; this
+    catches a summary whose BITS were corrupted in flight or by a buggy
+    peer — an invalid summary must degrade to full gossip, never update
+    the peer's vote bookkeeping."""
+    pv = prevotes.to_bytes() if prevotes is not None else b""
+    pc = precommits.to_bytes() if precommits is not None else b""
+    body = b"%d|%d|%d|%d|" % (
+        height, round_,
+        prevotes.size() if prevotes is not None else -1,
+        precommits.size() if precommits is not None else -1) + pv + b"|" + pc
+    return zlib.crc32(body) & 0xFFFFFFFF
 
 
 def encode(msg) -> bytes:
@@ -114,6 +135,17 @@ def encode(msg) -> bytes:
             .output()
         )
         w.message(9, inner, always=True)
+    elif isinstance(msg, M.VoteSummaryMessage):
+        inner = (
+            Writer()
+            .varint_i64(1, msg.height)
+            .varint_i64(2, msg.round_)
+            .message(3, _bits_bytes(msg.prevotes))
+            .message(4, _bits_bytes(msg.precommits))
+            .uvarint(5, msg.checksum)
+            .output()
+        )
+        w.message(10, inner, always=True)
     else:
         raise TypeError(f"cannot encode consensus message {type(msg)}")
     return w.output()
@@ -254,6 +286,24 @@ def decode(data: bytes):
                 msg.block_id = BlockID.from_proto(mr.read_bytes())
             elif mf == 5:
                 msg.votes = _read_bits(mr)
+            else:
+                mr.skip(mw)
+        return msg
+    if f == 10:
+        mr = r.read_message()
+        msg = M.VoteSummaryMessage(height=0, round_=0)
+        while not mr.at_end():
+            mf, mw = mr.read_tag()
+            if mf == 1:
+                msg.height = mr.read_varint_i64()
+            elif mf == 2:
+                msg.round_ = mr.read_varint_i64()
+            elif mf == 3:
+                msg.prevotes = _read_bits(mr)
+            elif mf == 4:
+                msg.precommits = _read_bits(mr)
+            elif mf == 5:
+                msg.checksum = mr.read_uvarint()
             else:
                 mr.skip(mw)
         return msg

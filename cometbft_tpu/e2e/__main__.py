@@ -33,6 +33,25 @@ def main(argv=None) -> int:
     c.add_argument("--count", type=int, default=5)
     c.add_argument("--base-port", type=int, default=29000)
 
+    f = sub.add_parser(
+        "fleet", help="run one deliberate fleet-scale testnet "
+                      "(50-100 nodes; gated by the resource guard)")
+    f.add_argument("--nodes", type=int, default=50)
+    f.add_argument("--topology", default="regional",
+                   choices=("full", "hub", "regional"))
+    f.add_argument("--regions", type=int, default=4)
+    f.add_argument("--link-profile", default=None,
+                   choices=("", "wan", "lossy-wan"),
+                   help="cross-region link profile (default: wan for the "
+                        "regional topology, clean wire otherwise)")
+    f.add_argument("--perturb", default="regional-partition:1,churn-storm:30",
+                   help="comma-separated net perturbations ('' for none)")
+    f.add_argument("--no-summaries", action="store_true",
+                   help="full-gossip control arm (reconciliation off)")
+    f.add_argument("--dir", default="")
+    # span must end below the kernel ephemeral range (guard enforces)
+    f.add_argument("--base-port", type=int, default=10000)
+
     ns = p.parse_args(argv)
     if ns.cmd == "generate":
         os.makedirs(ns.dir, exist_ok=True)
@@ -51,6 +70,27 @@ def main(argv=None) -> int:
         except RunError as e:
             print(f"FAIL {m.name}: {e}", file=sys.stderr)
             return 1
+        return 0
+    if ns.cmd == "fleet":
+        from cometbft_tpu.e2e.generator import generate_fleet_manifest
+
+        profile = ns.link_profile
+        if profile is None:
+            profile = "wan" if ns.topology == "regional" else ""
+        m = generate_fleet_manifest(
+            ns.nodes, topology=ns.topology, regions=ns.regions,
+            link_profile=profile,
+            net_perturb=tuple(x for x in ns.perturb.split(",") if x),
+            vote_summaries=not ns.no_summaries)
+        out = ns.dir or tempfile.mkdtemp(prefix=f"e2e-{m.name}-")
+        try:
+            run_manifest(m, out, base_port=ns.base_port)
+        except RunError as e:
+            print(f"FAIL {m.name}: {e}", file=sys.stderr)
+            print(f"(forensics: {os.path.join(out, 'net_report.json')})",
+                  file=sys.stderr)
+            return 1
+        print(f"fleet report: {os.path.join(out, 'net_report.json')}")
         return 0
     # ci
     failures = 0

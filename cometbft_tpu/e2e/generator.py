@@ -55,12 +55,24 @@ def generate_manifest(rng: random.Random, index: int) -> Manifest:
             initial_height + rng.choice(VOTE_EXT_HEIGHT_OFFSETS)
             if rng.random() < 0.5 else 0),
     )
+    # a slice of the matrix runs the reconciliation-off control arm
+    if rng.random() < 0.15:
+        m.vote_summaries = False
+    # occasionally wire the quad as a small regional net (2 regions, wan
+    # cross-links): the matrix keeps the fleet plumbing honest at a size
+    # CI can afford — the 50-100 node shapes are deliberate
+    # (generate_fleet_manifest), not rolled
+    if n == 4 and rng.random() < 0.15:
+        m.topology = "regional"
+        m.regions = 2
+        m.link_profile = rng.choice(("wan", "lossy-wan"))
     for i in range(n):
         node = NodeManifest(
             database=rng.choice(DATABASES),
             abci_protocol=rng.choice(ABCI_PROTOCOLS),
             persist_interval=rng.choice((0, 1, 5)),
             retain_blocks=rng.choice((0, 20)),
+            region=(i % 2 if m.topology == "regional" else 0),
         )
         if n >= 4:  # perturbing a 1-node net just halts it
             for p, prob in PERTURBATIONS.items():
@@ -95,3 +107,57 @@ def generate_manifest(rng: random.Random, index: int) -> Manifest:
 def generate_manifests(seed: int, count: int) -> list[Manifest]:
     rng = random.Random(seed)
     return [generate_manifest(rng, i) for i in range(count)]
+
+
+# ------------------------------------------------------------- fleets
+# Deterministic fleet-scale manifests (50-100 node nets are booted on
+# purpose by tests/bench, not rolled from the random matrix — a 100-node
+# net is a deliberate resource commitment, runner._resource_guard gates
+# it). Hub/spoke and regional topologies with the intra-region-fast /
+# cross-region-slow link shape (runner.LINK_PROFILES).
+
+FLEET_TOPOLOGIES = ("full", "hub", "regional")
+
+
+def generate_fleet_manifest(
+    n_nodes: int,
+    topology: str = "regional",
+    regions: int = 4,
+    hubs: int = 4,
+    link_profile: str = "",
+    net_perturb: tuple[str, ...] = (),
+    target_height_delta: int = 4,
+    name: str = "",
+    vote_summaries: bool = True,
+) -> Manifest:
+    """One fleet testnet: `n_nodes` sqlite+builtin validators wired by
+    `topology`, regions assigned round-robin, with the given net-level
+    perturbation schedule. memdb is excluded (churn storms respawn
+    processes) and out-of-process ABCI apps are excluded (they would
+    double the fleet's process count for no gossip-plane coverage)."""
+    if topology not in FLEET_TOPOLOGIES:
+        raise ValueError(f"unknown fleet topology {topology!r}")
+    if link_profile and topology != "regional":
+        # loudly, not silently: a clean-wire run misread as WAN-resilient
+        # is exactly the misconfiguration Manifest.validate exists for
+        raise ValueError(
+            f"link_profile {link_profile!r} requires the regional "
+            f"topology (got {topology!r})")
+    regions = regions if topology == "regional" else 1
+    m = Manifest(
+        name=name or f"fleet-{n_nodes:03d}-{topology}",
+        topology=topology,
+        regions=regions,
+        hubs=min(hubs, n_nodes),
+        link_profile=link_profile,
+        net_perturb=list(net_perturb),
+        target_height_delta=target_height_delta,
+        vote_summaries=vote_summaries,
+    )
+    for i in range(n_nodes):
+        m.nodes[f"node{i:03d}"] = NodeManifest(
+            database="sqlite", abci_protocol="builtin",
+            region=i % regions,
+        )
+    m.validate()
+    return m

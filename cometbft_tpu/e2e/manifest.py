@@ -44,6 +44,10 @@ class NodeManifest:
     # light_verify, partition the fleet node away mid-soak, and assert
     # post-heal p99 recovery via the light_fleet metrics)
     perturb: list[str] = field(default_factory=list)
+    # fleet topologies: which region this node lives in (regional/hub
+    # topologies wire peering and netchaos link profiles from this;
+    # meaningless under topology "full")
+    region: int = 0
 
     PERTURBATIONS = ("kill", "pause", "restart", "disconnect",
                      "device-kill", "device-flap",
@@ -59,6 +63,8 @@ class NodeManifest:
         return base, arg
 
     def validate(self) -> None:
+        if self.region < 0:
+            raise ValueError("node region cannot be negative")
         if self.database not in ("sqlite", "memdb"):
             raise ValueError(f"unknown database {self.database!r}")
         if self.abci_protocol not in ("builtin", "tcp", "unix", "grpc"):
@@ -89,22 +95,97 @@ class NodeManifest:
 @dataclass
 class Manifest:
     """A whole testnet (manifest.go Manifest, the options this framework
-    exercises)."""
+    exercises — grown to fleet scale: 50-100 node hub/regional
+    topologies, netchaos link profiles, and NET-level perturbations)."""
 
     name: str = "testnet"
     initial_height: int = 1
     initial_state: dict[str, str] = field(default_factory=dict)
     vote_extensions_enable_height: int = 0
     target_height_delta: int = 4  # heights every node must advance
+    # peer-wiring shape (runner.setup): "full" = every node peers with
+    # every other (the classic 4-val net); "hub" = the first `hubs` nodes
+    # form a hub mesh, spokes peer only with hubs; "regional" = full mesh
+    # within a region, region gateways (first node of each region) mesh
+    # across regions — the shape production gossip pathologies need
+    topology: str = "full"
+    regions: int = 1    # regional topology: how many regions
+    hubs: int = 2       # hub topology: how many hub nodes
+    # named netchaos link profile for CROSS-REGION links ("" = clean
+    # wire): "wan" = high-latency, "lossy-wan" = high-latency + loss.
+    # Intra-region links stay clean — the intra-fast/cross-slow shape.
+    link_profile: str = ""
+    # NET-level perturbations (runner, after per-node perturbations):
+    #   churn-storm[:pct]         rolling restarts of pct% of the fleet
+    #                             (default 30), quorum preserved per wave
+    #   regional-partition[:r]    cut region r (default 0) off, assert the
+    #                             minority stalls while the majority
+    #                             commits, heal, assert catch-up + the
+    #                             heal metric
+    #   byzantine-minority[:k]    restart k nodes (default n//3, capped to
+    #                             keep a +2/3 honest quorum) equivocating;
+    #                             honest nodes must commit evidence
+    net_perturb: list[str] = field(default_factory=list)
+    # compact vote-set reconciliation (consensus.gossip_vote_summaries)
+    # for every node: False = the full-gossip baseline, the control arm
+    # of the amplification measurement
+    vote_summaries: bool = True
     nodes: dict[str, NodeManifest] = field(default_factory=dict)
+
+    TOPOLOGIES = ("full", "hub", "regional")
+    NET_PERTURBATIONS = ("churn-storm", "regional-partition",
+                         "byzantine-minority")
+    LINK_PROFILES = ("", "wan", "lossy-wan")
 
     def validate(self) -> None:
         if not self.nodes:
             raise ValueError("manifest has no nodes")
         if self.initial_height < 1:
             raise ValueError("initial_height must be >= 1")
+        if self.topology not in self.TOPOLOGIES:
+            raise ValueError(f"unknown topology {self.topology!r} "
+                             f"(expected one of {self.TOPOLOGIES})")
+        if self.regions < 1:
+            raise ValueError("regions must be >= 1")
+        if self.topology == "hub" and not 1 <= self.hubs <= len(self.nodes):
+            raise ValueError(
+                f"hub topology needs 1 <= hubs <= nodes, got {self.hubs}")
+        if self.link_profile not in self.LINK_PROFILES:
+            raise ValueError(f"unknown link_profile {self.link_profile!r} "
+                             f"(expected one of {self.LINK_PROFILES})")
+        if self.link_profile and self.topology != "regional":
+            raise ValueError("link_profile requires the regional topology")
+        for p in self.net_perturb:
+            base, _, arg = p.partition(":")
+            if base not in self.NET_PERTURBATIONS:
+                raise ValueError(f"unknown net perturbation {p!r}")
+            if arg:
+                try:
+                    v = int(arg)
+                except ValueError:
+                    raise ValueError(
+                        f"bad net perturbation arg in {p!r}") from None
+                if v < 0:
+                    raise ValueError(f"negative arg in {p!r}")
+                if base == "churn-storm" and not 1 <= v <= 100:
+                    raise ValueError(
+                        f"churn-storm percent out of range in {p!r}")
+            if (base == "regional-partition"
+                    and (self.topology != "regional" or self.regions < 2)):
+                raise ValueError(
+                    "regional-partition needs topology=regional with "
+                    ">= 2 regions")
         for n in self.nodes.values():
             n.validate()
+            if self.topology == "regional" and not 0 <= n.region < self.regions:
+                raise ValueError(
+                    f"node region {n.region} out of range "
+                    f"(0..{self.regions - 1})")
+
+    def region_names(self) -> dict[str, int]:
+        """node name -> region index (sorted-name order, as the runner
+        sees them)."""
+        return {name: self.nodes[name].region for name in sorted(self.nodes)}
 
     # ---------------------------------------------------------- TOML
 
@@ -117,6 +198,13 @@ class Manifest:
             f"initial_height = {self.initial_height}",
             f"vote_extensions_enable_height = {self.vote_extensions_enable_height}",
             f"target_height_delta = {self.target_height_delta}",
+            f"topology = {q(self.topology)}",
+            f"regions = {self.regions}",
+            f"hubs = {self.hubs}",
+            f"link_profile = {q(self.link_profile)}",
+            "net_perturb = ["
+            + ", ".join(q(p) for p in self.net_perturb) + "]",
+            f"vote_summaries = {'true' if self.vote_summaries else 'false'}",
         ]
         if self.initial_state:
             out.append("")
@@ -133,6 +221,7 @@ class Manifest:
             out.append(f"persist_interval = {n.persist_interval}")
             out.append(f"retain_blocks = {n.retain_blocks}")
             out.append(f"fuzz = {q(n.fuzz)}")
+            out.append(f"region = {n.region}")
             out.append(
                 "perturb = [" + ", ".join(q(p) for p in n.perturb) + "]")
         return "\n".join(out) + "\n"
@@ -148,6 +237,12 @@ class Manifest:
             vote_extensions_enable_height=int(
                 doc.get("vote_extensions_enable_height", 0)),
             target_height_delta=int(doc.get("target_height_delta", 4)),
+            topology=str(doc.get("topology", "full")),
+            regions=int(doc.get("regions", 1)),
+            hubs=int(doc.get("hubs", 2)),
+            link_profile=str(doc.get("link_profile", "")),
+            net_perturb=list(doc.get("net_perturb", [])),
+            vote_summaries=bool(doc.get("vote_summaries", True)),
         )
         for name, nd in doc.get("node", {}).items():
             m.nodes[name] = NodeManifest(
@@ -158,6 +253,7 @@ class Manifest:
                 retain_blocks=int(nd.get("retain_blocks", 0)),
                 fuzz=str(nd.get("fuzz", "")),
                 perturb=list(nd.get("perturb", [])),
+                region=int(nd.get("region", 0)),
             )
         m.validate()
         return m

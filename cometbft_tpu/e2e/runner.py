@@ -28,6 +28,138 @@ class RunError(Exception):
     pass
 
 
+# ---------------------------------------------------------------- fleet
+# Named netchaos link-profile bodies (p2p/netchaos.py profile syntax) for
+# the regional topology's cross-region links: intra-region links stay
+# clean, cross-region links pay WAN latency (and, for lossy-wan, loss).
+LINK_PROFILES = {
+    "wan": "latency:0.03;jitter:0.01",
+    "lossy-wan": "latency:0.05;jitter:0.02;drop:0.005",
+}
+
+# resource-guard knobs (env-overridable; the error message names them):
+# estimated per-node cost of one OS-process node on this host
+NODE_RSS_MB = int(os.environ.get("CBFT_E2E_NODE_RSS_MB", "400"))
+NODE_FDS = int(os.environ.get("CBFT_E2E_NODE_FDS", "96"))
+
+
+def _ephemeral_port_range() -> tuple[int, int]:
+    try:
+        with open("/proc/sys/net/ipv4/ip_local_port_range") as f:
+            lo, hi = (int(x) for x in f.read().split())
+            return lo, hi
+    except (OSError, ValueError):
+        return 32768, 60999  # the Linux default
+
+
+def _resource_guard(n_nodes: int, base_port: int | None = None) -> None:
+    """Refuse to launch a fleet the host cannot hold — BEFORE node 0
+    spawns, with an error naming the knob, instead of wedging mid-boot at
+    node 70. Estimates are deliberately conservative; operators with
+    bigger boxes override via env (CBFT_E2E_NODE_RSS_MB /
+    CBFT_E2E_NODE_FDS) or disable with CBFT_E2E_RESOURCE_GUARD=0."""
+    if os.environ.get("CBFT_E2E_RESOURCE_GUARD", "1") == "0":
+        return
+    # Listen ports colliding with the kernel's EPHEMERAL range is the
+    # classic wedge-at-node-48: another node's outbound conn grabs the
+    # port a later node was about to bind (found the hard way at 50
+    # nodes — ~750 outbound conns vs. 150 pending listens is a birthday
+    # problem). The net spans [base, base+2000+n] (p2p/rpc/abci
+    # strides). Small nets keep their historical ports: a handful of
+    # listens against a handful of conns is a negligible exposure.
+    if base_port is not None and n_nodes >= 16:
+        eph_lo, eph_hi = _ephemeral_port_range()
+        span_hi = base_port + 2000 + n_nodes
+        if base_port <= eph_hi and span_hi >= eph_lo:
+            raise RunError(
+                f"refusing to launch {n_nodes} nodes on base_port "
+                f"{base_port}: the net's port span [{base_port}, {span_hi}]"
+                f" overlaps the kernel ephemeral range [{eph_lo}, {eph_hi}]"
+                f" — a peer's outbound conn can steal a listen port "
+                f"mid-boot; pick base_port so the span ends below "
+                f"{eph_lo} (or set CBFT_E2E_RESOURCE_GUARD=0)")
+    # file descriptors: every node holds sockets to its peers + stores
+    try:
+        import resource
+
+        soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    except Exception:  # noqa: BLE001 - exotic platform: skip the fd check
+        soft = 0
+    need_fds = n_nodes * NODE_FDS
+    if soft and need_fds > soft:
+        raise RunError(
+            f"refusing to launch {n_nodes} nodes: estimated {need_fds} fds "
+            f"(~{NODE_FDS}/node, knob CBFT_E2E_NODE_FDS) exceeds the "
+            f"RLIMIT_NOFILE soft limit {soft}; raise `ulimit -n` or set "
+            f"CBFT_E2E_RESOURCE_GUARD=0 to override")
+    # memory: each node is a full python+jax process
+    avail_mb = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    avail_mb = int(line.split()[1]) // 1024
+                    break
+    except OSError:
+        return  # no /proc: skip the memory check
+    need_mb = n_nodes * NODE_RSS_MB
+    if avail_mb and need_mb > avail_mb:
+        raise RunError(
+            f"refusing to launch {n_nodes} nodes: estimated {need_mb} MB "
+            f"(~{NODE_RSS_MB} MB/node, knob CBFT_E2E_NODE_RSS_MB) exceeds "
+            f"the {avail_mb} MB available; shrink the fleet or set "
+            f"CBFT_E2E_RESOURCE_GUARD=0 to override")
+
+
+def _topology_peers(manifest: Manifest, names: list[str], i: int) -> list[int]:
+    """Which peers node i dials persistently, by topology. "full" is the
+    classic everyone-dials-everyone; "hub" meshes the first `hubs` nodes
+    and hangs every spoke off ALL hubs; "regional" meshes each region
+    internally and meshes the region GATEWAYS (first node per region)
+    across regions — cross-region traffic concentrates on the gateway
+    links the netchaos profiles degrade."""
+    n = len(names)
+    others = [j for j in range(n) if j != i]
+    if manifest.topology == "hub":
+        hubs = list(range(min(manifest.hubs, n)))
+        if i in hubs:
+            return [j for j in hubs if j != i]
+        return hubs
+    if manifest.topology == "regional":
+        regs = [manifest.nodes[nm].region for nm in names]
+        # TWO gateways per region (the first two nodes), meshed across
+        # regions: killing one gateway — a churn storm will — must not
+        # partition the fleet
+        gateways: dict[int, list[int]] = {}
+        for j, r in enumerate(regs):
+            gateways.setdefault(r, [])
+            if len(gateways[r]) < 2:
+                gateways[r].append(j)
+        peers = [j for j in others if regs[j] == regs[i]]
+        if i in gateways.get(regs[i], []):
+            peers += [g for r, gs in sorted(gateways.items())
+                      if r != regs[i] for g in gs]
+        return peers
+    return others
+
+
+def _netchaos_spec(manifest: Manifest, names: list[str],
+                   node_ids: list[str]) -> str:
+    """The per-node p2p.chaos schedule for a regional fleet: the named
+    link profile, every node's region, and one cross-region link mapping
+    per region pair. Empty when the manifest asks for a clean wire."""
+    if manifest.topology != "regional" or not manifest.link_profile:
+        return ""
+    prof = manifest.link_profile
+    parts = [f"profile.{prof}={LINK_PROFILES[prof]}"]
+    parts += [f"region={node_ids[i]}:r{manifest.nodes[nm].region}"
+              for i, nm in enumerate(names)]
+    regions = sorted({manifest.nodes[nm].region for nm in names})
+    parts += [f"link.r{a}-r{b}={prof}"
+              for ai, a in enumerate(regions) for b in regions[ai + 1:]]
+    return ",".join(parts)
+
+
 @dataclass
 class _Net:
     manifest: Manifest
@@ -85,6 +217,8 @@ def setup(manifest: Manifest, out_dir: str, base_port: int) -> _Net:
 
     peer_addrs = [f"{node_keys[i].id()}@127.0.0.1:{base_port + i}"
                   for i in range(len(names))]
+    node_ids = [nk.id() for nk in node_keys]
+    chaos_spec = _netchaos_spec(manifest, names, node_ids)
     for i, (name, home) in enumerate(zip(names, net.homes)):
         nm = manifest.nodes[name]
         cfg = Config(home=home)
@@ -93,9 +227,24 @@ def setup(manifest: Manifest, out_dir: str, base_port: int) -> _Net:
         cfg.p2p.laddr = f"tcp://127.0.0.1:{base_port + i}"
         cfg.rpc.laddr = f"tcp://127.0.0.1:{net.rpc_port(i)}"
         cfg.p2p.persistent_peers = ",".join(
-            a for j, a in enumerate(peer_addrs) if j != i)
+            peer_addrs[j] for j in _topology_peers(manifest, names, i))
+        # a fleet hub/gateway takes far more inbound conns than the
+        # 40-peer default allows
+        cfg.p2p.max_num_inbound_peers = max(40, len(names) + 8)
+        if chaos_spec:
+            # every node arms the same region/profile map: partition and
+            # profile enforcement is write-side, so each process must
+            # throttle its OWN outbound links
+            cfg.p2p.chaos = chaos_spec
         cfg.crypto.backend = "cpu"  # N processes cannot share one chip
         cfg.consensus.timeout_commit = 0.1
+        # reconciliation arm: the manifest picks the protocol (the
+        # full-gossip control arm measures amplification WITHOUT it); a
+        # fleet repairs vote views on a tighter cadence than the 0.5 s
+        # single-digit-net default
+        cfg.consensus.gossip_vote_summaries = manifest.vote_summaries
+        if manifest.vote_summaries:
+            cfg.consensus.vote_summary_interval = 0.1
         # perturbations drive the runtime control routes (partition arm/
         # heal); test-scale ban windows so a flood perturbation's bans
         # decay before the final catch-up deadline
@@ -152,6 +301,17 @@ def _chip_kill_chaos(dev: int) -> str:
 def _chip_flap_chaos(dev: int) -> str:
     return (f"ed25519.dispatch.dev{dev}=transient:6,"
             f"sr25519.dispatch.dev{dev}=transient:2")
+
+
+def _boot_staggered(net: _Net, wave: int = 12, pause: float = 1.0) -> None:
+    """Spawn every node in waves: 50 simultaneous jax imports would
+    stall every node's dial window (thundering herd). Shared by
+    run_manifest and bench_fleet so the curves boot fleets with the
+    same herd behavior as the acceptance runs they are compared to."""
+    for w in range(0, len(net.homes), wave):
+        net.node_procs += [_spawn_node(h) for h in net.homes[w:w + wave]]
+        if w + wave < len(net.homes):
+            time.sleep(pause)
 
 
 def _spawn_node(home: str, mesh_devices: int = 0):
@@ -313,9 +473,57 @@ def _kill(proc) -> None:
         pass
 
 
+def _fleet_rollup(report: dict, net: _Net, names: list[str]) -> dict:
+    """Aggregate per-node net_report forensics into ONE fleet view: wire
+    totals, gossip accounting (votes sent vs. needed — the amplification
+    headline), heal latency, and per-node heights. Every field degrades
+    to None/partial when a node died — the rollup reports, it never
+    raises."""
+    heights, send_bytes, recv_bytes = {}, 0, 0
+    g_tot: dict[str, int] = {}
+    heal = []
+    reporting = 0
+    for i, name in enumerate(names):
+        doc = report["nodes"].get(name) or {}
+        if "error" in doc:
+            continue
+        reporting += 1
+        heights[name] = _height(net, i)
+        totals = doc.get("totals") or {}
+        send_bytes += totals.get("send_bytes", 0)
+        recv_bytes += totals.get("recv_bytes", 0)
+        gossip = doc.get("gossip") or {}
+        for k, v in (gossip.get("totals") or {}).items():
+            g_tot[k] = g_tot.get(k, 0) + v
+        hs = (doc.get("net_chaos") or {}).get("last_heal_seconds")
+        if hs:
+            heal.append(hs)
+    hs_vals = [h for h in heights.values() if h > 0]
+    span = ((max(hs_vals) - net.manifest.initial_height)
+            if hs_vals else 0)
+    needed = g_tot.get("votes_recv_needed", 0)
+    return {
+        "n_nodes": len(names),
+        "nodes_reporting": reporting,
+        "topology": net.manifest.topology,
+        "heights": heights,
+        "wire_send_bytes_total": send_bytes,
+        "wire_recv_bytes_total": recv_bytes,
+        "wire_bytes_per_height_per_node": (
+            round(send_bytes / span / max(1, reporting), 1)
+            if span > 0 and reporting else None),
+        "gossip_totals": g_tot,
+        "gossip_votes_per_vote_needed": (
+            round(g_tot.get("votes_recv", 0) / needed, 3)
+            if needed else None),
+        "partition_heal_seconds_max": max(heal) if heal else None,
+    }
+
+
 def _write_net_report(net: _Net, names: list[str], log=print) -> str | None:
     """Snapshot net_telemetry from every live node into
-    <out_dir>/net_report.json (the run report's wire-plane section).
+    <out_dir>/net_report.json (the run report's wire-plane section),
+    plus the `fleet` rollup aggregating them into one record.
     Telemetry failures are recorded per node, never raised — the report
     is an artifact, not an assertion."""
     report = {"manifest": net.manifest.name, "nodes": {}}
@@ -325,6 +533,10 @@ def _write_net_report(net: _Net, names: list[str], log=print) -> str | None:
                                          timeout=5.0).get("result", {})
         except Exception as e:  # noqa: BLE001
             report["nodes"][name] = {"error": str(e)}
+    try:
+        report["fleet"] = _fleet_rollup(report, net, names)
+    except Exception as e:  # noqa: BLE001 - the rollup must never cost
+        report["fleet"] = {"error": str(e)}  # the per-node forensics
     path = os.path.join(net.dir, "net_report.json")
     try:
         with open(path, "w") as f:
@@ -338,14 +550,183 @@ def _write_net_report(net: _Net, names: list[str], log=print) -> str | None:
     return path
 
 
+# ------------------------------------------------- fleet perturbations
+# NET-level perturbations (manifest.net_perturb): each one drives the
+# WHOLE fleet and asserts through the gossip/heal metrics, where the
+# per-node perturbations above drive one node at a time.
+
+
+def _min_height(net: _Net, idxs) -> int:
+    return min(_height(net, j) for j in idxs)
+
+
+def _max_height(net: _Net, idxs) -> int:
+    return max(_height(net, j) for j in idxs)
+
+
+def _perturb_churn_storm(net: _Net, names: list[str], pct: int, log) -> None:
+    """Rolling restarts of pct% of the fleet in quorum-preserving waves:
+    at most ~10% of nodes are down at once, and the chain must ADVANCE
+    while the storm blows (a churn storm is weather, not an outage)."""
+    n = len(names)
+    n_churn = max(1, n * pct // 100)
+    wave = max(1, min(n // 10, max(1, (n - 1) // 3)))
+    victims = list(range(n))[:n_churn]  # deterministic: lowest indices
+    log(f"[{net.manifest.name}] churn storm: restarting {n_churn}/{n} "
+        f"nodes in waves of {wave}")
+    h0 = _max_height(net, range(n))
+    for w in range(0, n_churn, wave):
+        batch = victims[w:w + wave]
+        for j in batch:
+            _kill(net.node_procs[j])
+        for j in batch:
+            net.node_procs[j] = _spawn_node(net.homes[j])
+        # the respawned wave must REJOIN before the next wave blows, or
+        # waves overlap into an outage
+        target = _max_height(net, [j for j in range(n) if j not in batch])
+        _wait(lambda: _min_height(net, batch) >= target - 1,
+              120 + 4 * len(batch),
+              f"churn wave {w // wave} rejoining height {target - 1}")
+    # the chain must have kept committing through the storm window (a
+    # churn storm is weather, not an outage); a short tail covers a
+    # proposer round that died mid-wave
+    _wait(lambda: _max_height(net, range(n)) > h0, 60 + 2 * n,
+          f"the chain advancing past {h0} through the churn storm")
+    h1 = _max_height(net, range(n))
+    _wait(lambda: _min_height(net, range(n)) >= h1, 150 + 2 * n,
+          "the whole fleet catching up after the churn storm")
+    log(f"[{net.manifest.name}] churn storm done: {h0} -> {h1}, all caught up")
+
+
+def _nudge_dials(net: _Net, names: list[str]) -> None:
+    """Ask every node to re-dial its topology peers NOW (the dial_peers
+    control route; already-connected peers are no-ops). Best-effort —
+    a node that ignores the nudge just rides its own backoff."""
+    ids = _node_ids(net)
+    for i in range(len(names)):
+        peers = ",".join(
+            f"{ids[j]}@127.0.0.1:{net.base_port + j}"
+            for j in _topology_peers(net.manifest, names, i))
+        if not peers:
+            continue
+        try:
+            _rpc(net, i,
+                 f"dial_peers?peers={urllib.parse.quote(peers)}",
+                 timeout=10.0)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _perturb_regional_partition(net: _Net, names: list[str], region: int,
+                                log) -> None:
+    """Cut one region off through the runtime netchaos route. A minority
+    region must STALL while the rest commits (they lost nothing but that
+    region's votes); a heal must reconnect it, catch it up, and land on
+    the partition-heal metric."""
+    m = net.manifest
+    n = len(names)
+    ids = _node_ids(net)
+    cut = [i for i, nm in enumerate(names) if m.nodes[nm].region == region]
+    rest = [i for i in range(n) if i not in cut]
+    if not cut or not rest:
+        raise RunError(f"regional-partition: region {region} is empty or "
+                       f"the whole net")
+    spec = ("partition=" + ".".join(ids[i] for i in cut) + "|"
+            + ".".join(ids[i] for i in rest))
+    log(f"[{m.name}] partitioning region r{region} "
+        f"({len(cut)} nodes) from the other {len(rest)}")
+    arg = urllib.parse.quote(f'"{spec}"')
+    for j in range(n):
+        _rpc(net, j, f"unsafe_net_chaos?spec={arg}", timeout=10.0)
+    time.sleep(2.0)  # in-flight commits land
+    cut_h = _max_height(net, cut)
+    rest_h = _max_height(net, rest)
+    majority_has_quorum = len(rest) * 3 > n * 2
+    if majority_has_quorum:
+        _wait(lambda: _min_height(net, rest) >= rest_h + 2, 120 + 2 * n,
+              "the majority side committing through the partition")
+    else:
+        time.sleep(6.0)
+        if _max_height(net, rest) > rest_h + 1:
+            raise RunError("progress on a quorum-less majority side")
+    if _max_height(net, cut) > cut_h + 1:
+        raise RunError(
+            f"cut region r{region} advanced {cut_h} -> "
+            f"{_max_height(net, cut)} during its partition")
+    for j in range(n):
+        _rpc(net, j, "unsafe_net_chaos?heal=true", timeout=10.0)
+    # redial nudge: persistent-peer reconnect backoff deepens to 30 s
+    # steps during a long partition, which can leave the few
+    # cross-region links down for minutes AFTER the heal — the operator
+    # move (and this runner's) is to nudge the dials through the
+    # control route instead of waiting out the backoff
+    _nudge_dials(net, names)
+    target = _max_height(net, rest) + 2
+    _wait(lambda: _min_height(net, range(n)) >= target, 300 + 6 * n,
+          f"region r{region} catching up to {target} after the heal")
+    if not any(_metric_value(_metrics_text(net, j),
+                             "cometbft_p2p_partition_heal_seconds") > 0
+               for j in range(n)):
+        raise RunError("regional partition heal not recorded on /metrics")
+    log(f"[{m.name}] region r{region} healed and caught up")
+
+
+def _perturb_byzantine_minority(net: _Net, names: list[str], k: int,
+                                log) -> None:
+    """Restart k nodes equivocating (capped to keep a +2/3 honest
+    quorum). The honest fleet must detect (DuplicateVoteEvidence
+    committed) while staying live; the culprits are then reformed."""
+    n = len(names)
+    k = max(1, min(k, (n - 1) // 3))
+    byz = list(range(k))
+    honest = [j for j in range(n) if j >= k]
+    log(f"[{net.manifest.name}] byzantine minority: {k}/{n} equivocating")
+    for j in byz:
+        _kill(net.node_procs[j])
+        _arm_byzantine(net.homes[j], "equivocation")
+        net.node_procs[j] = _spawn_node(net.homes[j])
+    _wait(lambda: any(
+        _metric_value(_metrics_text(net, j), "cometbft_evidence_committed")
+        >= 1 for j in honest), 240 + 4 * n,
+        "honest nodes committing DuplicateVoteEvidence")
+    h0 = _max_height(net, honest)
+    _wait(lambda: _max_height(net, honest) >= h0 + 2, 120 + 2 * n,
+          "the honest fleet staying live under the byzantine minority")
+    for j in byz:
+        _kill(net.node_procs[j])
+        _arm_byzantine(net.homes[j], "")
+        net.node_procs[j] = _spawn_node(net.homes[j])
+    target = _max_height(net, honest) + 1
+    _wait(lambda: _min_height(net, range(n)) >= target, 200 + 4 * n,
+          "reformed nodes rejoining the fleet")
+    log(f"[{net.manifest.name}] byzantine minority detected and reformed")
+
+
+def _run_net_perturbations(net: _Net, names: list[str], log) -> None:
+    for p in net.manifest.net_perturb:
+        base, _, arg = p.partition(":")
+        if base == "churn-storm":
+            _perturb_churn_storm(net, names, int(arg) if arg else 30, log)
+        elif base == "regional-partition":
+            _perturb_regional_partition(net, names,
+                                        int(arg) if arg else 0, log)
+        elif base == "byzantine-minority":
+            _perturb_byzantine_minority(
+                net, names, int(arg) if arg else len(names) // 3, log)
+
+
 def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
                  log=print) -> None:
     """Setup + start + perturb + verify + cleanup. Raises RunError on any
     violated expectation."""
     manifest.validate()
+    _resource_guard(len(manifest.nodes), base_port)
     net = setup(manifest, out_dir, base_port)
     names = sorted(manifest.nodes)
     n = len(names)
+    # fleet deadlines scale with size: 50 processes importing jax and
+    # dialing a topology do not boot in a 4-node net's 150 s
+    boot_deadline = 150 + 4 * n
     try:
         # out-of-process apps first (the node dials them on boot)
         for i, name in enumerate(names):
@@ -358,12 +739,12 @@ def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
             cfg = Config.load(net.homes[i])
             net.app_procs.append(_spawn_app(cfg.base.proxy_app))
         time.sleep(1.0)
-        net.node_procs = [_spawn_node(h) for h in net.homes]
+        _boot_staggered(net)
 
         start_h = manifest.initial_height
         log(f"[{manifest.name}] waiting for height {start_h + 2} on {n} nodes")
         _wait(lambda: all(_height(net, i) >= start_h + 2 for i in range(n)),
-              150, f"all {n} nodes reaching height {start_h + 2}")
+              boot_deadline, f"all {n} nodes reaching height {start_h + 2}")
 
         # perturbations (perturb.go:44-100), one node at a time. A
         # single-node net has no survivors to observe: kill degrades to
@@ -599,11 +980,15 @@ def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
                                 f"(size {size} of {n_mesh}) instead of "
                                 f"being absorbed")
 
+        # net-level perturbations (fleet scale): after the per-node loop,
+        # so a manifest can compose both planes
+        _run_net_perturbations(net, names, log)
+
         target = max(manifest.initial_height + manifest.target_height_delta,
                      max(_height(net, i) for i in range(n)))
         log(f"[{manifest.name}] waiting for target height {target}")
         _wait(lambda: all(_height(net, i) >= target for i in range(n)),
-              150, f"all nodes reaching target height {target}")
+              150 + 2 * n, f"all nodes reaching target height {target}")
 
         # no fork: every node agrees on the newest height they all have
         h = min(_height(net, i) for i in range(n)) - 1

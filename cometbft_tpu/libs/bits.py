@@ -125,6 +125,16 @@ class BitArray:
     def to_bytes(self) -> bytes:
         return bytes(self._elems)
 
+    def or_update(self, other: "BitArray") -> None:
+        """In-place union restricted to self's size. Used by vote-summary
+        reconciliation: has-vote knowledge is monotonic, and mutating in
+        place keeps any aliases (catchup_commit may BE precommits) in
+        agreement where a rebinding union would silently fork them."""
+        n = min(len(self._elems), len(other._elems))
+        for i in range(n):
+            self._elems[i] |= other._elems[i]
+        self._mask_tail()
+
     def update(self, other: "BitArray") -> None:
         """Copy other's bits into self (sizes should match)."""
         n = min(len(self._elems), len(other._elems))

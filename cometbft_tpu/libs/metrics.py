@@ -249,6 +249,27 @@ class ConsensusMetrics:
             "consensus", "vote_batch_flushes", "Device vote-batch flushes")
         self.batch_lanes = reg.counter(
             "consensus", "vote_batch_lanes", "Signatures through batched flushes")
+        # gossip accounting (fleet dimension): votes sent vs. votes the
+        # peer actually needed — vote amplification as a measured number.
+        # Receiver-side classification: needed = the vote advanced our
+        # view; already_had = our vote set already held it (a wasted
+        # send by the peer); stale = for a height we have committed past.
+        # Cardinality is bounded by construction (3 statuses, no peer
+        # labels — the per-peer split lives in net_telemetry's gossip
+        # rollup, bounded by live peers).
+        self.gossip_votes_sent = reg.counter(
+            "consensus", "gossip_votes_sent",
+            "Votes this node's gossip routines sent to peers")
+        self.gossip_votes_received = reg.counter(
+            "consensus", "gossip_votes_received",
+            "Votes received from peers, by whether this node needed them",
+            labels=("status",))
+        self.gossip_summaries = reg.counter(
+            "consensus", "gossip_vote_summaries",
+            "Compact vote-summary reconciliation events (sent / applied / "
+            "degraded_* = summary ignored, full gossip continues / "
+            "peer_unsupported = peer never negotiated the channel)",
+            labels=("event",))
 
 
 class MempoolMetrics:
@@ -310,23 +331,77 @@ class P2PMetrics:
             "p2p", "peer_bans",
             "Peers banned after repeated misbehavior")
         self.peer_cap = peer_cap
+        # label-slot ledger (bounded under churn storms — ISSUE 12):
+        #   _peer_labels  ids currently OWNING a label (<= peer_cap live
+        #                 owners; a returning released peer may briefly
+        #                 push past while its old label is re-armed)
+        #   _released     past owners, newest last (<= peer_cap): a peer
+        #                 whose ban expired re-claims its OWN label
+        #                 instead of minting a new exposition series
+        #   _minted       distinct labels ever created — the HARD
+        #                 exposition bound (2x peer_cap): counter series
+        #                 persist after release, so reclaimed slots must
+        #                 not mint fresh label values forever
+        # Overflow ids are NOT cached (a churn storm past the cap must
+        # not grow this map without bound).
         self._peer_labels: dict[str, str] = {}
+        self._released: dict[str, str] = {}
+        self._minted = 0
         self._peer_lock = threading.Lock()
 
     OTHER_PEER_LABEL = "other"
 
+    @property
+    def mint_cap(self) -> int:
+        """Distinct per-peer label values ever allowed on the exposition
+        (live + released-but-persisting series)."""
+        return 2 * self.peer_cap
+
     def peer_label(self, node_id: str) -> str:
-        """Bounded-cardinality peer label: the first peer_cap distinct
-        node ids map to their short id, everything after to "other"."""
+        """Bounded-cardinality peer label: up to peer_cap LIVE peers own
+        their short-id label; a released peer (disconnect, ban) frees its
+        slot and — returning later — gets its old label back; past the
+        mint cap, new peers fold into "other" even when slots are free
+        (the exposition is already at its bound)."""
         if not node_id:
             return self.OTHER_PEER_LABEL
         with self._peer_lock:
             label = self._peer_labels.get(node_id)
-            if label is None:
-                label = (node_id[:10] if len(self._peer_labels) < self.peer_cap
-                         else self.OTHER_PEER_LABEL)
+            if label is not None:
+                return label
+            label = self._released.pop(node_id, None)
+            if label is not None:  # ban expired / redial: same series
                 self._peer_labels[node_id] = label
-            return label
+                return label
+            if (len(self._peer_labels) < self.peer_cap
+                    and self._minted < self.mint_cap):
+                label = node_id[:10]
+                self._peer_labels[node_id] = label
+                self._minted += 1
+                return label
+            return self.OTHER_PEER_LABEL
+
+    def release_peer(self, node_id: str) -> None:
+        """Free a disconnected/banned peer's label slot. Its label is
+        remembered (bounded FIFO) so the SAME peer returning re-claims
+        it; the oldest released memory is dropped past peer_cap — such a
+        peer returning after a long churn storm reads as new."""
+        with self._peer_lock:
+            label = self._peer_labels.pop(node_id, None)
+            if label is None:
+                return
+            self._released.pop(node_id, None)
+            self._released[node_id] = label
+            while len(self._released) > self.peer_cap:
+                del self._released[next(iter(self._released))]
+
+    def peer_label_stats(self) -> dict:
+        """Ledger introspection for tests/health: all bounded."""
+        with self._peer_lock:
+            return {"owners": len(self._peer_labels),
+                    "released": len(self._released),
+                    "minted": self._minted,
+                    "mint_cap": self.mint_cap}
 
     def record_conn_traffic(self, peer_label: str, per_chan: dict,
                             send: bool) -> None:

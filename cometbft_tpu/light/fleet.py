@@ -465,6 +465,12 @@ class LightFleet:
         self._subs: dict[str, Subscription] = {}
         self._watcher: Optional[asyncio.Task] = None
         self._stopped = False
+        # event-driven head plane (node event bus -> notify_height): the
+        # watcher wakes on the event instead of sleeping out a poll
+        # interval; with no event source it polls (store-only setups)
+        self._head_event: Optional[asyncio.Event] = None
+        self._notified_height: Optional[int] = None
+        self.head_notifications = 0
         # ---- accounting (health + bench surface)
         self.requests = 0
         self.cache_hits = 0
@@ -636,9 +642,25 @@ class LightFleet:
             m.subscribers.set(len(self._subs))
 
     def _ensure_watcher(self) -> None:
+        if self._head_event is None:
+            self._head_event = asyncio.Event()
         if self._watcher is None or self._watcher.done():
             self._watcher = asyncio.get_running_loop().create_task(
                 self._watch_head(), name="light-fleet-head")
+
+    def notify_height(self, height: int) -> None:
+        """Event-driven head publishing (the node's NewBlock hook, PR 11
+        residual): record the newly committed height and wake the watcher
+        NOW instead of letting it sleep out the poll interval. The
+        watcher still verifies through the coalescing path — the event
+        carries the height, never an unverified header — and the poll
+        path stays as fallback for store-only setups where no event bus
+        feeds the fleet."""
+        if self._notified_height is None or height > self._notified_height:
+            self._notified_height = height
+        self.head_notifications += 1
+        if self._head_event is not None:
+            self._head_event.set()
 
     # heights verified+fanned per watcher tick: bounds one tick's work
     # without ever SKIPPING a height — a backlog deeper than this simply
@@ -646,25 +668,34 @@ class LightFleet:
     _WATCH_BUDGET = 16
 
     async def _watch_head(self) -> None:
-        """Poll the primary's head; verify each newly committed height
-        once (coalesced with any concurrent request for it) and fan the
-        verified header out. The stream is GAP-FREE from subscription
-        time onward: `last` only advances through heights actually
-        fanned out, so a stall longer than one poll interval delays
+        """Follow the head — event-driven when the node event bus feeds
+        notify_height (each tick consumes the notified height, no store
+        poll), polling the primary otherwise — and verify each newly
+        committed height once (coalesced with any concurrent request for
+        it), fanning the verified header out. The stream is GAP-FREE from
+        subscription time onward: `last` only advances through heights
+        actually fanned out, so a stall longer than one tick delays
         headers but never drops them (backpressure and send budgets are
         the only loss modes, as documented). Provider errors back off on
         the poll cadence — the stream stalls, it never dies."""
         last: Optional[int] = None  # None = anchor at the head on tick 1
         while not self._stopped and self._subs:
             try:
-                head = await self.client.primary.light_block(0)
-                self._watcher_polls += 1
+                notified = self._notified_height
+                if last is not None and notified is not None \
+                        and notified > last:
+                    # event-driven tick: the bus already told us the head
+                    head_h = notified
+                else:
+                    head = await self.client.primary.light_block(0)
+                    self._watcher_polls += 1
+                    head_h = head.height
                 if last is None:
                     # subscribers want heights committed AFTER they
                     # subscribed; history is light_verify's job
-                    last = head.height - 1
+                    last = head_h - 1
                 budget = self._WATCH_BUDGET
-                while last < head.height and budget:
+                while last < head_h and budget:
                     lb = await self._verify_for_stream(last + 1)
                     self._fan_out(lb)
                     last += 1
@@ -675,7 +706,18 @@ class LightFleet:
                 self.logger.info("fleet head watcher error", err=str(e))
             except Exception as e:  # noqa: BLE001 - watcher must survive
                 self.logger.error("fleet head watcher failure", err=str(e))
-            await asyncio.sleep(self.poll_interval)
+            # event-or-timeout: a notify_height wakes the watcher NOW;
+            # the timeout is the store-only poll fallback
+            ev = self._head_event
+            if ev is not None:
+                try:
+                    await asyncio.wait_for(ev.wait(),
+                                           timeout=self.poll_interval)
+                except asyncio.TimeoutError:
+                    pass
+                ev.clear()
+            else:
+                await asyncio.sleep(self.poll_interval)
         self._watcher = None
 
     def publish(self, lb: LightBlock) -> None:
@@ -777,6 +819,7 @@ class LightFleet:
             "subscribers": len(self._subs),
             "streamed": self.streamed,
             "dropped_subscribers": self.dropped_subscribers,
+            "head_notifications": self.head_notifications,
             "request_latency": self.latency_quantiles(),
             # per-verification bisection budget: provider fetches per
             # verification (client-driven AND watcher-driven — both do

@@ -26,6 +26,23 @@ the `unsafe_net_chaos` RPC control route:
   CBFT_NET_CHAOS="latency=0.05,drop=0.01,dup=0.02,reorder=0.05,bandwidth=65536"
   CBFT_NET_CHAOS="partition=<idA>.<idB>|<idC>.<idD>"
 
+Link profiles (the fleet-topology dimension): instead of one global link
+config, NAMED profiles apply per region pair — the regional testnets'
+"intra-region fast, cross-region high-latency/lossy" shape:
+
+  CBFT_NET_CHAOS="profile.wan=latency:0.04;jitter:0.02;drop:0.005,
+                  region=<idA>:r0,region=<idB>:r1,link.r0-r1=wan"
+
+  profile.<name>=k:v;k:v   define a profile (keys = the link-fault keys)
+  region=<node_id>:<name>  assign a node to a region (repeatable)
+  link.<rA>-<rB>=<name>    profile for traffic between two regions
+                           (unordered; rA == rB for intra-region links)
+  link.default=<name>      profile for any region pair not mapped above
+
+A write resolves its profile from (local region, remote region); links
+with no profile (or nodes with no region) fall back to the global link
+config. Profiles compose with partitions unchanged.
+
 `partition=` groups are separated by `|`, members by `.`; node ids are hex
 so neither collides. Probabilistic faults use a seeded RNG per connection
 (`seed=` in the spec), so a fault schedule replays deterministically like a
@@ -80,6 +97,11 @@ _lock = threading.Lock()
 _cfg: NetChaosConfig | None = None
 _groups: dict[str, str] = {}          # node_id -> partition group label
 _blocked_links: set[tuple[str, str]] = set()  # directed (src, dst) blocks
+# link-profile plane (fleet topologies): named configs + region wiring
+_profiles: dict[str, NetChaosConfig] = {}     # profile name -> config
+_regions: dict[str, str] = {}                 # node_id -> region name
+_region_links: dict[tuple[str, str], str] = {}  # sorted (rA, rB) -> profile
+_default_link_profile: str | None = None
 _env_loaded = False
 # heal observability: set when a partition is cleared, consumed by the first
 # write that crosses a formerly-blocked link
@@ -93,14 +115,52 @@ _stats = {"blocked_writes": 0, "dropped": 0, "duplicated": 0,
 _active = False
 
 
-def parse_spec(spec: str) -> tuple[NetChaosConfig | None, dict[str, str],
-                                   set[tuple[str, str]]]:
-    """Parse a CBFT_NET_CHAOS schedule into (link config, partition groups,
-    directed blocks), raising ValueError on any malformed part — config
-    validation uses this so a typo'd schedule fails at boot."""
+class ParsedSpec:
+    """The parsed form of one CBFT_NET_CHAOS schedule. Attribute access
+    only (the old 3-tuple unpack shape predates link profiles)."""
+
+    __slots__ = ("cfg", "groups", "blocks", "profiles", "regions", "links",
+                 "default_link")
+
+    def __init__(self):
+        self.cfg: NetChaosConfig | None = None
+        self.groups: dict[str, str] = {}
+        self.blocks: set[tuple[str, str]] = set()
+        self.profiles: dict[str, NetChaosConfig] = {}
+        self.regions: dict[str, str] = {}
+        self.links: dict[tuple[str, str], str] = {}
+        self.default_link: str | None = None
+
+
+def _parse_link_kwargs(value: str, part: str, sep_pair: str = "=") -> dict:
+    """Parse link-fault key/value pairs; `value` is `k{sep}v` joined by
+    `,` (top level) or `;` (inside a profile definition)."""
+    kwargs: dict[str, float | int] = {}
+    items = value.split(";") if sep_pair == ":" else [value]
+    for item in items:
+        key, sep, val = item.partition(sep_pair)
+        key, val = key.strip(), val.strip()
+        if not sep or not val or key not in _LINK_KEYS:
+            raise ValueError(f"bad net-chaos link fault {item!r} in {part!r} "
+                             f"(keys: {_LINK_KEYS})")
+        try:
+            kwargs[key] = (int(val) if key in ("bandwidth", "seed")
+                           else float(val))
+        except ValueError:
+            raise ValueError(
+                f"bad net-chaos value {val!r} in {part!r}") from None
+        if kwargs[key] < 0:
+            raise ValueError(f"negative net-chaos value in {part!r}")
+    return kwargs
+
+
+def parse_spec(spec: str) -> ParsedSpec:
+    """Parse a CBFT_NET_CHAOS schedule (link config, partition groups,
+    directed blocks, link profiles, region map, region-pair links),
+    raising ValueError on any malformed part — config validation uses
+    this so a typo'd schedule fails at boot."""
+    out = ParsedSpec()
     cfg_kwargs: dict[str, float | int] = {}
-    groups: dict[str, str] = {}
-    blocks: set[tuple[str, str]] = set()
     for part in spec.split(","):
         part = part.strip()
         if not part:
@@ -115,34 +175,58 @@ def parse_spec(spec: str) -> tuple[NetChaosConfig | None, dict[str, str],
                 if not members:
                     raise ValueError(f"empty partition group in {part!r}")
                 for m in members:
-                    groups[m] = f"g{gi}"
+                    out.groups[m] = f"g{gi}"
         elif key == "block":
             src, sep2, dst = value.partition(">")
             if not sep2 or not src or not dst:
                 raise ValueError(f"malformed directed block {part!r} "
                                  "(want block=src>dst)")
-            blocks.add((src, dst))
+            out.blocks.add((src, dst))
+        elif key.startswith("profile."):
+            name = key[len("profile."):]
+            if not name:
+                raise ValueError(f"empty profile name in {part!r}")
+            out.profiles[name] = NetChaosConfig(
+                **_parse_link_kwargs(value, part, sep_pair=":"))
+        elif key == "region":
+            node_id, sep2, region = value.partition(":")
+            if not sep2 or not node_id or not region:
+                raise ValueError(f"malformed region assignment {part!r} "
+                                 "(want region=<node_id>:<region>)")
+            out.regions[node_id] = region
+        elif key.startswith("link."):
+            pair = key[len("link."):]
+            if pair == "default":
+                out.default_link = value
+            else:
+                ra, sep2, rb = pair.partition("-")
+                if not sep2 or not ra or not rb:
+                    raise ValueError(f"malformed link key {part!r} "
+                                     "(want link.<rA>-<rB>=<profile>)")
+                out.links[tuple(sorted((ra, rb)))] = value
         elif key in _LINK_KEYS:
-            try:
-                cfg_kwargs[key] = (int(value) if key in ("bandwidth", "seed")
-                                   else float(value))
-            except ValueError:
-                raise ValueError(
-                    f"bad net-chaos value {value!r} in {part!r}") from None
-            if cfg_kwargs[key] < 0:
-                raise ValueError(f"negative net-chaos value in {part!r}")
+            cfg_kwargs.update(_parse_link_kwargs(part, part))
         else:
             raise ValueError(
                 f"unknown net-chaos key {key!r} (keys: "
-                f"{_LINK_KEYS + ('partition', 'block')})")
-    cfg = NetChaosConfig(**cfg_kwargs) if cfg_kwargs else None
-    return cfg, groups, blocks
+                f"{_LINK_KEYS + ('partition', 'block', 'region', 'profile.<name>', 'link.<rA>-<rB>')})")
+    if cfg_kwargs:
+        out.cfg = NetChaosConfig(**cfg_kwargs)
+    # a link mapping naming an undefined profile is a boot-time error,
+    # not a silent clean wire at fault time
+    for pair, name in list(out.links.items()) + (
+            [(("default", "default"), out.default_link)]
+            if out.default_link else []):
+        if name not in out.profiles:
+            raise ValueError(f"link {pair} names unknown profile {name!r}")
+    return out
 
 
 def _recompute_active_locked() -> None:
     global _active
     _active = bool((_cfg is not None and _cfg.any_active()) or _groups
-                   or _blocked_links or _heal_pending)
+                   or _blocked_links or _heal_pending
+                   or (_region_links or _default_link_profile))
 
 
 def _load_env_locked() -> None:
@@ -165,14 +249,19 @@ def _load_env_locked() -> None:
 
 
 def _arm_spec_locked(spec: str) -> None:
-    global _cfg
-    cfg, groups, blocks = parse_spec(spec)
-    if cfg is not None:
-        _cfg = cfg
-    if groups:
-        _set_partition_locked(groups)
-    for link in blocks:
+    global _cfg, _default_link_profile
+    parsed = parse_spec(spec)
+    if parsed.cfg is not None:
+        _cfg = parsed.cfg
+    if parsed.groups:
+        _set_partition_locked(parsed.groups)
+    for link in parsed.blocks:
         _blocked_links.add(link)
+    _profiles.update(parsed.profiles)
+    _regions.update(parsed.regions)
+    _region_links.update(parsed.links)
+    if parsed.default_link is not None:
+        _default_link_profile = parsed.default_link
     _recompute_active_locked()
 
 
@@ -201,10 +290,15 @@ def disarm() -> None:
 def reset() -> None:
     """Back to a clean wire; forgets the env schedule (tests re-arm)."""
     global _cfg, _env_loaded, _heal_pending, _last_heal_seconds
+    global _default_link_profile
     with _lock:
         _cfg = None
         _groups.clear()
         _blocked_links.clear()
+        _profiles.clear()
+        _regions.clear()
+        _region_links.clear()
+        _default_link_profile = None
         _heal_pending = False
         _heal_links.clear()
         _last_heal_seconds = None
@@ -270,6 +364,28 @@ def clear_partition() -> None:
         _recompute_active_locked()
 
 
+def link_config(src: str, dst: str) -> NetChaosConfig | None:
+    """The link-fault config governing traffic src -> dst: a region-pair
+    profile when both nodes have regions and the pair (or the default
+    link) is mapped, else the global config. None = clean wire."""
+    if _region_links or _default_link_profile:
+        with _lock:
+            ra, rb = _regions.get(src), _regions.get(dst)
+            if ra is not None and rb is not None:
+                name = _region_links.get(tuple(sorted((ra, rb))),
+                                         _default_link_profile)
+                if name is not None:
+                    prof = _profiles.get(name)
+                    if prof is not None:
+                        return prof
+    return _cfg
+
+
+def region_of(node_id: str) -> str | None:
+    with _lock:
+        return _regions.get(node_id)
+
+
 def link_blocked(src: str, dst: str) -> bool:
     """True when traffic src -> dst is cut (directed block or group split)."""
     if not _active:
@@ -326,6 +442,13 @@ def snapshot() -> dict:
             "config": cfg,
             "partition": dict(_groups),
             "blocked_links": sorted(f"{a}>{b}" for a, b in _blocked_links),
+            "profiles": {
+                name: {k: getattr(p, k) for k in _LINK_KEYS}
+                for name, p in _profiles.items()},
+            "regions": dict(_regions),
+            "region_links": {f"{a}-{b}": name
+                             for (a, b), name in _region_links.items()},
+            "default_link_profile": _default_link_profile,
             "heal_pending": _heal_pending,
             "last_heal_seconds": _last_heal_seconds,
             "stats": dict(_stats),
@@ -389,7 +512,7 @@ class ChaosConn:
             _count("blocked_writes")
             raise ConnectionResetError(
                 f"net chaos: partitioned from {self.remote_id[:10]}")
-        cfg = _cfg
+        cfg = link_config(self.local_id, self.remote_id)
         if cfg is not None and cfg.any_active():
             rng = self._link_rng(cfg.seed)
             if cfg.bandwidth:

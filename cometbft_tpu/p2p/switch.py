@@ -371,6 +371,11 @@ class Switch(BaseService):
             self.peers.pop(peer.id, None)
             if self.metrics is not None:
                 self.metrics.peers.set(len(self.peers))
+                # free the metrics label slot: under a churn storm the
+                # label ledger must turn over instead of pinning dead
+                # peers' slots forever (a returning peer re-claims its
+                # old label — same series, no new cardinality)
+                self.metrics.release_peer(peer.id)
         try:
             await peer.stop()
         except Exception:  # noqa: BLE001

@@ -86,6 +86,7 @@ class Environment:
         # the light_verify / light_subscribe routes
         self._light_fleet = None
         self._fleet_lock = None  # created on the serving loop
+        self._fleet_head_sub = None  # NewBlock subscription feeding it
 
     # ------------------------------------------------------------- info
 
@@ -189,12 +190,18 @@ class Environment:
             "totals": {}, "peer_scores": {}}
         node_key = getattr(self.node, "node_key", None)
         node_info = getattr(self.node, "node_info", None)
+        # gossip accounting (vote amplification as a measured number):
+        # the consensus reactor's per-peer sent/needed rollup — absent in
+        # inspect mode, where there is no live reactor
+        cons = getattr(self.node, "consensus_reactor", None)
+        acct = getattr(cons, "gossip_accounting", None)
         return {
             "node_id": node_key.id() if node_key is not None else "",
             "moniker": node_info.moniker if node_info is not None else "",
             "listen_addr": (node_info.listen_addr
                             if node_info is not None else ""),
             **wire,
+            "gossip": acct() if acct is not None else None,
             "tunnel": linkmodel.tunnel().snapshot(),
             "p2p_link": linkmodel.p2p().snapshot(),
             "net_chaos": netchaos.snapshot(),
@@ -562,8 +569,46 @@ class Environment:
                 logger=getattr(self.node, "logger", None),
             )
             await fleet.initialize()
+            self._attach_head_events(fleet)
             self._light_fleet = fleet
             return fleet
+
+    def _attach_head_events(self, fleet) -> None:
+        """Event-driven head publishing (PR 11 residual): bridge the
+        node's NewBlock events into fleet.notify_height so the head
+        watcher wakes on commit instead of sleeping out a poll interval.
+        Best-effort — a node without an event bus (inspect shims, tests)
+        just leaves the fleet on the poll fallback."""
+        import asyncio
+
+        bus = getattr(self.node, "event_bus", None)
+        if bus is None:
+            return
+        from cometbft_tpu.types import event_bus as eb
+
+        try:
+            sub = bus.subscribe("light-fleet-head", eb.QUERY_NEW_BLOCK)
+        except Exception:  # noqa: BLE001 - already subscribed / no server
+            return
+        self._fleet_head_sub = sub
+
+        async def _pump() -> None:
+            while True:
+                msg = await sub.out.get()
+                if msg is None:  # cancellation wake-up
+                    if sub.canceled is not None:
+                        return
+                    continue
+                block = getattr(msg.data, "block", None)
+                header = getattr(block, "header", None)
+                height = getattr(header, "height", None)
+                if height:
+                    fleet.notify_height(int(height))
+
+        task = asyncio.get_running_loop().create_task(
+            _pump(), name="light-fleet-head-events")
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
 
     async def light_verify(self, params: dict) -> dict:
         """Fleet-served skipping verification (no reference analog): the
@@ -672,8 +717,11 @@ class Environment:
             self._light_fleet.unsubscribe(client_id)
 
     async def close(self) -> None:
-        """Server shutdown hook: stop the fleet's head watcher so no
-        task outlives the RPC plane."""
+        """Server shutdown hook: stop the fleet's head watcher (and the
+        event-bus pump feeding it) so no task outlives the RPC plane."""
+        if self._fleet_head_sub is not None:
+            self._fleet_head_sub.cancel("rpc environment closed")
+            self._fleet_head_sub = None
         if self._light_fleet is not None:
             await self._light_fleet.stop()
 

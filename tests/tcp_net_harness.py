@@ -144,6 +144,9 @@ async def make_tcp_node(
     registry = cmtmetrics.Registry()
     switch.metrics = cmtmetrics.P2PMetrics(registry)
     ev_pool.metrics = cmtmetrics.EvidenceMetrics(registry)
+    # consensus metrics too: gossip-accounting tests read the vote
+    # sent/needed counters per node
+    cs.metrics = cmtmetrics.ConsensusMetrics(registry)
     cs.misbehavior_hook = switch.report_misbehavior
     return TcpNode(
         name=name, cs=cs, conns=conns, mempool=mempool, block_store=block_store,
@@ -159,6 +162,7 @@ async def make_tcp_net(
     chain_id: str = "tcp-test-chain",
     fuzz_config=None,
     scorer_factory=None,
+    configs: list[ConsensusConfig] | None = None,
 ) -> TcpNet:
     privs = [ed25519.gen_priv_key() for _ in range(n_vals)]
     gdoc = GenesisDoc(
@@ -173,8 +177,11 @@ async def make_tcp_net(
     net = TcpNet(privs=privs, chain_id=chain_id)
     cfg = config or make_test_config()
     for i in range(n_vals):
+        # `configs` overrides per node (mixed-fleet tests: one node on a
+        # different gossip capability set)
+        node_cfg = configs[i] if configs is not None else cfg
         node = await make_tcp_node(
-            f"val{i}", privs[i], gdoc, cfg, fuzz_config=fuzz_config,
+            f"val{i}", privs[i], gdoc, node_cfg, fuzz_config=fuzz_config,
             scorer=scorer_factory() if scorer_factory is not None else None)
         net.nodes.append(node)
     return net

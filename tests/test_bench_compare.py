@@ -138,6 +138,51 @@ class TestDirectionAwareCompare:
         assert bc.compare(rec, rec)["verdict"] == "pass"
         assert bc.compare(worse, rec)["verdict"] == "pass"
 
+    def test_gossip_amplification_is_enforced_lower_better(self):
+        """Gossip-plane sentinel wiring (ISSUE 12): amplification rising
+        past 25% fails; falling (reconciliation improving) passes; the
+        fleet-rate and heal-latency curves are informational with a
+        stated why."""
+        old = _record(gossip_votes_per_vote_needed=1.2,
+                      fleet_heights_per_s_50node=1.5,
+                      partition_heal_p99_ms=900.0)
+        worse = _record(gossip_votes_per_vote_needed=1.8,
+                        fleet_heights_per_s_50node=0.4,
+                        partition_heal_p99_ms=9000.0)
+        v = bc.compare(old, worse)
+        assert "gossip_votes_per_vote_needed" in v["regressions"]
+        assert v["regressions"] == ["gossip_votes_per_vote_needed"]
+        assert bc.compare(worse, old)["verdict"] == "pass"
+        for name, why in (("fleet_heights_per_s_50node", "quiet round"),
+                          ("partition_heal_p99_ms", "heal latency")):
+            row = v["metrics"][name]
+            assert row["verdict"] == "info"
+            assert why in row["why_info"]
+
+    def test_gossip_sentinel_self_test_case(self):
+        """--self-test contract on a gossip-fleet-shaped record: the
+        injected amplification regression is flagged; identical and
+        improved snapshots are not."""
+        rec = _record(gossip_votes_per_vote_needed=1.15)
+        worse, metric, pct = bc.inject_regression(
+            rec, metric="gossip_votes_per_vote_needed")
+        assert metric == "gossip_votes_per_vote_needed" and pct > 25.0
+        caught = bc.compare(rec, worse)
+        assert caught["verdict"] == "fail"
+        assert metric in caught["regressions"]
+        assert bc.compare(rec, rec)["verdict"] == "pass"
+        assert bc.compare(worse, rec)["verdict"] == "pass"
+
+    def test_fleet_curve_leaves_are_informational(self):
+        """Nested fleet curve values (fleet.curve.<n>.*) flatten into
+        dotted names that are NOT tracked — they must report as info,
+        never fail a run."""
+        old = _record(fleet={"curve": {"16": {"heights_per_s": 2.0}}})
+        worse = _record(fleet={"curve": {"16": {"heights_per_s": 0.1}}})
+        v = bc.compare(old, worse)
+        assert v["verdict"] == "pass"
+        assert v["metrics"]["fleet.curve.16.heights_per_s"]["verdict"] == "info"
+
 
 class TestSnapshotShapes:
     def test_driver_record_with_parsed(self):

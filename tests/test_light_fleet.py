@@ -837,3 +837,94 @@ class TestFleetSoak:
             await fleet.stop()
 
         asyncio.run(main())
+
+
+# ------------------------------------------------- event-driven head
+
+
+class TestEventDrivenHead:
+    def test_notify_height_wakes_watcher_without_poll(self):
+        """PR 12 satellite (PR 11 residual): with an effectively-disabled
+        poll interval, notify_height alone must drive the stream — and
+        the watcher must consume the NOTIFIED height without a head
+        poll fetch for it."""
+
+        async def main():
+            chain = LightChain(CHAIN_ID, 30, n_vals=3)
+            primary = CountingProvider(
+                CHAIN_ID, {h: chain.blocks[h] for h in range(1, 26)},
+                name="primary")
+            fleet = light.LightFleet(
+                CHAIN_ID, primary,
+                light.TrustOptions(period_ns=PERIOD_NS, height=1,
+                                   hash_=chain.blocks[1].hash()),
+                cache_capacity=64, skip_base=4,
+                trust_period_ns=PERIOD_NS, subscriber_queue=16,
+                poll_interval=30.0)  # poll fallback can't fire in-test
+            await fleet.initialize()
+            sub = fleet.subscribe("evt", from_height=26)
+            # let the watcher take its ONE anchoring poll and block on
+            # the (long) event wait
+            await asyncio.sleep(0.1)
+            polls_before = fleet._watcher_polls
+            got = []
+
+            async def pump():
+                while len(got) < 2:
+                    got.append(await sub.next())
+
+            pump_task = asyncio.ensure_future(pump())
+            for h in (26, 27):
+                primary.blocks[h] = chain.blocks[h]
+                fleet.notify_height(h)
+                await asyncio.sleep(0.05)
+            await asyncio.wait_for(pump_task, 5)
+            assert [lb.height for lb in got] == [26, 27]
+            # the event ticks consumed the notified height — no new
+            # head polls were needed to learn it
+            assert fleet._watcher_polls == polls_before
+            assert fleet.health()["head_notifications"] >= 2
+            await fleet.stop()
+
+        asyncio.run(main())
+
+    def test_event_bus_bridge_feeds_notify(self):
+        """The rpc Environment bridges NewBlock events into
+        notify_height; closing the environment tears the pump down."""
+
+        async def main():
+            from cometbft_tpu.rpc.core import Environment
+            from cometbft_tpu.types.event_bus import EventBus
+
+            chain = LightChain(CHAIN_ID, 10, n_vals=3)
+            fleet, _ = _make_fleet(chain, poll_interval=30.0)
+            await fleet.initialize()
+
+            class _Shim:
+                event_bus = EventBus()
+
+            env = Environment(_Shim())
+            env._attach_head_events(fleet)
+            assert env._fleet_head_sub is not None
+            fleet.subscribe("bridge")  # arms the watcher + head event
+            await asyncio.sleep(0.05)
+
+            class _Header:
+                height = 9
+
+            class _Block:
+                header = _Header()
+
+            await _Shim.event_bus.publish_event_new_block(
+                _Block(), None, None)
+            await asyncio.sleep(0.1)
+            assert fleet.head_notifications >= 1
+            assert fleet._notified_height == 9
+            sub = env._fleet_head_sub
+            await env.close()
+            assert env._fleet_head_sub is None
+            assert sub.canceled is not None
+            await asyncio.sleep(0.05)  # pump task drains and exits
+            await fleet.stop()
+
+        asyncio.run(main())

@@ -27,23 +27,41 @@ def _clean_registry():
 
 class TestParseSpec:
     def test_link_faults(self):
-        cfg, groups, blocks = netchaos.parse_spec(
+        parsed = netchaos.parse_spec(
             "latency=0.05,jitter=0.01,drop=0.1,dup=0.2,reorder=0.3,"
             "bandwidth=65536,seed=7")
+        cfg = parsed.cfg
         assert cfg.latency == 0.05 and cfg.jitter == 0.01
         assert cfg.drop == 0.1 and cfg.dup == 0.2 and cfg.reorder == 0.3
         assert cfg.bandwidth == 65536 and cfg.seed == 7
-        assert groups == {} and blocks == set()
+        assert parsed.groups == {} and parsed.blocks == set()
 
     def test_partition_and_blocks(self):
-        _, groups, blocks = netchaos.parse_spec(
-            "partition=aa.bb|cc.dd,block=ee>ff")
+        parsed = netchaos.parse_spec("partition=aa.bb|cc.dd,block=ee>ff")
+        groups, blocks = parsed.groups, parsed.blocks
         assert groups["aa"] == groups["bb"] != groups["cc"] == groups["dd"]
         assert blocks == {("ee", "ff")}
+
+    def test_profiles_regions_links(self):
+        parsed = netchaos.parse_spec(
+            "profile.wan=latency:0.04;jitter:0.02;drop:0.005,"
+            "profile.lan=latency:0.001,"
+            "region=aa:r0,region=bb:r1,link.r0-r1=wan,link.r0-r0=lan,"
+            "link.default=wan")
+        assert parsed.profiles["wan"].latency == 0.04
+        assert parsed.profiles["wan"].drop == 0.005
+        assert parsed.profiles["lan"].latency == 0.001
+        assert parsed.regions == {"aa": "r0", "bb": "r1"}
+        assert parsed.links[("r0", "r1")] == "wan"
+        assert parsed.links[("r0", "r0")] == "lan"
+        assert parsed.default_link == "wan"
 
     @pytest.mark.parametrize("bad", [
         "latency", "latency=", "latency=x", "latency=-1", "nope=1",
         "partition=", "block=aa", "block=>bb",
+        "profile.=latency:0.1", "profile.wan=nope:1", "profile.wan=latency",
+        "region=aa", "region=:r0", "link.r0=wan", "link.r0-r1=ghost",
+        "link.default=ghost",
     ])
     def test_malformed_specs_raise(self, bad):
         with pytest.raises(ValueError):
@@ -61,6 +79,56 @@ class TestParseSpec:
 
 
 # ------------------------------------------------------------- partitions
+
+
+class TestLinkProfiles:
+    def test_link_config_resolution(self):
+        netchaos.arm_spec(
+            "profile.wan=latency:0.04,profile.lan=latency:0.001,"
+            "region=aa:r0,region=bb:r1,region=cc:r0,"
+            "link.r0-r1=wan,link.r0-r0=lan")
+        assert netchaos.link_config("aa", "bb").latency == 0.04
+        assert netchaos.link_config("bb", "aa").latency == 0.04  # unordered
+        assert netchaos.link_config("aa", "cc").latency == 0.001
+        # unmapped pair with no default -> global config (clean here)
+        assert netchaos.link_config("aa", "zz") is None
+        assert netchaos.region_of("aa") == "r0"
+        snap = netchaos.snapshot()
+        assert snap["regions"]["aa"] == "r0"
+        assert snap["region_links"]["r0-r1"] == "wan"
+        assert snap["profiles"]["wan"]["latency"] == 0.04
+
+    def test_default_link_and_global_fallback(self):
+        netchaos.arm_spec(
+            "latency=0.2,profile.wan=latency:0.05,"
+            "region=aa:r0,region=bb:r1,link.default=wan")
+        assert netchaos.link_config("aa", "bb").latency == 0.05
+        # a node without a region falls back to the global link config
+        assert netchaos.link_config("aa", "zz").latency == 0.2
+
+    def test_profile_applies_on_the_conn(self):
+        """A cross-region write pays the profile's delay; an intra-region
+        write does not (the regional-topology latency shape)."""
+        netchaos.arm_spec(
+            "profile.wan=latency:0.05,region=me:r0,region=far:r1,"
+            "region=near:r0,link.r0-r1=wan")
+        import time
+
+        far = netchaos.ChaosConn(_FakeConn(), "me", "far")
+        near = netchaos.ChaosConn(_FakeConn(), "me", "near")
+
+        async def main():
+            t0 = time.monotonic()
+            await near.write(b"x")
+            intra = time.monotonic() - t0
+            t0 = time.monotonic()
+            await far.write(b"x")
+            cross = time.monotonic() - t0
+            return intra, cross
+
+        intra, cross = asyncio.run(main())
+        assert cross >= 0.05 > intra
+        assert netchaos.snapshot()["stats"]["delayed"] >= 1
 
 
 class TestPartitionMap:

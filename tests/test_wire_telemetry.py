@@ -261,6 +261,92 @@ class TestPeerLabelCardinality:
         assert len(other) == 1
         assert float(other[0].rsplit(" ", 1)[1]) == 48 * 100
 
+    def test_churn_storm_past_cap_stays_bounded(self):
+        """ISSUE 12 satellite: a churn storm cycling hundreds of peers
+        through a capped ledger must not grow the label maps OR the
+        exposition without bound — late peers fold into "other" even as
+        slots keep turning over."""
+        reg = cmtmetrics.Registry()
+        m = cmtmetrics.P2PMetrics(reg, peer_cap=4)
+        for i in range(300):  # connect -> traffic -> disconnect, rolling
+            nid = f"{i:02d}"[:2] * 20
+            label = m.peer_label(nid)
+            m.record_conn_traffic(label, {0x22: (10, 1)}, send=True)
+            m.release_peer(nid)
+        stats = m.peer_label_stats()
+        assert stats["owners"] == 0
+        assert stats["released"] <= 4
+        assert stats["minted"] <= stats["mint_cap"] == 8
+        series = [ln for ln in reg.render().splitlines()
+                  if ln.startswith("cometbft_p2p_peer_send_bytes_total{")]
+        # at most mint_cap labeled series + one "other" bucket
+        assert len(series) <= 8 + 1
+        other = [ln for ln in series if 'peer="other"' in ln]
+        assert other and float(other[0].rsplit(" ", 1)[1]) > 0
+
+    def test_released_label_reclaimed_after_ban_expiry(self):
+        """A banned peer's slot frees for others; when the ban expires
+        and it redials, it gets its ORIGINAL label back — its series
+        continues instead of minting a new one."""
+        reg = cmtmetrics.Registry()
+        m = cmtmetrics.P2PMetrics(reg, peer_cap=2)
+        a, b, c = ("aa" * 20, "bb" * 20, "cc" * 20)
+        la = m.peer_label(a)
+        lb = m.peer_label(b)
+        assert m.peer_label(c) == "other"  # cap full
+        m.release_peer(a)  # banned
+        # the freed slot admits the next NEW peer (mint cap permitting)
+        lc = m.peer_label(c)
+        assert lc == c[:10]
+        # ban expired: a returns and re-claims its original label even
+        # though owners are momentarily past the live cap
+        assert m.peer_label(a) == la
+        assert m.peer_label(b) == lb
+        stats = m.peer_label_stats()
+        assert stats["minted"] == 3 <= stats["mint_cap"]
+
+    def test_mint_cap_holds_under_release_churn(self):
+        """Past the mint cap, freed slots must NOT mint new labels —
+        persisted series of released peers already occupy the
+        exposition budget."""
+        reg = cmtmetrics.Registry()
+        m = cmtmetrics.P2PMetrics(reg, peer_cap=2)
+        ids = [f"{i}{i}" * 20 for i in range(10)]
+        minted = 0
+        for nid in ids:
+            if m.peer_label(nid) != "other":
+                minted += 1
+            m.release_peer(nid)
+        assert minted == m.mint_cap == 4
+        # everything after folds into other, forever
+        assert m.peer_label("ff" * 20) == "other"
+        # but an OLD released peer still re-claims its own label
+        assert m.peer_label(ids[3]) == ids[3][:10]
+
+    def test_switch_releases_label_on_peer_stop(self):
+        """The Switch frees the slot when a peer stops: stop a live
+        peer, its slot turns over."""
+        from test_p2p import make_switch_pair, wait_until
+
+        async def main():
+            s1, s2, _, _, addr2 = await make_switch_pair()
+            reg = cmtmetrics.Registry()
+            s1.metrics = cmtmetrics.P2PMetrics(reg, peer_cap=4)
+            try:
+                await s1.dial_peers_async([addr2])
+                await wait_until(lambda: s1.n_peers() and s2.n_peers())
+                peer = next(iter(s1.peers.values()))
+                s1.metrics.peer_label(peer.id)
+                assert s1.metrics.peer_label_stats()["owners"] == 1
+                await s1.stop_peer_for_error(peer, "test stop", score=0.0)
+                st = s1.metrics.peer_label_stats()
+                assert st["owners"] == 0 and st["released"] == 1
+            finally:
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(main())
+
     def test_record_conn_traffic_directions(self):
         reg = cmtmetrics.Registry()
         m = cmtmetrics.P2PMetrics(reg, peer_cap=4)
