@@ -132,6 +132,13 @@ class CryptoConfig:
     # device memory; one row is reserved for the padding identity).
     # Must fit a uint16 index: [64, 65536]
     wire_table_rows: int = 16384
+    # --- BLS12-381 aggregate-signature scheme (crypto/bls12381.py) ---
+    # the third verify-plane scheme: 48 B G1 pubkeys, 96 B G2 sigs,
+    # aggregate commit verify (one pairing-product check per commit) and
+    # batched single-verify through the scheduler. Off = a BLS key
+    # reaching the batch seam raises a LOUD ErrInvalidKey naming this
+    # knob (never a silent CPU fallback — the light-proxy https rule)
+    bls_enabled: bool = True
     # --- device-fault supervision (ops/dispatch.py DeviceSupervisor) ---
     # transient failures: retries per dispatch, with backoff doubling from
     # retry_backoff_base up to retry_backoff_cap (plus jitter)

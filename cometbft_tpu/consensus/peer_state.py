@@ -210,6 +210,15 @@ class PeerState:
         prs.start_time = cmttime.now().add_seconds(-msg.seconds_since_start_time)
 
         if start_height != msg.height or start_round != msg.round_:
+            # RE-ARM the vote-summary send (PR 12 residual): the
+            # send-first routine suppresses resends while OUR view is
+            # unchanged, but a summary sent while this peer was on an
+            # earlier round was dropped as "stale" on its side — when
+            # the peer arrives at a new (height, round) the next summary
+            # tick must send again so a multi-round height repairs the
+            # peer's vote view for the CURRENT round, not just the round
+            # it happened to be on at connect time.
+            self.last_summary_sent = None
             prs.proposal = False
             prs.proposal_block_part_set_header = PartSetHeader()
             prs.proposal_block_parts = None

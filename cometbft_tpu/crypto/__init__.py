@@ -21,6 +21,12 @@ from abc import ABC, abstractmethod
 # (reference: crypto/crypto.go:8-17, crypto/tmhash).
 ADDRESS_SIZE = 20
 
+# Wire cap on signature bytes in votes/commits/proposals. The reference
+# pins 64 (ed25519/sr25519); BLS12-381 G2 signatures are 96 bytes, so
+# the cap is the max over registered schemes — validate_basic callers
+# share this constant instead of baking the ed25519 size.
+MAX_SIGNATURE_SIZE = 96
+
 
 class PubKey(ABC):
     @abstractmethod

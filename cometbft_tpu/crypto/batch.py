@@ -118,15 +118,39 @@ def configure(crypto_cfg) -> None:
         enabled=crypto_cfg.wire_indexed_sends,
         rows=crypto_cfg.wire_table_rows,
     )
+    from cometbft_tpu.crypto import bls12381
+
+    bls12381.set_enabled(crypto_cfg.bls_enabled)
     if crypto_cfg.chaos:
         from cometbft_tpu.libs import chaos
 
         chaos.arm_spec(crypto_cfg.chaos)
 
 
+def _check_bls_enabled(key_type: str) -> None:
+    """A BLS key arriving with crypto.bls_enabled off is a CONFIGURATION
+    error and must fail loudly (the light-proxy https-refusal rule) —
+    a silent CPU fallback would hide that aggregate commit verification
+    is off while the validator set expects it."""
+    if key_type != "bls12381":
+        return
+    from cometbft_tpu.crypto import bls12381
+
+    if not bls12381.enabled():
+        raise crypto.ErrInvalidKey(
+            "bls12381 key reached the batch-verify seam but the scheme is "
+            "disabled (crypto.bls_enabled = false); enable it in config "
+            "or remove BLS keys from the validator set")
+
+
 def supports_batch_verifier(pub_key: crypto.PubKey | None) -> bool:
-    """reference: crypto/batch/batch.go:26-32 — secp256k1 has no batch path."""
-    return pub_key is not None and pub_key.type_() in _REGISTRY
+    """reference: crypto/batch/batch.go:26-32 — secp256k1 has no batch
+    path. Raises ErrInvalidKey (not False) for a BLS key while
+    crypto.bls_enabled is off: misconfiguration must be loud."""
+    if pub_key is None:
+        return False
+    _check_bls_enabled(pub_key.type_())
+    return pub_key.type_() in _REGISTRY
 
 
 def create_batch_verifier(pub_key: crypto.PubKey) -> crypto.BatchVerifier:
@@ -172,6 +196,7 @@ class MixedBatchVerifier(crypto.BatchVerifier):
 
     def add(self, pub_key: crypto.PubKey, msg: bytes, sig: bytes) -> None:
         kt = pub_key.type_()
+        _check_bls_enabled(kt)
         sub = self._subs.get(kt)
         if sub is None:
             backends = _REGISTRY.get(kt)
@@ -214,7 +239,8 @@ class ScheduledBatchVerifier(crypto.BatchVerifier):
     Mixed key types are accepted — the scheduler groups rows per scheme
     into per-scheme device sub-batches resolved with one fetch."""
 
-    SIGNATURE_SIZE = 64
+    # per-scheme signature sizes (BLS G2 signatures are 96 bytes)
+    SIGNATURE_SIZES = {"ed25519": 64, "sr25519": 64, "bls12381": 96}
 
     def __init__(self, klass: str | None = None):
         from cometbft_tpu import sched
@@ -223,10 +249,12 @@ class ScheduledBatchVerifier(crypto.BatchVerifier):
         self._rows: list[tuple[crypto.PubKey, bytes, bytes]] = []
 
     def add(self, pub_key: crypto.PubKey, msg: bytes, sig: bytes) -> None:
-        if pub_key.type_() not in _REGISTRY:
+        kt = pub_key.type_()
+        _check_bls_enabled(kt)
+        if kt not in _REGISTRY:
             raise crypto.ErrInvalidKey(
-                f"key type {pub_key.type_()!r} has no batch verifier")
-        if len(sig) != self.SIGNATURE_SIZE:
+                f"key type {kt!r} has no batch verifier")
+        if len(sig) != self.SIGNATURE_SIZES.get(kt, 64):
             raise crypto.ErrInvalidSignature("bad signature length")
         # shared-prefix rows (libs/prefixrows.py) ride to the scheduler
         # factored — kernel staging broadcasts each run's prefix once
@@ -274,7 +302,21 @@ def _cpu_sr25519_factory() -> crypto.BatchVerifier:
     return sr25519.CPUBatchVerifier()
 
 
+def _tpu_bls_factory() -> crypto.BatchVerifier:
+    from cometbft_tpu.ops.batch_verifier import BlsTPUBatchVerifier
+
+    return BlsTPUBatchVerifier()
+
+
+def _cpu_bls_factory() -> crypto.BatchVerifier:
+    from cometbft_tpu.crypto import bls12381
+
+    return bls12381.CPUBatchVerifier()
+
+
 register(ed25519.KEY_TYPE, "cpu", ed25519.CPUBatchVerifier)
 register(ed25519.KEY_TYPE, "tpu", _tpu_ed25519_factory)
 register("sr25519", "cpu", _cpu_sr25519_factory)
 register("sr25519", "tpu", _tpu_sr25519_factory)
+register("bls12381", "cpu", _cpu_bls_factory)
+register("bls12381", "tpu", _tpu_bls_factory)

@@ -1,0 +1,218 @@
+"""BLS12-381 keys (min-pubkey-size variant: 48 B G1 pubkeys, 96 B G2
+signatures) — the third verify-plane scheme.
+
+Motivation (PAPERS.md, "Performance of EdDSA and BLS Signatures in
+Committee-Based Consensus"): BLS aggregation makes commit size and verify
+cost ~independent of committee size — a mega-commit decides with ONE
+pairing-product check instead of one lane-verify per validator
+(types/validation.py wires the aggregate path; ops/bls_kernel.py carries
+the batched single-verify path through the scheduler/mesh like ed25519
+and sr25519).
+
+Signing and the exact CPU verification oracle live in crypto/fallback.py
+(pure-Python pairing, self-calibrating derived constants). Aggregation
+semantics are the proof-of-possession flavor: validator sets are
+registered keys, so identical sign-bytes across signers aggregate their
+pubkeys (FastAggregateVerify-style) instead of being rejected for
+non-distinctness. The hash-to-curve suite follows the
+draft-irtf-cfrg-hash-to-curve pipeline with the generic SvdW map and
+therefore carries its own DST (see fallback.py for why the registered
+ciphersuite's 3-isogeny constants are not reproduced here).
+
+Enablement: the scheme registers with crypto/batch only when
+`crypto.bls_enabled` is on (the default). With it off, a BLS key
+reaching the batch seam raises a LOUD ErrInvalidKey naming the knob —
+misconfiguration must never silently fall back (the light-proxy https
+refusal rule).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+
+from cometbft_tpu import crypto
+from cometbft_tpu.crypto import fallback as _bls
+from cometbft_tpu.crypto import tmhash
+
+KEY_TYPE = "bls12381"
+PUB_KEY_SIZE = 48
+PRIV_KEY_SIZE = 32
+SIGNATURE_SIZE = 96
+
+# Domain separation tag. The suite string is honest about the map in use:
+# the pipeline is draft-structured (expand_message_xmd/SHA-256 ->
+# hash_to_field -> map -> clear_cofactor) with the generic SvdW map of
+# RFC 9380 §6.6.1 rather than the registered G2 SSWU isogeny suite.
+DST = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SVDW_RO_CBFT_"
+
+_enabled = True
+
+
+def set_enabled(on: bool) -> None:
+    """Applied from config.crypto.bls_enabled at node boot
+    (crypto/batch.configure)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+class PubKey(crypto.PubKey):
+    __slots__ = ("_bytes", "_valid")
+
+    def __init__(self, data: bytes):
+        if len(data) != PUB_KEY_SIZE:
+            raise crypto.ErrInvalidKey(
+                f"bls12381 pubkey must be {PUB_KEY_SIZE} bytes")
+        self._bytes = bytes(data)
+        self._valid: bool | None = None  # KeyValidate result, lazy
+
+    def address(self) -> bytes:
+        return tmhash.sum_truncated(self._bytes)
+
+    def bytes_(self) -> bytes:
+        return self._bytes
+
+    def type_(self) -> str:
+        return KEY_TYPE
+
+    def key_validate(self) -> bool:
+        """Draft KeyValidate: decodes, subgroup-checks, and rejects the
+        infinity (zero) pubkey. Cached — validator sets re-verify every
+        height."""
+        if self._valid is None:
+            self._valid = _bls.bls_pubkey_validate(self._bytes)
+        return self._valid
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        if type(msg) is not bytes:
+            msg = bytes(msg)  # shared-prefix factored rows (prefixrows)
+        if not self.key_validate():
+            return False
+        return _bls.bls_verify(self._bytes, msg, sig, DST)
+
+    def __repr__(self) -> str:
+        return f"PubKeyBLS12381{{{self._bytes.hex().upper()}}}"
+
+
+class PrivKey(crypto.PrivKey):
+    __slots__ = ("_bytes", "_sk", "_pub")
+
+    def __init__(self, data: bytes):
+        if len(data) != PRIV_KEY_SIZE:
+            raise crypto.ErrInvalidKey("bls12381 privkey must be 32 bytes")
+        self._bytes = bytes(data)
+        self._sk = int.from_bytes(self._bytes, "big") % _bls.BLS_R
+        if self._sk == 0:
+            raise crypto.ErrInvalidKey("bls12381 privkey is zero mod r")
+        self._pub = PubKey(_bls.bls_pub_from_priv(self._sk))
+
+    def bytes_(self) -> bytes:
+        return self._bytes
+
+    def sign(self, msg: bytes) -> bytes:
+        if type(msg) is not bytes:
+            msg = bytes(msg)
+        return _bls.bls_sign(self._sk, msg, DST)
+
+    def pub_key(self) -> PubKey:
+        return self._pub
+
+    def type_(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key() -> PrivKey:
+    while True:
+        data = secrets.token_bytes(PRIV_KEY_SIZE)
+        if int.from_bytes(data, "big") % _bls.BLS_R:
+            return PrivKey(data)
+
+
+def gen_priv_key_from_secret(secret: bytes) -> PrivKey:
+    """Deterministic key from a secret (testing only)."""
+    return PrivKey(hashlib.sha256(secret).digest())
+
+
+def aggregate_signatures(sigs: list[bytes]) -> bytes:
+    """One 96-byte aggregate from per-vote signatures (each individually
+    subgroup-checked; infinity and garbage raise ValueError)."""
+    return _bls.bls_aggregate(sigs)
+
+
+def aggregate_verify(pubs: list[bytes], msgs: list[bytes],
+                     sig: bytes) -> bool:
+    """The one-pairing-product commit check (PoP flavor: repeated
+    messages aggregate their pubkeys). See fallback.bls_aggregate_verify
+    for the exact rejection semantics (infinity pubkey/signature, wrong
+    subgroup, cancelled pubkey group)."""
+    return _bls.bls_aggregate_verify(
+        [bytes(p) for p in pubs], [bytes(m) for m in msgs], bytes(sig), DST)
+
+
+class CPUBatchVerifier(crypto.BatchVerifier):
+    """CPU batched single-verify: a random-linear-combination check with
+    ONE shared final exponentiation —
+
+        e(-g1, sum [r_i] sig_i) * prod e([r_i] pk_i, H(m_i)) == 1
+
+    with fresh 128-bit blinders r_i (a forged row passes only with
+    probability 2^-128). On failure the verifier pinpoints per-lane with
+    serial exact verifies, mirroring the device kernels' mask contract."""
+
+    def __init__(self) -> None:
+        self._items: list[tuple[PubKey, bytes, bytes]] = []
+
+    def add(self, pub_key: crypto.PubKey, msg: bytes, sig: bytes) -> None:
+        if not isinstance(pub_key, PubKey):
+            raise crypto.ErrInvalidKey(
+                "bls12381 batch verifier got non-bls12381 key")
+        if len(sig) != SIGNATURE_SIZE:
+            raise crypto.ErrInvalidSignature("bad signature length")
+        self._items.append((pub_key, msg, sig))
+
+    def count(self) -> int:
+        return len(self._items)
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        n = len(self._items)
+        if n == 0:
+            return True, []
+        if self._combined_check():
+            return True, [True] * n
+        mask = [pk.verify_signature(m, s) for pk, m, s in self._items]
+        return all(mask), mask
+
+    def _combined_check(self) -> bool:
+        f = _bls
+        sig_acc = None
+        pairs = []
+        h_cache: dict[bytes, tuple] = {}
+        for pk, msg, sig in self._items:
+            if not pk.key_validate():
+                return False
+            sig_aff = f.bls_signature_validate(sig)
+            if sig_aff is None:
+                return False
+            r = secrets.randbits(128) | 1
+            sig_acc = f._ec_add(
+                f._Fp2Ops, sig_acc,
+                f._ec_mul(f._Fp2Ops, r, f._ec_from_affine(sig_aff)))
+            msg_b = bytes(msg)
+            h = h_cache.get(msg_b)
+            if h is None:
+                h = f.bls_hash_to_g2(msg_b, DST)
+                h_cache[msg_b] = h
+            pk_r = f._ec_affine(f._FpOps, f._ec_mul(
+                f._FpOps, r, f._ec_from_affine(f.bls_g1_decompress(pk.bytes_()))))
+            pairs.append((pk_r, h))
+        agg_sig = f._ec_affine(f._Fp2Ops, sig_acc)
+        if agg_sig is None:
+            return False
+        pairs.append((f._NEG_G1, agg_sig))
+        return f.bls_pairing_product_is_one(pairs)

@@ -67,6 +67,48 @@ DEFAULT_CAPACITY = 4096
 DEFAULT_SKIP_BASE = 16
 _MAX_LANES = 8  # skip_base^8 heights dwarf any real chain
 
+# ---------------------------------------------------------------------------
+# Per-chain shared checkpoint cache (PR 11 residual, landed PR 13): the
+# fleet's skip-list cache and the STATESYNC light client share verified
+# checkpoints. A statesync bootstrap that runs before the fleet exists
+# seeds the cache the fleet later serves from; a fleet that ran first
+# spares statesync its cold bisections (node/node.py points the statesync
+# client's checkpoint_source here and tees its verified blocks back in).
+# First creation's parameters win — later callers get the same instance
+# regardless of knobs (one cache per chain per process is the point).
+# ---------------------------------------------------------------------------
+
+import threading as _threading
+
+_SHARED_CACHES: dict[str, "CheckpointCache"] = {}
+_SHARED_LOCK = _threading.Lock()
+
+
+def shared_cache(chain_id: str, *, capacity: int | None = None,
+                 trust_period_ns: int | None = None,
+                 skip_base: int | None = None) -> "CheckpointCache":
+    """The process-level checkpoint cache for `chain_id` (created on
+    first use with the caller's parameters)."""
+    with _SHARED_LOCK:
+        cache = _SHARED_CACHES.get(chain_id)
+        if cache is None:
+            kwargs = {}
+            if capacity is not None:
+                kwargs["capacity"] = capacity
+            if trust_period_ns is not None:
+                kwargs["trust_period_ns"] = trust_period_ns
+            if skip_base is not None:
+                kwargs["skip_base"] = skip_base
+            cache = CheckpointCache(**kwargs)
+            _SHARED_CACHES[chain_id] = cache
+        return cache
+
+
+def reset_shared_caches() -> None:
+    """Test hook: drop every per-chain shared cache."""
+    with _SHARED_LOCK:
+        _SHARED_CACHES.clear()
+
 
 class FleetSaturated(LightClientError):
     """Unique-verification admission rejected: the fleet already runs

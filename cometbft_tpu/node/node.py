@@ -317,6 +317,30 @@ class Node(BaseService):
             providers = [
                 RPCProvider(genesis_doc.chain_id, url) for url in ss.rpc_servers
             ]
+            # fold statesync onto the fleet's shared checkpoint cache
+            # (PR 11 residual): bisections start/fast-forward from any
+            # checkpoint the serving plane already verified, and every
+            # statesync-verified block seeds the cache for the fleet
+            from cometbft_tpu.light.fleet import shared_cache
+
+            ckpt_cache = shared_cache(
+                genesis_doc.chain_id,
+                capacity=config.light.fleet_cache_capacity,
+                trust_period_ns=int(ss.trust_period * 1e9),
+                skip_base=config.light.fleet_skip_base,
+            )
+
+            class _TeeingLightStore(LightStore):
+                """Statesync trust store that tees every verified block
+                into the shared checkpoint cache."""
+
+                def save_light_block(self, lb):  # noqa: D102
+                    super().save_light_block(lb)
+                    try:
+                        ckpt_cache.put(lb)
+                    except Exception:  # noqa: BLE001 - cache is a bonus
+                        pass
+
             lc = LightClient(
                 genesis_doc.chain_id,
                 TrustOptions(
@@ -324,9 +348,16 @@ class Node(BaseService):
                     height=ss.trust_height,
                     hash_=bytes.fromhex(ss.trust_hash),
                 ),
-                providers[0], providers[1:], LightStore(MemDB()),
+                providers[0], providers[1:], _TeeingLightStore(MemDB()),
                 logger=self.logger.with_fields(module="light"),
             )
+            _own_source = lc.checkpoint_source
+
+            def _cached_source(h, _own=_own_source, _c=ckpt_cache):
+                hit = _c.nearest_at_or_below(h)
+                return hit if hit is not None else _own(h)
+
+            lc.checkpoint_source = _cached_source
             self._statesync_light_client = lc
             state_provider = LightClientStateProvider(
                 lc, initial_height=state.initial_height,

@@ -80,3 +80,41 @@ class SrTPUBatchVerifier(crypto.BatchVerifier):
 
     def count(self) -> int:
         return len(self._sigs)
+
+
+class BlsTPUBatchVerifier(crypto.BatchVerifier):
+    """BLS12-381 batched single-verify on the device (ops/bls_kernel.py:
+    one 2B-wide Miller loop + vectorized final exponentiations). 96-byte
+    G2 signatures; the aggregate commit path lives in
+    bls_kernel.aggregate_verify, not behind this per-lane seam."""
+
+    SIGNATURE_SIZE = 96
+
+    def __init__(self):
+        self._pubs: list[bytes] = []
+        self._msgs: list[bytes] = []
+        self._sigs: list[bytes] = []
+
+    def add(self, pub_key: crypto.PubKey, msg: bytes, sig: bytes) -> None:
+        if pub_key.type_() != "bls12381":
+            raise crypto.ErrInvalidKey(
+                "bls12381 tpu batch verifier requires bls12381 keys")
+        if len(sig) != self.SIGNATURE_SIZE:
+            raise crypto.ErrInvalidSignature("bad signature length")
+        self._pubs.append(pub_key.bytes_())
+        self._msgs.append(bytes(msg))
+        self._sigs.append(bytes(sig))
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        from cometbft_tpu.ops import bls_kernel
+
+        return bls_kernel.verify_batch(self._pubs, self._msgs, self._sigs)
+
+    def verify_async(self):
+        from cometbft_tpu.ops import bls_kernel
+
+        return bls_kernel.verify_batch_async(
+            self._pubs, self._msgs, self._sigs)
+
+    def count(self) -> int:
+        return len(self._sigs)

@@ -1,0 +1,14 @@
+"""Vectorized BLS12-381 arithmetic on the batch axis.
+
+The scheme's device plane, built in the style of ops/field.py /
+ops/curve.py: packed-limb Fp (fp.py), the Fp2/Fp6/Fp12 towers
+(fp2.py, tower.py), G1/G2 in complete projective coordinates with batch
+add/double/fixed-scalar ladders (curve.py), the optimal-ate Miller loop
+and final exponentiation (pairing.py), and the hash-to-curve pipeline
+(htc.py). One lane = one field element / point / pairing; the limb axis
+is major so the batch axis lands on vector lanes, exactly like the
+ed25519 kernel's layout.
+
+The host twin for every function here is the pure-Python oracle in
+crypto/fallback.py — tests/test_ops_bls.py asserts bit-consistency.
+"""

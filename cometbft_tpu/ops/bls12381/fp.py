@@ -1,0 +1,347 @@
+"""The BLS12-381 base field on TPU vector lanes.
+
+Representation: radix-2^11, 35 limbs (385 bits), little-endian, int32,
+LIMB-AXIS FIRST — an Fp batch is shape (35, B), one vector lane per
+element, mirroring ops/field.py. Unlike 2^255 - 19 the BLS prime is not
+pseudo-Mersenne, so reduction is MONTGOMERY (R = 2^385): every stored
+element is in the Montgomery domain and mul() is a schoolbook limb
+convolution followed by a CIOS-style REDC sweep (fori_loop bodies, so
+the Miller-loop scan's HLO stays bounded).
+
+Invariant ("carried"): limbs in [0, ~2^12), value REDUNDANT mod p. The
+2^385 overflow of carries folds back through the constant R mod p — the
+general-modulus analog of field.py's FOLD = 608 wrap; a residual top
+carry of 1 can persist across rounds (R mod p has full-size limbs), which
+is why the carried bound is 2^12, not 2^11. canon() produces the unique
+representative for comparisons; from_mont() leaves the Montgomery domain.
+
+int32 safety (radix-11 is the headroom choice; radix-12 is one carry away
+from overflow):
+  conv columns:     <= 35 * (2^12)^2            ~= 5.9e8
+  REDC m*N columns: <= 35 * 2047^2              ~= 1.5e8
+  worst REDC col:   conv + m*N + carries        <  7.5e8  <  2^31
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cometbft_tpu.crypto import fallback as _oracle
+
+P_INT = _oracle.BLS_P
+RADIX = 11
+NLIMBS = 35
+MASK = (1 << RADIX) - 1
+R_INT = 1 << (RADIX * NLIMBS)  # 2^385, the Montgomery radix
+R_MOD_P = R_INT % P_INT
+R2_MOD_P = R_INT * R_INT % P_INT
+N0INV = (-pow(P_INT, -1, 1 << RADIX)) % (1 << RADIX)
+# subtraction bias: a multiple of p dominating any carried value
+# (value < 2^387); its top limb overflows 11 bits by design
+M_SUB_INT = P_INT * (-(-(1 << 388) // P_INT))
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    out = np.zeros(NLIMBS, dtype=np.int64)
+    for i in range(NLIMBS - 1):
+        out[i] = x & MASK
+        x >>= RADIX
+    out[NLIMBS - 1] = x
+    assert x < 2**17, "constant too large for the loose top limb"
+    return out.astype(np.int32)
+
+
+def ints_to_limbs(xs) -> np.ndarray:
+    """list[int] -> (35, B) int32 canonical limbs."""
+    out = np.zeros((NLIMBS, len(xs)), dtype=np.int32)
+    for j, x in enumerate(xs):
+        for i in range(NLIMBS):
+            out[i, j] = x & MASK
+            x >>= RADIX
+    return out
+
+
+def limbs_to_ints(a: np.ndarray) -> list[int]:
+    """(35, B) limbs (any carried representation) -> list[int] values."""
+    a = np.asarray(a, dtype=object)
+    out = []
+    for j in range(a.shape[1]):
+        v = 0
+        for i in range(NLIMBS - 1, -1, -1):
+            v = (v << RADIX) + int(a[i, j])
+        out.append(v)
+    return out
+
+
+def _const(x: int) -> jnp.ndarray:
+    return jnp.asarray(int_to_limbs(x))[:, None]
+
+
+P_LIMBS = _const(P_INT)
+R_MOD_P_LIMBS = _const(R_MOD_P)
+R2_LIMBS = _const(R2_MOD_P)
+M_SUB = _const(M_SUB_INT)
+ONE = _const(R_MOD_P)       # 1 in the Montgomery domain
+ONE_RAW = _const(1)         # the raw integer 1 (for from_mont)
+_NPAD = jnp.concatenate(
+    [jnp.asarray(int_to_limbs(P_INT)), jnp.zeros(NLIMBS, jnp.int32)])[:, None]
+
+
+def zeros(b: int) -> jnp.ndarray:
+    return jnp.zeros((NLIMBS, b), dtype=jnp.int32)
+
+
+def _carry_fold(x: jnp.ndarray, rounds: int = 2) -> jnp.ndarray:
+    """Carry rounds with the 2^385 overflow folded back via R mod p (the
+    whole 35-limb constant — a top carry re-enters as c * (R mod p)).
+    Convergence: the fold's top limb is ~2^9, so top carries shrink ~4x
+    per round; a residual carry of 1 keeps limbs under 2^12."""
+    for _ in range(rounds):
+        c = x >> RADIX
+        r = x & MASK
+        x = r + jnp.concatenate(
+            [jnp.zeros_like(c[:1]), c[: NLIMBS - 1]], axis=0)
+        x = x + c[NLIMBS - 1:] * R_MOD_P_LIMBS
+    return x
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _carry_fold(a + b, rounds=2)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _carry_fold(a + M_SUB - b, rounds=3)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return _carry_fold(M_SUB - a, rounds=3)
+
+
+# bias for the fused a - b - c (dominates two carried operands)
+M_SUB2 = _const(P_INT * (-(-(1 << 389) // P_INT)))
+
+
+def sub2(a: jnp.ndarray, b: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
+    """a - b - c in one carry chain (tower-mul glue)."""
+    return _carry_fold(a + M_SUB2 - b - c, rounds=3)
+
+
+def stack(parts) -> jnp.ndarray:
+    """Concatenate operands on the LANE axis — the tower's multiply
+    batching: k independent Fp muls become one k-wide mul, so the HLO op
+    count stays flat while lanes fill (the whole point on a VPU)."""
+    return jnp.concatenate(parts, axis=1)
+
+
+def split(x: jnp.ndarray, k: int):
+    """Undo stack(): split k equal lane groups."""
+    return jnp.split(x, k, axis=1)
+
+
+# the full-width Montgomery constant N' = -p^-1 mod 2^385 (3-conv REDC)
+NPRIME_INT = (-pow(P_INT, -1, R_INT)) % R_INT
+
+
+def _conv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(35, B) x (35, B) -> (70, B) schoolbook product columns: 35
+    statically-rolled multiply-adds (the field.py _conv idiom — plain
+    elementwise HLO compiles and runs an order of magnitude faster here
+    than gather/einsum or grouped-conv formulations; the fori_loop
+    variant compiled the FULL pairing in ~9 minutes, this one in
+    seconds). Callers batch independent multiplies onto the lane axis
+    (fp2/tower stacking) so op count, not op width, stays the budget."""
+    bz = jnp.concatenate([b, jnp.zeros_like(b)], axis=0)
+    acc = a[0:1] * bz
+    for i in range(1, NLIMBS):
+        acc = acc + a[i:i + 1] * jnp.roll(bz, i, axis=0)
+    return acc
+
+
+def _carry_nodrop(x: jnp.ndarray, rounds: int) -> jnp.ndarray:
+    """Partial carry rounds on a full-width column array (no top wrap —
+    the value bound guarantees no carry ever leaves the top column)."""
+    for _ in range(rounds):
+        c = x >> RADIX
+        x = (x & MASK) + jnp.concatenate(
+            [jnp.zeros_like(c[:1]), c[:-1]], axis=0)
+    return x
+
+
+_NPRIME_LIMBS = jnp.asarray(
+    np.stack([int_to_limbs(NPRIME_INT)]).T)  # (35, 1)
+_N_LIMBS_C = P_LIMBS
+
+
+def _redc(t: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery reduction in convolution form: m = (t mod R) * N'
+    mod R, result = (t + m*p) / R. Whole-array partial carries only —
+    the exact division's carry bit falls out of a reduction: after the
+    carries, the low half's value is a multiple of 2^385 bounded below
+    2 * 2^385, i.e. exactly 0 or 2^385, so the carry into the high half
+    is any(low != 0)."""
+    # one spill column: redundant inputs can push the product a hair
+    # past 70 limbs (2^770 * 1.001); its carry must not drop
+    t = jnp.concatenate([t, jnp.zeros_like(t[:1])], axis=0)
+    t = _carry_nodrop(t, 3)
+    m = _conv(t[:NLIMBS],
+              jnp.broadcast_to(_NPRIME_LIMBS, t[:NLIMBS].shape)
+              .astype(jnp.int32))[:NLIMBS]
+    # drop-top carries are multiples of 2^385 — m only matters mod R
+    for _ in range(3):
+        c = m >> RADIX
+        m = (m & MASK) + jnp.concatenate(
+            [jnp.zeros_like(c[:1]), c[:-1]], axis=0)
+    mp = _conv(m, jnp.broadcast_to(_N_LIMBS_C, m.shape).astype(jnp.int32))
+    t = t + jnp.concatenate([mp, jnp.zeros_like(mp[:1])], axis=0)
+    t = _carry_nodrop(t, 3)
+    carry = jnp.any(t[:NLIMBS] != 0, axis=0).astype(jnp.int32)
+    res = t[NLIMBS: 2 * NLIMBS]
+    res = jnp.concatenate([res[:1] + carry[None, :], res[1:]], axis=0)
+    # the spill column (weight 2^385 relative to res) folds via R mod p
+    res = res + t[2 * NLIMBS:] * R_MOD_P_LIMBS
+    return _carry_fold(res, rounds=2)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _redc(_conv(a, b))
+
+
+def sq(a: jnp.ndarray) -> jnp.ndarray:
+    return _redc(_conv(a, a))
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """a * k for tiny non-Montgomery integers (k <= ~2^6): plain limb
+    scaling, no domain factor involved."""
+    return _carry_fold(a * jnp.int32(k), rounds=2)
+
+
+def to_mont(raw: jnp.ndarray) -> jnp.ndarray:
+    """Raw integer limbs -> Montgomery domain (mont-mul by R^2)."""
+    return mul(raw, jnp.broadcast_to(R2_LIMBS, raw.shape))
+
+
+def from_mont(a: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery -> raw integer limbs in [0, p), canonical."""
+    return _canon_raw(mul(a, jnp.broadcast_to(ONE_RAW, a.shape)))
+
+
+def _cond_sub_p(x: jnp.ndarray) -> jnp.ndarray:
+    """One conditional subtract of p with a sequential borrow sweep;
+    input limbs canonical-carried, value < 2p."""
+    def body(i, st):
+        borrow, out = st
+        v = jax.lax.dynamic_slice_in_dim(x, i, 1, axis=0) \
+            - jax.lax.dynamic_slice_in_dim(
+                jnp.broadcast_to(P_LIMBS, x.shape), i, 1, axis=0) - borrow
+        borrow = (v < 0).astype(jnp.int32)
+        return borrow, jax.lax.dynamic_update_slice_in_dim(
+            out, v + (borrow << RADIX), i, axis=0)
+
+    borrow, sub_x = jax.lax.fori_loop(
+        0, NLIMBS, body, (jnp.zeros_like(x[:1]), jnp.zeros_like(x)))
+    return jnp.where(borrow == 0, sub_x, x)
+
+
+def _strict_carry(x: jnp.ndarray) -> jnp.ndarray:
+    """Full sequential carry: limbs -> canonical digits (value must
+    already be < 2^385 so no top carry escapes)."""
+    def body(i, st):
+        carry, out = st
+        v = jax.lax.dynamic_slice_in_dim(x, i, 1, axis=0) + carry
+        return v >> RADIX, jax.lax.dynamic_update_slice_in_dim(
+            out, v & MASK, i, axis=0)
+
+    _, out = jax.lax.fori_loop(
+        0, NLIMBS, body, (jnp.zeros_like(x[:1]), jnp.zeros_like(x)))
+    return out
+
+
+def _canon_raw(x: jnp.ndarray) -> jnp.ndarray:
+    """Carried limbs, value < 3p -> canonical [0, p)."""
+    x = _strict_carry(_carry_fold(x, rounds=2))
+    return _cond_sub_p(_cond_sub_p(x))
+
+
+def canon(a: jnp.ndarray) -> jnp.ndarray:
+    """Canonical Montgomery representative in [0, p) — the read path for
+    comparisons. A mont-mul by ONE tightens the redundant value below
+    ~2p before the conditional subtracts."""
+    return _canon_raw(mul(a, jnp.broadcast_to(ONE, a.shape)))
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canon(a) == 0, axis=0)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return is_zero(sub(a, b))
+
+
+def select(m: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Per-lane select: m (B,) bool -> a where true else b."""
+    return jnp.where(m[None, :], a, b)
+
+
+def _bits_desc(e: int) -> jnp.ndarray:
+    return jnp.asarray([int(c) for c in bin(e)[2:]], dtype=jnp.int32)
+
+
+def pow_const(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a^e for a fixed public exponent, square-and-multiply over the
+    baked bit array via lax.scan (one compiled body per call site)."""
+    bits = _bits_desc(e)
+    one = jnp.broadcast_to(ONE, a.shape).astype(jnp.int32)
+
+    def body(acc, bit):
+        acc = sq(acc)
+        return select(jnp.broadcast_to(bit == 1, a.shape[1:]),
+                      mul(acc, a), acc), None
+
+    out, _ = jax.lax.scan(body, one, bits)
+    return out
+
+
+def inv(a: jnp.ndarray) -> jnp.ndarray:
+    """Fermat inverse (inv(0) = 0, branch-free)."""
+    return pow_const(a, P_INT - 2)
+
+
+def sqrt(a: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(ok (B,), root): p = 3 mod 4 so the candidate is a^((p+1)/4);
+    ok is the was-square check."""
+    r = pow_const(a, (P_INT + 1) // 4)
+    return eq(sq(r), a), r
+
+
+def sgn0(a: jnp.ndarray) -> jnp.ndarray:
+    """Parity of the canonical integer value (RFC 9380 sgn0 for m=1)."""
+    return from_mont(a)[0] & 1
+
+
+# ---- host packing -------------------------------------------------------
+
+
+def bytes_be_to_limbs(rows: np.ndarray) -> np.ndarray:
+    """(B, 48) uint8 big-endian field elements -> (35, B) int32 raw
+    limbs: unpack to 384 LE bits, pad to 385, regroup by 11."""
+    le = np.ascontiguousarray(rows[:, ::-1])
+    bits = np.unpackbits(le, axis=1, bitorder="little")  # (B, 384)
+    bits = np.concatenate(
+        [bits, np.zeros((rows.shape[0], 1), dtype=np.uint8)], axis=1)
+    weights = (1 << np.arange(RADIX, dtype=np.int32))
+    limbs = (bits.reshape(rows.shape[0], NLIMBS, RADIX)
+             * weights[None, None, :]).sum(axis=2, dtype=np.int32)
+    return np.ascontiguousarray(limbs.T)
+
+
+def limbs_to_bytes_be(limbs: np.ndarray) -> np.ndarray:
+    """(35, B) canonical raw limbs -> (B, 48) uint8 big-endian."""
+    limbs = np.asarray(limbs).T.astype(np.int64)  # (B, 35)
+    shifts = np.arange(RADIX, dtype=np.int64)
+    bits = ((limbs[:, :, None] >> shifts[None, None, :]) & 1).astype(np.uint8)
+    bits = bits.reshape(limbs.shape[0], NLIMBS * RADIX)[:, :384]
+    le = np.packbits(bits, axis=1, bitorder="little")
+    return np.ascontiguousarray(le[:, ::-1])

@@ -1,0 +1,189 @@
+"""Fp2 = Fp[u]/(u^2 + 1) on the batch axis: an element is a NamedTuple of
+two (35, B) Montgomery limb arrays. Mirrors crypto/fallback.py's f2_*
+oracle functions one-for-one (tests assert bit-consistency)."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.crypto import fallback as _oracle
+from cometbft_tpu.ops.bls12381 import fp
+
+
+class Fp2(NamedTuple):
+    a: jnp.ndarray  # real component, (35, B) Montgomery limbs
+    b: jnp.ndarray  # u component
+
+
+def broadcast_const(c, shape) -> Fp2:
+    """Python-int pair (oracle Fp2) -> broadcast Montgomery constant."""
+    p = fp.P_INT
+    ca = fp._const(c[0] % p * fp.R_MOD_P % p)
+    cb = fp._const(c[1] % p * fp.R_MOD_P % p)
+    return Fp2(jnp.broadcast_to(ca, shape).astype(jnp.int32),
+               jnp.broadcast_to(cb, shape).astype(jnp.int32))
+
+
+def zero(bshape) -> Fp2:
+    z = jnp.zeros(bshape, dtype=jnp.int32)
+    return Fp2(z, z)
+
+
+def one(bshape) -> Fp2:
+    return Fp2(jnp.broadcast_to(fp.ONE, bshape).astype(jnp.int32),
+               jnp.zeros(bshape, dtype=jnp.int32))
+
+
+def add(x: Fp2, y: Fp2) -> Fp2:
+    return Fp2(fp.add(x.a, y.a), fp.add(x.b, y.b))
+
+
+def sub(x: Fp2, y: Fp2) -> Fp2:
+    return Fp2(fp.sub(x.a, y.a), fp.sub(x.b, y.b))
+
+
+def neg(x: Fp2) -> Fp2:
+    return Fp2(fp.neg(x.a), fp.neg(x.b))
+
+
+def stack(parts) -> Fp2:
+    """k independent Fp2 values -> one k-wide value (lane batching)."""
+    return Fp2(fp.stack([p.a for p in parts]),
+               fp.stack([p.b for p in parts]))
+
+
+def split(x: Fp2, k: int):
+    return [Fp2(a, b) for a, b in zip(fp.split(x.a, k), fp.split(x.b, k))]
+
+
+def mul(x: Fp2, y: Fp2) -> Fp2:
+    """Karatsuba with the three Fp products STACKED into one 3-wide
+    fp.mul — one conv instead of three (the plain-sum third lane has
+    limbs <= 2^12, inside the conv's proven bound)."""
+    prod = fp.mul(fp.stack([x.a, x.b, x.a + x.b]),
+                  fp.stack([y.a, y.b, y.a + y.b]))
+    t0, t1, t2 = fp.split(prod, 3)
+    return Fp2(fp.sub(t0, t1), fp.sub2(t2, t0, t1))
+
+
+def sq(x: Fp2) -> Fp2:
+    prod = fp.mul(fp.stack([x.a + x.b, x.a]),
+                  fp.stack([fp.sub(x.a, x.b), x.b]))
+    u, v = fp.split(prod, 2)
+    return Fp2(u, fp.mul_small(v, 2))
+
+
+def conj(x: Fp2) -> Fp2:
+    return Fp2(x.a, fp.neg(x.b))
+
+
+def mul_fp(x: Fp2, k: jnp.ndarray) -> Fp2:
+    return Fp2(fp.mul(x.a, k), fp.mul(x.b, k))
+
+
+def mul_small(x: Fp2, k: int) -> Fp2:
+    return Fp2(fp.mul_small(x.a, k), fp.mul_small(x.b, k))
+
+
+def mul_xi(x: Fp2) -> Fp2:
+    """(1 + u) * x — the tower non-residue."""
+    return Fp2(fp.sub(x.a, x.b), fp.add(x.a, x.b))
+
+
+def inv(x: Fp2) -> Fp2:
+    """Fermat through the norm; inv(0) = 0 (branch-free inv0)."""
+    n = fp.inv(fp.add(fp.sq(x.a), fp.sq(x.b)))
+    return Fp2(fp.mul(x.a, n), fp.neg(fp.mul(x.b, n)))
+
+
+def is_zero(x: Fp2) -> jnp.ndarray:
+    return fp.is_zero(x.a) & fp.is_zero(x.b)
+
+
+def eq(x: Fp2, y: Fp2) -> jnp.ndarray:
+    return is_zero(sub(x, y))
+
+
+def select(m: jnp.ndarray, x: Fp2, y: Fp2) -> Fp2:
+    return Fp2(fp.select(m, x.a, y.a), fp.select(m, x.b, y.b))
+
+
+def pow_const(x: Fp2, e: int) -> Fp2:
+    bits = fp._bits_desc(e)
+    acc0 = one(x.a.shape)
+
+    def body(acc, bit):
+        acc = sq(Fp2(*acc))
+        nxt = select(jnp.broadcast_to(bit == 1, x.a.shape[1:]),
+                     mul(acc, x), acc)
+        return tuple(nxt), None
+
+    out, _ = jax.lax.scan(body, tuple(acc0), bits)
+    return Fp2(*out)
+
+
+def is_square(x: Fp2) -> jnp.ndarray:
+    """norm(x)^((p-1)/2) != p-1 (zero counts as square)."""
+    n = fp.add(fp.sq(x.a), fp.sq(x.b))
+    leg = fp.pow_const(n, (fp.P_INT - 1) // 2)
+    return ~fp.eq(leg, _minus_one_mont(leg.shape))
+
+
+def _minus_one_mont(shape):
+    c = fp._const((fp.P_INT - 1) * fp.R_MOD_P % fp.P_INT)
+    return jnp.broadcast_to(c, shape).astype(jnp.int32)
+
+
+def sqrt(x: Fp2) -> tuple[jnp.ndarray, Fp2]:
+    """(ok, root) — algorithm 9 of eprint 2012/685 for p = 3 mod 4,
+    branch-free; ok is the final root check (False for non-squares)."""
+    a1 = pow_const(x, (fp.P_INT - 3) // 4)
+    alpha = mul(sq(a1), x)
+    x0 = mul(a1, x)
+    minus1 = Fp2(_minus_one_mont(x.a.shape),
+                 jnp.zeros_like(x.a))
+    is_m1 = eq(alpha, minus1)
+    # u * x0 branch vs (1 + alpha)^((p-1)/2) * x0 branch
+    ux0 = Fp2(fp.neg(x0.b), x0.a)
+    b = pow_const(add(one(x.a.shape), alpha), (fp.P_INT - 1) // 2)
+    cand = select(is_m1, ux0, mul(b, x0))
+    ok = eq(sq(cand), x)
+    return ok, cand
+
+
+def sgn0(x: Fp2) -> jnp.ndarray:
+    """RFC 9380 sgn0 for m = 2."""
+    ra = fp.from_mont(x.a)
+    rb = fp.from_mont(x.b)
+    s0 = ra[0] & 1
+    z0 = jnp.all(ra == 0, axis=0)
+    return s0 | (z0 & (rb[0] & 1))
+
+
+def canon_ints(x: Fp2):
+    """Host read: -> (a_limbs, b_limbs) canonical raw (non-Montgomery)."""
+    return fp.from_mont(x.a), fp.from_mont(x.b)
+
+
+def from_oracle_ints(pairs, b: int | None = None) -> Fp2:
+    """Host stage: list of oracle (a, b) int pairs -> device Fp2 batch."""
+    import numpy as np
+
+    p = fp.P_INT
+    a = fp.ints_to_limbs([int(c[0]) % p * fp.R_MOD_P % p for c in pairs])
+    bb = fp.ints_to_limbs([int(c[1]) % p * fp.R_MOD_P % p for c in pairs])
+    return Fp2(jnp.asarray(np.ascontiguousarray(a)),
+               jnp.asarray(np.ascontiguousarray(bb)))
+
+
+def to_oracle_ints(x: Fp2) -> list:
+    """Host read: device Fp2 batch -> list of oracle (a, b) int pairs."""
+    import numpy as np
+
+    a, b = canon_ints(x)
+    av = fp.limbs_to_ints(np.asarray(a))
+    bv = fp.limbs_to_ints(np.asarray(b))
+    return [(x0, x1) for x0, x1 in zip(av, bv)]

@@ -1,0 +1,117 @@
+"""Vectorized hash-to-curve for G2 (draft-irtf-cfrg-hash-to-curve
+pipeline, generic SvdW map — the oracle twin is fallback.bls_hash_to_g2).
+
+Split host/device the way the ed25519 kernel splits SHA-512 from curve
+math: expand_message_xmd is 32-bit SHA-256 word arithmetic (host, riding
+ops/hashvec.sha256_many for rung accounting, batched ACROSS messages —
+the per-message chaining is sequential by construction), while
+hash_to_field reduction, the SvdW map, and cofactor clearing are batch
+field arithmetic (device)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from cometbft_tpu.crypto import fallback as _oracle
+from cometbft_tpu.ops.bls12381 import fp
+from cometbft_tpu.ops.bls12381 import fp2
+from cometbft_tpu.ops.bls12381 import points as pts
+from cometbft_tpu.ops.bls12381.fp2 import Fp2
+
+_LEN = 2 * 2 * _oracle._H2F_L  # 256 uniform bytes per message
+
+
+def expand_messages(msgs: list[bytes], dst: bytes) -> list[bytes]:
+    """expand_message_xmd over a batch of messages: 9 hashvec.sha256_many
+    calls of B rows each instead of 9*B hashlib calls."""
+    from cometbft_tpu.ops import hashvec
+
+    if len(dst) > 255:
+        import hashlib
+
+        dst = hashlib.sha256(b"H2C-OVERSIZE-DST-" + dst).digest()
+    ell = -(-_LEN // 32)
+    dst_prime = dst + bytes([len(dst)])
+    z_pad = bytes(64)
+    l_i_b = _LEN.to_bytes(2, "big")
+    b0 = hashvec.sha256_many(
+        [z_pad + m + l_i_b + b"\x00" + dst_prime for m in msgs])
+    prev = hashvec.sha256_many(
+        [bytes(b0[j]) + b"\x01" + dst_prime for j in range(len(msgs))])
+    chunks = [prev]
+    b0b = [bytes(b0[j]) for j in range(len(msgs))]
+    for i in range(2, ell + 1):
+        prev = hashvec.sha256_many(
+            [bytes(x ^ y for x, y in zip(b0b[j], bytes(prev[j])))
+             + bytes([i]) + dst_prime for j in range(len(msgs))])
+        chunks.append(prev)
+    return [b"".join(bytes(c[j]) for c in chunks)[:_LEN]
+            for j in range(len(msgs))]
+
+
+def hash_to_field_limbs(msgs: list[bytes], dst: bytes):
+    """B messages -> two Fp2 element batches as RAW (non-Montgomery)
+    limb planes (u0a, u0b, u1a, u1b), each (35, B) — host staging; the
+    512-bit-to-Fp reduction happens in exact host integers (cheap and
+    bit-identical to the oracle by construction)."""
+    uniform = expand_messages(msgs, dst)
+    planes = [[], [], [], []]
+    for u in uniform:
+        for k in range(4):
+            off = _oracle._H2F_L * k
+            planes[k].append(
+                int.from_bytes(u[off:off + _oracle._H2F_L], "big")
+                % _oracle.BLS_P)
+    return tuple(fp.ints_to_limbs(p) for p in planes)
+
+
+def svdw_map(u: Fp2) -> pts.Point:
+    """Branch-free map_to_curve_svdw on the twist (constants baked from
+    the oracle's self-validated setup)."""
+    z, c1, c2, c3, c4 = _oracle._bls_setup()["svdw"]
+    bshape = u.a.shape
+    Z = fp2.broadcast_const(z, bshape)
+    C1 = fp2.broadcast_const(c1, bshape)
+    C2 = fp2.broadcast_const(c2, bshape)
+    C3 = fp2.broadcast_const(c3, bshape)
+    C4 = fp2.broadcast_const(c4, bshape)
+    B2 = fp2.broadcast_const(_oracle._B2, bshape)
+
+    def g(x):
+        return fp2.add(fp2.mul(fp2.sq(x), x), B2)
+
+    tv1 = fp2.mul(fp2.sq(u), C1)
+    tv2 = fp2.add(fp2.one(bshape), tv1)
+    tv1 = fp2.sub(fp2.one(bshape), tv1)
+    tv3 = fp2.inv(fp2.mul(tv1, tv2))  # inv0 built in
+    tv4 = fp2.mul(fp2.mul(u, tv1), fp2.mul(tv3, C3))
+    x1 = fp2.sub(C2, tv4)
+    x2 = fp2.add(C2, tv4)
+    x3 = fp2.add(fp2.mul(fp2.sq(fp2.mul(fp2.sq(tv2), tv3)), C4), Z)
+    e1 = fp2.is_square(g(x1))
+    e2 = fp2.is_square(g(x2)) & ~e1
+    x = fp2.select(e1, x1, fp2.select(e2, x2, x3))
+    _, y = fp2.sqrt(g(x))
+    flip = fp2.sgn0(u) != fp2.sgn0(y)
+    y = fp2.select(flip, fp2.neg(y), y)
+    return pts.from_affine(pts.G2Field, x, y)
+
+
+def map_to_g2(u0: Fp2, u1: Fp2) -> pts.Point:
+    """SvdW both field elements, add, clear the (calibrated) cofactor —
+    projective output in the r-order subgroup."""
+    h2 = _oracle._bls_setup()["h2"]
+    q = pts.add(pts.G2Field, svdw_map(u0), svdw_map(u1))
+    return pts.mul_const(pts.G2Field, q, h2)
+
+
+def hash_to_g2_device(msgs: list[bytes], dst: bytes) -> pts.Point:
+    """Full pipeline for a batch of messages (host expand + device map)."""
+    u0a, u0b, u1a, u1b = hash_to_field_limbs(msgs, dst)
+    u0 = Fp2(fp.to_mont(jnp.asarray(np.ascontiguousarray(u0a))),
+             fp.to_mont(jnp.asarray(np.ascontiguousarray(u0b))))
+    u1 = Fp2(fp.to_mont(jnp.asarray(np.ascontiguousarray(u1a))),
+             fp.to_mont(jnp.asarray(np.ascontiguousarray(u1b))))
+    return map_to_g2(u0, u1)

@@ -1,0 +1,188 @@
+"""Optimal-ate pairing on the batch axis: one lane = one pairing.
+
+Miller variable T walks the twist E'(Fp2) in PROJECTIVE coordinates; the
+evaluated line lands in the same three sparse Fp12 slots as the oracle's
+affine derivation (fallback.py bls_miller_loop), scaled per step by the
+Fp2 factor 2YZ^2 (tangent) / X - xQ Z (chord) — Fp2 scalings are killed
+by the final exponentiation, so the affine oracle and this projective
+pipeline agree exactly after it (tested bit-for-bit).
+
+The loop is a lax.scan over the 64 baked bits of |x|, so the HLO holds
+ONE doubling+conditional-add body. The final exponentiation mirrors the
+oracle's easy part + (x-1)^2 (x+p) (x^2+p^2-1) + 3 addition chain."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.crypto import fallback as _oracle
+from cometbft_tpu.ops.bls12381 import fp
+from cometbft_tpu.ops.bls12381 import fp2
+from cometbft_tpu.ops.bls12381 import points as pts
+from cometbft_tpu.ops.bls12381 import tower
+from cometbft_tpu.ops.bls12381.fp2 import Fp2
+from cometbft_tpu.ops.bls12381.tower import Fp6, Fp12
+
+_X_BITS = [int(c) for c in bin(-_oracle.BLS_X)[2:]]
+
+
+def _line_f12(c0: Fp2, c_vw: Fp2, c_v2w: Fp2, bshape) -> Fp12:
+    """Assemble the sparse line (c0 + c_vw * v w + c_v2w * v^2 w)."""
+    xi_inv = fp2.broadcast_const(_oracle._XI_INV, bshape)
+    z = fp2.zero(bshape)
+    return Fp12(Fp6(c0, z, z),
+                Fp6(z, fp2.mul(c_vw, xi_inv), fp2.mul(c_v2w, xi_inv)))
+
+
+def miller_loop(px: jnp.ndarray, py: jnp.ndarray,
+                qx: Fp2, qy: Fp2) -> Fp12:
+    """f_{|x|,Q}(P) conjugated (x < 0). px/py: (35, B) Montgomery Fp
+    affine G1 coordinates; qx/qy: affine twist coordinates. Identity
+    lanes must be masked by the caller (the pairing with infinity is
+    rejected upstream, matching the oracle's semantics)."""
+    bshape = px.shape
+    t0 = pts.from_affine(pts.G2Field, qx, qy)
+    f0 = tower.f12_one(bshape)
+    bits = jnp.asarray(_X_BITS[1:], dtype=jnp.int32)
+
+    state0 = (f0, t0)
+    flat0, tree = jax.tree_util.tree_flatten(state0)
+
+    def body(flat, bit):
+        f, t = jax.tree_util.tree_unflatten(tree, flat)
+        X, Y, Z = t.x, t.y, t.z
+        # tangent line at T, scaled by 2YZ^2
+        xx = fp2.sq(X)
+        yz = fp2.mul(Y, Z)
+        c0 = fp2.mul_fp(fp2.mul_small(fp2.mul(yz, Z), 2), py)
+        c_vw = fp2.sub(fp2.mul(xx, fp2.mul_small(X, 3)),
+                       fp2.mul_small(fp2.mul(fp2.sq(Y), Z), 2))
+        c_v2w = fp2.neg(fp2.mul_fp(fp2.mul_small(fp2.mul(xx, Z), 3), px))
+        f = tower.f12_mul(tower.f12_sq(f),
+                          _line_f12(c0, c_vw, c_v2w, bshape))
+        t = pts.dbl(pts.G2Field, t)
+        # chord through (new) T and Q, scaled by X - xQ Z — computed
+        # every step, selected by the bit (lockstep lanes)
+        X, Y, Z = t.x, t.y, t.z
+        s = fp2.sub(X, fp2.mul(qx, Z))
+        a_c0 = fp2.mul_fp(s, py)
+        a_v2w = fp2.neg(fp2.mul_fp(fp2.sub(Y, fp2.mul(qy, Z)), px))
+        a_vw = fp2.sub(fp2.mul(Y, qx), fp2.mul(X, qy))
+        f_add = tower.f12_mul(f, _line_f12(a_c0, a_vw, a_v2w, bshape))
+        t_add = pts.add(pts.G2Field, t, pts.from_affine(pts.G2Field, qx, qy))
+        taken = jnp.broadcast_to(bit == 1, bshape[1:])
+        f = tower.f12_select(taken, f_add, f)
+        t = jax.tree_util.tree_map(
+            lambda a, b: fp.select(taken, a, b), t_add, t)
+        return jax.tree_util.tree_flatten((f, t))[0], None
+
+    out, _ = jax.lax.scan(body, flat0, bits)
+    f, _t = jax.tree_util.tree_unflatten(tree, out)
+    return tower.f12_conj(f)
+
+
+def _cyclo_exp(a: Fp12, e: int) -> Fp12:
+    if e < 0:
+        return tower.f12_exp_const(tower.f12_conj(a), -e)
+    return tower.f12_exp_const(a, e)
+
+
+def final_exp(f: Fp12) -> Fp12:
+    """Mirror of fallback.bls_final_exp (same cubed-pairing chain, so
+    device and oracle values compare equal, not just both-roots)."""
+    f = tower.f12_mul(tower.f12_conj(f), tower.f12_inv(f))
+    f = tower.f12_mul(tower.f12_frob(f, 2), f)
+    x = _oracle.BLS_X
+    y = _cyclo_exp(_cyclo_exp(f, x - 1), x - 1)
+    y = tower.f12_mul(_cyclo_exp(y, x), tower.f12_frob(y, 1))
+    y2 = _cyclo_exp(_cyclo_exp(y, x), x)
+    y = tower.f12_mul(tower.f12_mul(y2, tower.f12_frob(y, 2)),
+                      tower.f12_conj(y))
+    return tower.f12_mul(y, tower.f12_mul(tower.f12_sq(f), f))
+
+
+def product_lanes(f: Fp12) -> Fp12:
+    """Multiply all lanes of a batched Fp12 down to one lane (tree
+    fold) — the aggregate check multiplies its Miller values before the
+    single shared final exponentiation."""
+    def lanes(x):
+        return jax.tree_util.tree_leaves(x)[0].shape[-1]
+
+    while lanes(f) > 1:
+        n = lanes(f)
+        half = (n + 1) // 2
+        lo = jax.tree_util.tree_map(lambda a: a[..., :half], f)
+        if n % 2:
+            hi_tail = jax.tree_util.tree_map(lambda a: a[..., half:], f)
+            one = tower.f12_one(
+                jax.tree_util.tree_leaves(lo)[0].shape[:-1] + (1,))
+            hi = jax.tree_util.tree_map(
+                lambda a, b: jnp.concatenate([a, b], axis=-1), hi_tail, one)
+        else:
+            hi = jax.tree_util.tree_map(lambda a: a[..., half:], f)
+        f = tower.f12_mul(lo, hi)
+    return f
+
+
+# ---- host-composed final exponentiation --------------------------------
+#
+# The monolithic final_exp above inlines five 64-bit exponentiation scans
+# — five compiled copies of the same body. The kernel path (ops/
+# bls_kernel.py) composes jitted pieces at host level instead: ONE
+# compiled exp-by-64-bits program (bits are a traced input) serves all
+# five chain steps, roughly halving the pipeline's cold-compile cost.
+# Intermediate values stay device-resident between calls.
+
+import jax as _jax
+
+
+@_jax.jit
+def _jit_easy(f: Fp12) -> Fp12:
+    f = tower.f12_mul(tower.f12_conj(f), tower.f12_inv(f))
+    return tower.f12_mul(tower.f12_frob(f, 2), f)
+
+
+@_jax.jit
+def _jit_exp64(f: Fp12, bits: jnp.ndarray) -> Fp12:
+    return tower.f12_exp_bits(f, bits)
+
+
+@_jax.jit
+def _jit_xplusp_step(y: Fp12, yx: Fp12) -> Fp12:
+    return tower.f12_mul(yx, tower.f12_frob(y, 1))
+
+
+@_jax.jit
+def _jit_tail(y2: Fp12, y: Fp12, f: Fp12) -> Fp12:
+    y = tower.f12_mul(tower.f12_mul(y2, tower.f12_frob(y, 2)),
+                      tower.f12_conj(y))
+    return tower.f12_mul(y, tower.f12_mul(tower.f12_sq(f), f))
+
+
+def _bits64(e: int) -> jnp.ndarray:
+    """|e| as exactly 64 MSB-first bits (leading zeros are exp no-ops)."""
+    s = bin(abs(e))[2:].rjust(64, "0")
+    assert len(s) == 64
+    return jnp.asarray([int(c) for c in s], dtype=jnp.int32)
+
+
+_XM1_BITS = _bits64(_oracle.BLS_X - 1)
+_X_BITS64 = _bits64(_oracle.BLS_X)
+
+
+def _cyclo_exp_host(a: Fp12, e: int) -> Fp12:
+    if e < 0:
+        a = tower.f12_conj(a)
+    return _jit_exp64(a, _bits64(e))
+
+
+def final_exp_composed(f: Fp12) -> Fp12:
+    """final_exp as a host-level composition of shared jitted pieces —
+    bit-identical to final_exp (and to the oracle)."""
+    x = _oracle.BLS_X
+    f = _jit_easy(f)
+    y = _cyclo_exp_host(_cyclo_exp_host(f, x - 1), x - 1)
+    y = _jit_xplusp_step(y, _cyclo_exp_host(y, x))
+    y2 = _cyclo_exp_host(_cyclo_exp_host(y, x), x)
+    return _jit_tail(y2, y, f)

@@ -1,0 +1,298 @@
+"""G1/G2 point arithmetic on the batch axis.
+
+Complete projective formulas (Renes-Costello-Batina 2015, algorithms 7/9
+for a = 0) over a generic field adapter — branch-free by construction,
+which is exactly what lockstep vector lanes need: identity, doubling and
+adversarial inputs take the same instruction path (the ops/curve.py
+design note, ported to short Weierstrass). One instantiation per group:
+G1 over fp arrays, G2 over fp2 pairs.
+
+The only scalars multiplied on device are FIXED public constants (the
+subgroup order r, the G2 cofactor) — per-lane secret scalars never reach
+this plane (signing is host-side), so every ladder is a baked-bits scan.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cometbft_tpu.crypto import fallback as _oracle
+from cometbft_tpu.ops.bls12381 import fp
+from cometbft_tpu.ops.bls12381 import fp2
+from cometbft_tpu.ops.bls12381.fp2 import Fp2
+
+
+class G1Field:
+    """Field adapter: fp module over (35, B) arrays."""
+
+    add = staticmethod(fp.add)
+    sub = staticmethod(fp.sub)
+    mul = staticmethod(fp.mul)
+    sq = staticmethod(fp.sq)
+    neg = staticmethod(fp.neg)
+    select = staticmethod(fp.select)
+    is_zero = staticmethod(fp.is_zero)
+    stack = staticmethod(fp.stack)
+    split = staticmethod(fp.split)
+    mul_small = staticmethod(fp.mul_small)
+
+    @staticmethod
+    def mul_b3(a):  # 3 * b = 12: a cheap limb scaling, never a field mul
+        return fp.mul_small(a, 12)
+
+    @staticmethod
+    def zero_like(a):
+        return jnp.zeros_like(a)
+
+    @staticmethod
+    def one_like(a):
+        return jnp.broadcast_to(fp.ONE, a.shape).astype(jnp.int32)
+
+
+_B3_G2 = _oracle.f2_mul_fp(_oracle._B2, 3)  # 12 * (1 + u)
+
+
+class G2Field:
+    """Field adapter: fp2 module over Fp2 pairs."""
+
+    add = staticmethod(fp2.add)
+    sub = staticmethod(fp2.sub)
+    mul = staticmethod(fp2.mul)
+    sq = staticmethod(fp2.sq)
+    neg = staticmethod(fp2.neg)
+    select = staticmethod(fp2.select)
+    is_zero = staticmethod(fp2.is_zero)
+    stack = staticmethod(fp2.stack)
+    split = staticmethod(fp2.split)
+    mul_small = staticmethod(fp2.mul_small)
+
+    @staticmethod
+    def mul_b3(a: Fp2):  # 3 * b = 12(1 + u): limb scaling + xi rotation
+        return fp2.mul_xi(fp2.mul_small(a, 12))
+
+    @staticmethod
+    def zero_like(a: Fp2):
+        return fp2.zero(a.a.shape)
+
+    @staticmethod
+    def one_like(a: Fp2):
+        return fp2.one(a.a.shape)
+
+
+class Point(NamedTuple):
+    """Projective (X : Y : Z); identity = (0 : 1 : 0)."""
+
+    x: object
+    y: object
+    z: object
+
+
+def identity_like(F, coord) -> Point:
+    return Point(F.zero_like(coord), F.one_like(coord), F.zero_like(coord))
+
+
+def from_affine(F, x, y) -> Point:
+    return Point(x, y, F.one_like(y))
+
+
+def neg_point(F, p: Point) -> Point:
+    return Point(p.x, F.neg(p.y), p.z)
+
+
+def is_identity(F, p: Point) -> jnp.ndarray:
+    return F.is_zero(p.z)
+
+
+def add(F, p: Point, q: Point) -> Point:
+    """RCB 2015 algorithm 7 (complete, a = 0), multiplies stacked in two
+    dependency layers of six."""
+    l1 = F.mul(
+        F.stack([p.x, p.y, p.z, F.add(p.x, p.y), F.add(p.y, p.z),
+                 F.add(p.x, p.z)]),
+        F.stack([q.x, q.y, q.z, F.add(q.x, q.y), F.add(q.y, q.z),
+                 F.add(q.x, q.z)]))
+    t0, t1, t2, mxy, myz, mxz = F.split(l1, 6)
+    t3 = F.sub(mxy, F.add(t0, t1))
+    t4 = F.sub(myz, F.add(t1, t2))
+    y3 = F.sub(mxz, F.add(t0, t2))
+    x3 = F.mul_small(t0, 3)
+    t2b = F.mul_b3(t2)
+    z3 = F.add(t1, t2b)
+    t1b = F.sub(t1, t2b)
+    y3b = F.mul_b3(y3)
+    l2 = F.mul(F.stack([t3, t4, y3b, t1b, z3, x3]),
+               F.stack([t1b, y3b, x3, z3, t4, t3]))
+    p1, p2, p3, p4, p5, p6 = F.split(l2, 6)
+    return Point(F.sub(p1, p2), F.add(p3, p4), F.add(p5, p6))
+
+
+def dbl(F, p: Point) -> Point:
+    """RCB 2015 algorithm 9 (complete doubling, a = 0), two stacked
+    multiply layers of four."""
+    l1 = F.mul(F.stack([p.y, p.y, p.z, p.x]),
+               F.stack([p.y, p.z, p.z, p.y]))
+    t0, t1, zz, txy = F.split(l1, 4)
+    t2 = F.mul_b3(zz)
+    z8 = F.mul_small(t0, 8)
+    y3 = F.add(t0, t2)
+    t0b = F.sub(t0, F.mul_small(t2, 3))
+    l2 = F.mul(F.stack([t2, t1, t0b, t0b]),
+               F.stack([z8, z8, y3, txy]))
+    x3, z3, q3, q4 = F.split(l2, 4)
+    return Point(F.mul_small(q4, 2), F.add(x3, q3), z3)
+
+
+def mul_const(F, p: Point, e: int) -> Point:
+    """[e]P for a fixed public scalar: baked-bits double-and-add scan
+    (complete formulas — no special-casing along the ladder)."""
+    assert e >= 0
+    bits = fp._bits_desc(e)
+    acc0 = identity_like(F, p.y)
+    flat_p, tree = jax.tree_util.tree_flatten(p)
+
+    def body(acc_flat, bit):
+        acc = jax.tree_util.tree_unflatten(tree, acc_flat)
+        acc = dbl(F, acc)
+        cand = add(F, acc, jax.tree_util.tree_unflatten(tree, flat_p))
+        bshape = bit == 1
+        nxt = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(jnp.broadcast_to(
+                bshape, a.shape[1:])[None, :], a, b),
+            cand, acc)
+        return jax.tree_util.tree_flatten(nxt)[0], None
+
+    out, _ = jax.lax.scan(body, jax.tree_util.tree_flatten(acc0)[0], bits)
+    return jax.tree_util.tree_unflatten(tree, out)
+
+
+def in_subgroup(F, p: Point) -> jnp.ndarray:
+    """[r]P == O (identity itself counts — callers mask infinity
+    separately where the draft rejects it)."""
+    return is_identity(F, mul_const(F, p, _oracle.BLS_R))
+
+
+def on_curve(F, p: Point) -> jnp.ndarray:
+    """Projective membership via 3*(Y^2 Z) == 3*X^3 + b3*Z^3 (only the
+    baked b3 constant is needed). Identity (0:1:0) satisfies it."""
+    cubes = F.mul(F.stack([F.sq(p.x), F.sq(p.z), F.sq(p.y)]),
+                  F.stack([p.x, p.z, p.z]))
+    x3, z3, yyz = F.split(cubes, 3)
+    lhs = F.mul_small(yyz, 3)
+    rhs = F.add(F.mul_small(x3, 3), F.mul_b3(z3))
+    return F.is_zero(F.sub(lhs, rhs))
+
+
+def to_affine(F, p: Point):
+    """(x, y, is_identity): identity lanes read (0, 0)."""
+    import cometbft_tpu.ops.bls12381.fp as _fp  # noqa: F401
+
+    zi = _field_inv(F, p.z)
+    return F.mul(p.x, zi), F.mul(p.y, zi), is_identity(F, p)
+
+
+def _field_inv(F, a):
+    if F is G1Field:
+        return fp.inv(a)
+    return fp2.inv(a)
+
+
+def sum_tree(F, p: Point, width: int) -> Point:
+    """Reduce a batch of points to lane 0 by halving adds: lanes past
+    `width` must already hold the identity. Returns a 1-lane Point.
+    log2(B) jitted adds at shrinking shapes — shapes walk the same
+    power-of-two ladder every call, so compilation is bounded."""
+    del width
+
+    def lanes(q: Point) -> int:
+        leaf = jax.tree_util.tree_leaves(q)[0]
+        return leaf.shape[-1]
+
+    while lanes(p) > 1:
+        n = lanes(p)
+        half = (n + 1) // 2
+        lo = jax.tree_util.tree_map(lambda a: a[..., :half], p)
+        if n % 2:  # odd: pad the high half with one identity lane
+            hi = jax.tree_util.tree_map(lambda a: a[..., half - 1:], p)
+            ident = identity_like(F, lo.y)
+            hi = jax.tree_util.tree_map(
+                lambda a, b: jnp.concatenate(
+                    [a[..., 1:], b[..., :1]], axis=-1), hi, ident)
+        else:
+            hi = jax.tree_util.tree_map(lambda a: a[..., half:], p)
+        p = add(F, lo, hi)
+    return p
+
+
+# ---- compressed-point staging (host <-> device) -------------------------
+
+
+def g1_decompress(x_raw: jnp.ndarray, sign_bit: jnp.ndarray
+                  ) -> tuple[jnp.ndarray, Point]:
+    """(35, B) raw x limbs (+ per-lane sign flags) -> (ok, affine-Z1
+    projective Point). Structural flag/range/infinity checks are the
+    host's job (ops/bls_kernel staging) — this is the field math half:
+    y = sqrt(x^3 + 4), sign-selected. ok = sqrt exists."""
+    x = fp.to_mont(x_raw)
+    four = jnp.broadcast_to(
+        fp._const(4 * fp.R_MOD_P % fp.P_INT), x.shape).astype(jnp.int32)
+    ok, y = fp.sqrt(fp.add(fp.mul(fp.sq(x), x), four))
+    flip = _lexi_larger_fp(y) != (sign_bit != 0)
+    y = fp.select(flip, fp.neg(y), y)
+    return ok, from_affine(G1Field, x, y)
+
+
+def g2_decompress(x0_raw: jnp.ndarray, x1_raw: jnp.ndarray,
+                  sign_bit: jnp.ndarray) -> tuple[jnp.ndarray, Point]:
+    """G2 analog: x = (x0, x1) raw limb planes, y via Fp2 sqrt."""
+    x = Fp2(fp.to_mont(x0_raw), fp.to_mont(x1_raw))
+    b2 = fp2.broadcast_const(_oracle._B2, x.a.shape)
+    ok, y = fp2.sqrt(fp2.add(fp2.mul(fp2.sq(x), x), b2))
+    flip = _lexi_larger_fp2(y) != (sign_bit != 0)
+    y = fp2.select(flip, fp2.neg(y), y)
+    return ok, from_affine(G2Field, x, y)
+
+
+_HALF = (fp.P_INT - 1) // 2
+
+
+def _gt_half(raw: jnp.ndarray) -> jnp.ndarray:
+    """(35, B) canonical raw limbs -> (B,) bool of value > (p-1)/2,
+    via a borrow sweep against the constant."""
+    half = jnp.broadcast_to(fp._const(_HALF), raw.shape).astype(jnp.int32)
+
+    def body(i, borrow):
+        v = (jax.lax.dynamic_slice_in_dim(half, i, 1, axis=0)
+             - jax.lax.dynamic_slice_in_dim(raw, i, 1, axis=0) - borrow)
+        return (v < 0).astype(jnp.int32)
+
+    borrow = jax.lax.fori_loop(
+        0, fp.NLIMBS, body, jnp.zeros_like(raw[:1]))
+    return borrow[0] != 0
+
+
+def _lexi_larger_fp(y_mont: jnp.ndarray) -> jnp.ndarray:
+    return _gt_half(fp.from_mont(y_mont))
+
+
+def _lexi_larger_fp2(y: Fp2) -> jnp.ndarray:
+    ra, rb = fp.from_mont(y.a), fp.from_mont(y.b)
+    b_zero = jnp.all(rb == 0, axis=0)
+    return jnp.where(b_zero, _gt_half(ra), _gt_half(rb))
+
+
+def g1_compress_host(pt_affine_raw: np.ndarray, y_larger: np.ndarray,
+                     inf: np.ndarray) -> np.ndarray:
+    """(35, B) canonical raw x limbs + per-lane sign/infinity -> (B, 48)
+    compressed encodings (host-side assembly)."""
+    out = fp.limbs_to_bytes_be(pt_affine_raw)
+    out = out.copy()
+    out[:, 0] |= 0x80
+    out[y_larger.astype(bool), 0] |= 0x20
+    if inf.any():
+        out[inf.astype(bool)] = 0
+        out[inf.astype(bool), 0] = 0xC0
+    return out

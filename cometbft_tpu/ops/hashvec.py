@@ -546,3 +546,27 @@ def sha512_mod_l_words(datas: list[bytes]) -> np.ndarray:
     ed25519 challenge pipeline (hash -> wide reduction -> wire words) in
     three batch calls."""
     return reduce512_mod_l(sha512_many(datas))
+
+
+# ------------------------------------------------------------- SHA-256 rung
+#
+# The BLS hash-to-curve pipeline (ops/bls12381/htc.py expand_message_xmd)
+# hashes with SHA-256. Today the only rung is serial hashlib — SHA-256's
+# host cost is a rounding error next to the pairing math it feeds, and
+# each expand_message round is already batched ACROSS messages by the
+# caller (9 sha256_many calls per batch instead of 9*N hashlib calls).
+# When profiling ever shows this on a flush's critical path, the
+# batch-axis rung follows _sha512_blocks_numpy with 32-bit words and
+# K-constants — the structure above is the template.
+
+
+def sha256_many(datas: list[bytes]) -> np.ndarray:
+    """N messages -> (N, 32) uint8 digests, bit-for-bit hashlib.sha256;
+    counted on the shared rung-stats surface like the sha512 cores."""
+    n = len(datas)
+    out = np.empty((n, 32), dtype=np.uint8)
+    for i, d in enumerate(datas):
+        out[i] = np.frombuffer(hashlib.sha256(d).digest(), dtype=np.uint8)
+    if n:
+        _count("sha256", "serial", n)
+    return out

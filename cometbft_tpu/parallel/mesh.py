@@ -312,6 +312,18 @@ class VerifyMesh:
                 "verify_fn": _srm.verify,
                 "fallback_async": SRK.verify_batch_async,
             }
+        if scheme == "bls12381":
+            from cometbft_tpu.ops import bls_kernel as BLSK
+
+            return {
+                # pairing kernels stage/dispatch through their own piece
+                # pipeline — the mesh delegates the whole shard to it
+                # (per-chip placement via the committed device of the
+                # staged block) instead of the rw/sw/kw word contract
+                "shard_verify": BLSK.mesh_shard_verify,
+                "verify_fn": BLSK.oracle_verify,
+                "fallback_async": BLSK.verify_batch_async,
+            }
         raise ValueError(f"mesh has no verify program for scheme {scheme!r}")
 
     @staticmethod
@@ -451,6 +463,25 @@ class VerifyMesh:
         chaos.fire(f"{scheme}.dispatch.dev{chip.index}")
         n = len(sigs)
         b = K.bucket_size(n)
+        shard_verify = ops.get("shard_verify")
+        if shard_verify is not None:
+            # scheme-owned shard path (bls12381): the kernel stages,
+            # places on this chip and fetches; the mesh keeps fault-
+            # domain accounting and placement
+            with _trace.span(f"{scheme}.dispatch", cat="compute",
+                             lanes=b, device=chip.index):
+                mask, eligible = shard_verify(chip.device, pubs, msgs, sigs)
+            K._count_device_batch(scheme, b)
+            mm = _mesh_metrics()
+            if mm is not None:
+                try:
+                    mm.mesh_shard_lanes.labels(str(chip.index)).inc(b)
+                except Exception:  # noqa: BLE001
+                    pass
+            with self._lock:
+                chip.lanes_total += b
+                chip.shards_total += 1
+            return mask, eligible
         with _trace.span(f"{scheme}.stage", cat="stage", sig_rows=n,
                          lanes=b, device=chip.index):
             pre_ok, safe_pubs, rw, sw, kw = ops["stage"](pubs, msgs, sigs, b)

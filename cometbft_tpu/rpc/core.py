@@ -553,11 +553,19 @@ class Environment:
                 RPCProvider(chain_id, u.strip())
                 for u in lc.fleet_witnesses.split(",") if u.strip()
             ]
+            from cometbft_tpu.light.fleet import shared_cache
+
             fleet = LightFleet(
                 chain_id, provider,
                 TrustOptions(period_ns=period_ns, height=root.height,
                              hash_=root.hash()),
                 witnesses=witnesses or None,
+                # the per-chain shared cache: statesync seeds it before
+                # the fleet exists, the fleet keeps it warm afterwards
+                cache=shared_cache(
+                    chain_id, capacity=lc.fleet_cache_capacity,
+                    trust_period_ns=period_ns,
+                    skip_base=lc.fleet_skip_base),
                 cache_capacity=lc.fleet_cache_capacity,
                 skip_base=lc.fleet_skip_base,
                 trust_period_ns=period_ns,

@@ -212,6 +212,7 @@ class VerifyScheduler:
         # construction: the first flush must never pay module imports
         # inside its span — they would dominate its latency budget (a
         # phantom slow-batch capture) and sink per-batch span coverage
+        from cometbft_tpu.ops import bls_kernel  # noqa: F401
         from cometbft_tpu.ops import ed25519_kernel  # noqa: F401
         from cometbft_tpu.ops import sr25519_kernel  # noqa: F401
 
@@ -622,7 +623,8 @@ class VerifyScheduler:
         with trace.span("sched.dispatch", cat="compute",
                         schemes=len(per)):
             for scheme, d in per.items():
-                if mesh is not None and scheme in ("ed25519", "sr25519"):
+                if mesh is not None and scheme in (
+                        "ed25519", "sr25519", "bls12381"):
                     # mesh shards dispatch eagerly inside verify_async;
                     # both schemes' shards are in flight before any join
                     mesh_thunks.append((scheme, mesh.verify_async(
@@ -640,6 +642,13 @@ class VerifyScheduler:
                     thunks.append(sr25519_kernel.verify_batch_async(
                         [p.bytes_() for p in d["pubs"]], d["msgs"],
                         d["sigs"]))
+                    thunk_schemes.append(scheme)
+                elif backend == "tpu" and scheme == "bls12381":
+                    from cometbft_tpu.ops import bls_kernel
+
+                    thunks.append(bls_kernel.verify_batch_async(
+                        [p.bytes_() for p in d["pubs"]], d["msgs"],
+                        d["sigs"], recheck_groups=d["bounds"]))
                     thunk_schemes.append(scheme)
                 else:
                     # sig_rows marks THE counting site for these rows

@@ -58,7 +58,7 @@ class CommitSig:
                 raise ValueError("expected ValidatorAddress size to be 20 bytes")
             if not self.signature:
                 raise ValueError("signature is missing")
-            if len(self.signature) > 64:
+            if len(self.signature) > crypto.MAX_SIGNATURE_SIZE:
                 raise ValueError("signature is too big")
 
     def to_proto(self) -> bytes:

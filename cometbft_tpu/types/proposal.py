@@ -41,7 +41,7 @@ class Proposal:
             raise ValueError(f"expected a complete, non-empty BlockID, got: {self.block_id}")
         if not self.signature:
             raise ValueError("signature is missing")
-        if len(self.signature) > 64:
+        if len(self.signature) > crypto.MAX_SIGNATURE_SIZE:
             raise ValueError("signature is too big")
 
     def to_proto(self) -> bytes:

@@ -133,6 +133,35 @@ def _commit_rows(
     return pubs, msgs, sigs, idxs
 
 
+def _bls_aggregate_ok(pubs, msgs, sigs) -> bool | None:
+    """The BLS aggregate commit path (ops/bls_kernel.aggregate_verify):
+    when EVERY signer in the commit is a bls12381 key, the whole commit
+    decides with one pairing-product check — signatures sum to a single
+    G2 point, pubkeys aggregate per distinct sign-bytes (PoP semantics),
+    cost ~independent of committee size. Returns None when the commit is
+    not BLS-shaped (callers fall through to per-lane batching), True on
+    an accepted aggregate, False when the aggregate fails — the caller
+    then re-runs the per-lane path to PINPOINT the offending signature
+    (the aggregate check is a commit-level verdict, not a mask).
+
+    Never raises on verification trouble: a device fault inside
+    aggregate_verify already degrades to the exact CPU oracle."""
+    if not pubs or any(p.type_() != "bls12381" for p in pubs):
+        return None
+    from cometbft_tpu.crypto import bls12381
+
+    if not bls12381.enabled():
+        # loud misconfiguration, same rule as crypto/batch
+        raise crypto_batch.crypto.ErrInvalidKey(
+            "bls12381 validator set but crypto.bls_enabled is off")
+    from cometbft_tpu.libs.prefixrows import as_bytes
+    from cometbft_tpu.ops import bls_kernel
+
+    return bls_kernel.aggregate_verify(
+        [p.bytes_() for p in pubs], [as_bytes(m) for m in msgs],
+        [bytes(s) for s in sigs])
+
+
 def _raise_first_bad(commit: Commit, idxs: list[int], mask) -> None:
     for i, sig_ok in enumerate(mask):
         if not sig_ok:
@@ -157,6 +186,10 @@ def _verify_commit_batch(
         chain_id, vals, commit, voting_power_needed,
         ignore_sig, count_sig, count_all_signatures, lookup_by_index,
     )
+    # all-BLS validator set: one pairing-product check per commit; a
+    # failed aggregate falls through to the per-lane path to pinpoint
+    if _bls_aggregate_ok(pubs, msgs, sigs):
+        return
     # mixed-scheme coalescing: each key type becomes one device sub-batch
     # (BASELINE config 5 mega-commits mix ed25519 + sr25519 validators)
     bv = crypto_batch.create_mixed_batch_verifier()
@@ -305,12 +338,16 @@ class StagedCommitVerification:
     device_thunk remains supported for callers that pre-dispatched."""
 
     def __init__(self, commit: Commit, sig_idxs: list[int], device_thunk=None,
-                 cpu_rows=None, ed_rows=None):
+                 cpu_rows=None, ed_rows=None, bls_rows=None):
         self.commit = commit
         self.sig_idxs = sig_idxs
         self.device_thunk = device_thunk
         self._cpu_rows = cpu_rows
         self._ed_rows = ed_rows  # (pub_bytes, msgs, sigs) all-ed25519 rows
+        # (pubs, msgs, sigs) all-bls12381 rows: finish() tries ONE
+        # aggregate pairing-product check first; only a failed aggregate
+        # pays the per-lane pinpoint pass
+        self._bls_rows = bls_rows
         self._mask = None
         self._passed = False
 
@@ -323,6 +360,13 @@ class StagedCommitVerification:
             return
         if mask is None:
             mask = self._mask
+        if mask is None and self._bls_rows is not None:
+            pubs, msgs, sigs = self._bls_rows
+            if _bls_aggregate_ok(pubs, msgs, sigs):
+                self._passed = True
+                return
+            # pinpoint below through the per-lane batch path
+            self._cpu_rows = self._bls_rows
         if mask is None:
             if self.device_thunk is not None:
                 mask = self.device_thunk()
@@ -358,6 +402,11 @@ def _stage_rows(commit: Commit, rows) -> StagedCommitVerification:
     on the TPU backend (dispatch deferred to prefetch_staged / finish);
     else defer to per-scheme host batching at finish()."""
     pubs, msgs, sigs, idxs = rows
+    if pubs and all(p.type_() == "bls12381" for p in pubs):
+        # aggregate-verified at finish(): blocksync/light windows decide
+        # each BLS commit with one pairing-product check
+        return StagedCommitVerification(
+            commit, idxs, bls_rows=(pubs, msgs, sigs))
     if crypto_batch.resolve_backend() == "tpu" and all(
         p.type_() == "ed25519" for p in pubs
     ):
@@ -517,6 +566,8 @@ def _prefetch_via_scheduler(staged: list[StagedCommitVerification],
     for s in staged:
         if s._passed or s._mask is not None or s.device_thunk is not None:
             continue
+        if getattr(s, "_bls_rows", None) is not None:
+            continue  # aggregate-verified at finish(), one check total
         if s._ed_rows is not None:
             from cometbft_tpu.crypto import ed25519 as _ed
 

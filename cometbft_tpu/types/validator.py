@@ -122,7 +122,8 @@ class Validator:
 def pub_key_to_proto(pub_key: crypto.PubKey) -> bytes:
     """crypto.PublicKey oneof: ed25519=1 bytes, secp256k1=2 bytes
     (proto/tendermint/crypto/keys.proto)."""
-    field_num = {"ed25519": 1, "secp256k1": 2, "sr25519": 3}.get(pub_key.type_())
+    field_num = {"ed25519": 1, "secp256k1": 2, "sr25519": 3,
+                 "bls12381": 4}.get(pub_key.type_())
     if field_num is None:
         raise ValueError(f"unsupported pubkey type {pub_key.type_()}")
     return pb.Writer().bytes(field_num, pub_key.bytes_(), always=True).output()
@@ -144,6 +145,10 @@ def pub_key_from_proto(data: bytes) -> crypto.PubKey:
             from cometbft_tpu.crypto import sr25519
 
             return sr25519.PubKey(r.read_bytes())
+        if f == 4:
+            from cometbft_tpu.crypto import bls12381
+
+            return bls12381.PubKey(r.read_bytes())
         r.skip(w)
     raise ValueError("empty/unsupported PublicKey proto")
 

@@ -122,3 +122,29 @@ def fail_on_leaked_asyncio_tasks(request):
         pytest.fail(
             "test left pending asyncio tasks behind (stop your services):\n  "
             + "\n  ".join(sorted(leaks)), pytrace=False)
+
+
+def pytest_collection_modifyitems(config, items):
+    """`pairing` implies `slow`: the BLS pairing pipeline's cold XLA
+    compile takes minutes, and tier-1 is pinned to -m "not slow" — the
+    marker documents WHY a test is excluded while -m pairing still
+    selects exactly the pairing suite."""
+    import pytest as _pytest
+
+    for item in items:
+        if "pairing" in item.keywords and "slow" not in item.keywords:
+            item.add_marker(_pytest.mark.slow)
+
+
+@pytest.fixture(autouse=True)
+def _reset_shared_checkpoint_caches():
+    """The per-chain shared CheckpointCache (light/fleet.shared_cache)
+    is process-global by design; tests reusing chain ids must not leak
+    trusted checkpoints into each other."""
+    yield
+    try:
+        from cometbft_tpu.light import fleet as _fleet
+
+        _fleet.reset_shared_caches()
+    except Exception:  # noqa: BLE001 - light plane may be unimportable
+        pass

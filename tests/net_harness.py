@@ -92,14 +92,35 @@ class InProcNet:
         await asyncio.wait_for(poll(), timeout)
 
 
+def _gen_priv(scheme: str, i: int):
+    """Deterministic per-validator key of the requested scheme (BLS key
+    generation costs a G1 scalar mul — deterministic seeds keep the
+    4-val BLS net reproducible)."""
+    if scheme == "ed25519":
+        return ed25519.gen_priv_key()
+    if scheme == "sr25519":
+        from cometbft_tpu.crypto import sr25519
+
+        return sr25519.gen_priv_key_from_secret(b"net-harness-%d" % i)
+    if scheme == "bls12381":
+        from cometbft_tpu.crypto import bls12381
+
+        return bls12381.gen_priv_key_from_secret(b"net-harness-%d" % i)
+    raise ValueError(f"unknown key scheme {scheme!r}")
+
+
 async def make_net(
     n_vals: int = 4,
     config: ConsensusConfig | None = None,
     chain_id: str = "net-test-chain",
     app_factory=None,
     ext_enable_height: int = 0,
+    key_scheme: str = "ed25519",
+    key_schemes: list[str] | None = None,
 ) -> InProcNet:
-    privs = [ed25519.gen_priv_key() for _ in range(n_vals)]
+    schemes = key_schemes or [key_scheme] * n_vals
+    assert len(schemes) == n_vals
+    privs = [_gen_priv(s, i) for i, s in enumerate(schemes)]
     gdoc = GenesisDoc(
         genesis_time=cmttime.canonical_now_ms(),
         chain_id=chain_id,

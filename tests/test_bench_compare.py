@@ -173,6 +173,42 @@ class TestDirectionAwareCompare:
         assert bc.compare(rec, rec)["verdict"] == "pass"
         assert bc.compare(worse, rec)["verdict"] == "pass"
 
+    def test_bls_aggregate_is_enforced_lower_better(self):
+        """BLS sentinel wiring (ISSUE 13): the 10k-validator aggregate
+        commit-verify time regressing UP past 50% fails — both the bare
+        detail key and the bls.-prefixed section key; the same delta as
+        an improvement passes; the crossover committee size is
+        informational with a stated why (it is a backend property, not a
+        regression surface)."""
+        old = _record(bls_aggregate_verify_ms_10k=120.0,
+                      bls={"bls_aggregate_verify_ms_10k": 120.0,
+                           "crossover_validators": 30_000.0})
+        worse = _record(bls_aggregate_verify_ms_10k=260.0,
+                        bls={"bls_aggregate_verify_ms_10k": 260.0,
+                             "crossover_validators": 500_000.0})
+        v = bc.compare(old, worse)
+        assert v["verdict"] == "fail"
+        assert "bls_aggregate_verify_ms_10k" in v["regressions"]
+        assert "bls.bls_aggregate_verify_ms_10k" in v["regressions"]
+        assert bc.compare(worse, old)["verdict"] == "pass"
+        row = v["metrics"]["bls.crossover_validators"]
+        assert row["verdict"] == "info"
+        assert "backend-dependent" in row["why_info"]
+
+    def test_bls_sentinel_self_test_case(self):
+        """--self-test contract on a bls-shaped record: an injected
+        aggregate-ms regression is flagged; the identical snapshot and
+        the improvement direction are not."""
+        rec = _record(bls_aggregate_verify_ms_10k=120.0)
+        worse, metric, pct = bc.inject_regression(
+            rec, metric="bls_aggregate_verify_ms_10k")
+        assert metric == "bls_aggregate_verify_ms_10k" and pct > 50.0
+        caught = bc.compare(rec, worse)
+        assert caught["verdict"] == "fail"
+        assert metric in caught["regressions"]
+        assert bc.compare(rec, rec)["verdict"] == "pass"
+        assert bc.compare(worse, rec)["verdict"] == "pass"
+
     def test_fleet_curve_leaves_are_informational(self):
         """Nested fleet curve values (fleet.curve.<n>.*) flatten into
         dotted names that are NOT tracked — they must report as info,

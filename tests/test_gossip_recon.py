@@ -316,3 +316,47 @@ class TestReconciliationLive:
                 netchaos.reset()
 
         asyncio.run(main())
+
+
+class TestRoundChangeRearmsSummary:
+    """PR 12 residual: a round change on the PEER side must re-arm the
+    send-first summary. A summary sent while the peer was on an earlier
+    round is dropped as "stale" on its side; without the re-arm, the
+    unchanged-view suppression (last_summary_sent) would never resend it
+    for the round the peer finally arrived at — a multi-round height
+    would leave that peer's vote view unrepaired."""
+
+    def _nrs(self, height: int, round_: int) -> M.NewRoundStepMessage:
+        return M.NewRoundStepMessage(
+            height=height, round_=round_, step=1,
+            seconds_since_start_time=0, last_commit_round=0)
+
+    def test_round_change_clears_last_summary_sent(self):
+        ps = _ps_at(5, 0, 4)
+        ps.last_summary_sent = (5, 0, b"\x0f", b"\x03")
+        ps.apply_new_round_step(self._nrs(5, 1))
+        assert ps.last_summary_sent is None, \
+            "round change must re-arm the summary resend"
+
+    def test_height_change_clears_last_summary_sent(self):
+        ps = _ps_at(5, 2, 4)
+        ps.last_summary_sent = (5, 2, b"\x0f", b"\x0f")
+        ps.apply_new_round_step(self._nrs(6, 0))
+        assert ps.last_summary_sent is None
+
+    def test_same_round_reannounce_keeps_suppression(self):
+        """A step-only update inside the same (height, round) must NOT
+        re-arm — that would turn the suppression off entirely and
+        re-send a frame per step transition."""
+        ps = _ps_at(5, 1, 4)
+        sig = (5, 1, b"\x0f", b"\x00")
+        ps.last_summary_sent = sig
+        ps.apply_new_round_step(self._nrs(5, 1))
+        assert ps.last_summary_sent == sig
+
+    def test_stale_announcement_keeps_suppression(self):
+        ps = _ps_at(5, 2, 4)
+        sig = (5, 2, b"\x0f", b"\x00")
+        ps.last_summary_sent = sig
+        ps.apply_new_round_step(self._nrs(5, 1))  # older round: ignored
+        assert ps.last_summary_sent == sig

@@ -1,0 +1,120 @@
+"""Statesync on the shared checkpoint cache (PR 11 residual).
+
+The statesync light client's `checkpoint_source` consults the per-chain
+shared CheckpointCache (light/fleet.shared_cache) before its own store —
+a checkpoint the fleet (or an earlier statesync run) already verified
+lets bootstrap bisections fast-forward instead of running cold — and a
+teeing store mirrors every statesync-verified block back into the cache
+so the serving plane starts warm. These tests exercise the seam the
+node wires up (node/node.py) with the same construction."""
+
+from __future__ import annotations
+
+import asyncio
+
+from cometbft_tpu import light
+from cometbft_tpu.light.fleet import (CheckpointCache, reset_shared_caches,
+                                      shared_cache)
+from cometbft_tpu.light.provider import MemProvider
+from cometbft_tpu.light.store import LightStore
+from cometbft_tpu.store.db import MemDB
+
+from light_harness import LightChain
+
+CHAIN_ID = "statesync-cache-chain"
+PERIOD_NS = 10**18
+
+
+class _CountingProvider(MemProvider):
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.fetches = 0
+
+    async def light_block(self, height):
+        self.fetches += 1
+        return await super().light_block(height)
+
+
+def _client(chain, primary, cache: CheckpointCache):
+    """Mirror node.py's statesync wiring: teeing store + cache-first
+    checkpoint source."""
+
+    class _Teeing(LightStore):
+        def save_light_block(self, lb):
+            super().save_light_block(lb)
+            cache.put(lb)
+
+    client = light.Client(
+        CHAIN_ID,
+        light.TrustOptions(period_ns=PERIOD_NS, height=1,
+                           hash_=chain.blocks[1].hash()),
+        primary, [MemProvider(CHAIN_ID, chain.blocks, name="w0")],
+        _Teeing(MemDB()),
+    )
+    own = client.checkpoint_source
+
+    def cached_source(h):
+        hit = cache.nearest_at_or_below(h)
+        return hit if hit is not None else own(h)
+
+    client.checkpoint_source = cached_source
+    return client
+
+
+def test_shared_cache_is_one_instance_per_chain():
+    reset_shared_caches()
+    a = shared_cache("chain-A", capacity=64)
+    assert shared_cache("chain-A", capacity=999) is a  # first params win
+    assert shared_cache("chain-B") is not a
+    reset_shared_caches()
+
+
+def test_statesync_fast_forwards_from_cached_checkpoint():
+    async def main():
+        # full churn every height: valset overlap dies with distance, so
+        # the bootstrap genuinely bisects (several pivots)
+        chain = LightChain(CHAIN_ID, 120, n_vals=6, churn_every=1)
+        cache = CheckpointCache(capacity=256, trust_period_ns=PERIOD_NS)
+
+        # COLD bootstrap: count provider traffic without any checkpoints
+        cold_primary = _CountingProvider(CHAIN_ID, chain.blocks,
+                                         name="cold")
+        cold = _client(chain, cold_primary, CheckpointCache(
+            capacity=256, trust_period_ns=PERIOD_NS))
+        await cold.initialize()
+        await cold.verify_light_block_at_height(110)
+        cold_fetches = cold_primary.fetches
+        assert cold_fetches >= 5, "fixture must actually bisect"
+
+        # WARM bootstrap: the shared cache holds checkpoints the fleet
+        # (or a previous statesync) verified INSIDE the pivot walk — the
+        # bisection jumps to them instead of descending below
+        for h in (50, 100):
+            cache.put(chain.blocks[h])
+        warm_primary = _CountingProvider(CHAIN_ID, chain.blocks,
+                                         name="warm")
+        warm = _client(chain, warm_primary, cache)
+        await warm.initialize()
+        lb = await warm.verify_light_block_at_height(110)
+        assert lb.hash() == chain.blocks[110].hash()
+        assert warm_primary.fetches < cold_fetches, (
+            "cached checkpoint must cut the bisection's provider traffic")
+
+    asyncio.run(main())
+
+
+def test_statesync_verified_blocks_seed_the_shared_cache():
+    async def main():
+        chain = LightChain(CHAIN_ID, 40, n_vals=4, churn_every=4)
+        cache = CheckpointCache(capacity=256, trust_period_ns=PERIOD_NS)
+        client = _client(
+            chain, MemProvider(CHAIN_ID, chain.blocks, name="p"), cache)
+        await client.initialize()
+        await client.verify_light_block_at_height(35)
+        # every pivot statesync verified is now a checkpoint the fleet
+        # can serve from
+        hit = cache.nearest_at_or_below(35)
+        assert hit is not None and hit.height >= 1
+        assert cache.nearest_at_or_below(10**9).height <= 35
+
+    asyncio.run(main())

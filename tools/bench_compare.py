@@ -96,6 +96,13 @@ TRACKED: dict[str, tuple[str, float]] = {
     # reconciliation protocol, not of host contention, and a jump means
     # the compact vote-set summaries stopped doing their job.
     "gossip_votes_per_vote_needed": (LOWER, 25.0),
+    # BLS aggregate commit verify at 10k validators (bench_bls): the
+    # one-pairing-product headline. Wide threshold — the host share is
+    # O(n) oracle point adds on a contended box — but a multiple-of-
+    # itself regression means aggregation stopped amortizing. Bare and
+    # section-prefixed like the mesh keys.
+    "bls_aggregate_verify_ms_10k": (LOWER, 50.0),
+    "bls.bls_aggregate_verify_ms_10k": (LOWER, 50.0),
 }
 
 # informational-by-design (wire/tunnel-bound): listed so the verdict can
@@ -128,6 +135,15 @@ INFORMATIONAL = {
     "partition_heal_p99_ms": "heal latency depends on redial backoff "
                              "phase and host contention; tracked for "
                              "trend until a quiet round",
+    # bench_bls crossover: the committee size where one pairing-product
+    # check beats per-lane ed25519 — informational because it is a
+    # BACKEND property (host point-add rate vs lane-verify rate), not a
+    # regression surface; it moves legitimately between CPU-extrapolated
+    # and accelerator-measured rounds
+    "bls.crossover_validators": "backend-dependent crossover point "
+                                "(aggregate vs batched-ed25519); moves "
+                                "between CPU and accelerator rounds by "
+                                "design — tracked for trend only",
 }
 
 
