@@ -1333,6 +1333,57 @@ def bench_fleet(detail: dict) -> None:
         detail["fleet_heights_per_s_50node"] = curve["50"]["heights_per_s"]
 
 
+def bench_storage(detail: dict) -> None:
+    """Storage-plane scenario: consensus-WAL fsync latency (the disk
+    floor under every committed height — the write_sync path EndHeight
+    rides) and sqlite transactional write latency, measured on a fresh
+    temp dir. Emits wal_fsync_p99_ms (TRACKED lower in
+    tools/bench_compare.py) bare and under detail["storage"]."""
+    import shutil
+    import tempfile
+
+    from cometbft_tpu.consensus.wal import WAL, EndHeightMessage
+    from cometbft_tpu.store.db import SQLiteDB
+
+    n = int(os.environ.get("BENCH_STORAGE_OPS", "300"))
+    d = tempfile.mkdtemp(prefix="bench-storage-")
+    try:
+        wal = WAL(os.path.join(d, "wal", "wal.bin"))
+        lat = []
+        for h in range(1, n + 1):
+            t0 = time.perf_counter()
+            wal.write_sync(EndHeightMessage(h))
+            lat.append(time.perf_counter() - t0)
+        wal.close()
+        lat.sort()
+        p50 = lat[len(lat) // 2] * 1e3
+        p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3
+
+        db = SQLiteDB(os.path.join(d, "kv.db"))
+        dlat = []
+        payload = b"\x5a" * 512
+        for i in range(n):
+            t0 = time.perf_counter()
+            db.set(b"bench-%06d" % i, payload)
+            dlat.append(time.perf_counter() - t0)
+        db.close()
+        dlat.sort()
+        detail["wal_fsync_p99_ms"] = round(p99, 3)
+        detail["storage"] = {
+            "wal_fsync_p50_ms": round(p50, 3),
+            "wal_fsync_p99_ms": round(p99, 3),
+            "db_write_p50_ms": round(dlat[len(dlat) // 2] * 1e3, 3),
+            "db_write_p99_ms": round(
+                dlat[min(len(dlat) - 1, int(len(dlat) * 0.99))] * 1e3, 3),
+            "ops": n,
+            "note": ("fsync latency on the bench host's disk; wide "
+                     "sentinel threshold — the contract is that the WAL "
+                     "write path stays one write+fsync, not the disk"),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def bench_scheduler(detail: dict) -> None:
     """Global verify scheduler under a mixed offered load (ISSUE 4
     acceptance): a 4-validator in-process net committing with batched
@@ -1690,8 +1741,8 @@ def main() -> dict:
     # -- subsystem benches (each guarded: a failure reports, not aborts)
     for fn in (bench_blocksync, bench_mixed_megacommit, bench_attribution,
                bench_light_client, bench_light_fleet, bench_bls,
-               bench_consensus_tpu, bench_scheduler, bench_mesh,
-               bench_fleet):
+               bench_consensus_tpu, bench_scheduler, bench_storage,
+               bench_mesh, bench_fleet):
         try:
             _progress(fn.__name__)
             fn(detail)

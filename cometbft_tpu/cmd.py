@@ -309,11 +309,45 @@ def cmd_rollback(args) -> int:
     from cometbft_tpu.store.db import open_db
 
     cfg = Config.load(_home(args))
-    block_store = BlockStore(open_db(cfg.base.db_backend, cfg.db_path("blockstore")))
-    state_store = StateStore(open_db(cfg.base.db_backend, cfg.db_path("state")))
+    block_store = BlockStore(open_db(
+        cfg.base.db_backend, cfg.db_path("blockstore"),
+        checksum=cfg.storage.checksum))
+    state_store = StateStore(open_db(
+        cfg.base.db_backend, cfg.db_path("state"),
+        checksum=cfg.storage.checksum))
     height, app_hash = rollback(block_store, state_store,
                                 remove_block=args.hard)
     print(f"Rolled back state to height {height} and hash {app_hash.hex().upper()}")
+    return 0
+
+
+def cmd_wal_repair(args) -> int:
+    """Repair a mid-group-corrupted consensus WAL on a STOPPED node (the
+    knob consensus/wal.py's WALCorruptionError names): the damaged chunk
+    keeps its good prefix (original preserved as <chunk>.corrupt), later
+    chunks are quarantined, and the node recovers the gap over
+    handshake/blocksync. A clean WAL is a no-op."""
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.consensus.wal import WAL
+
+    cfg = Config.load(_home(args))
+    wal = WAL(os.path.join(cfg.wal_path(), "wal"))
+    try:
+        report = wal.repair()
+    finally:
+        wal.close()
+    if report.corrupt_chunk is None:
+        print("WAL is clean; nothing to repair")
+        return 0
+    print(f"quarantined corruption in {report.corrupt_chunk} at byte "
+          f"offset {report.offset} ({report.truncated_bytes} bytes "
+          f"dropped; original kept as "
+          f"{os.path.basename(report.corrupt_chunk)}.corrupt)")
+    for q in report.quarantined:
+        print(f"quarantined unreplayable later chunk {q} -> "
+              f"{os.path.basename(q)}.quarantined")
+    print("the node will recover the dropped records over "
+          "handshake/blocksync at next boot")
     return 0
 
 
@@ -556,7 +590,9 @@ def cmd_loadtime(args) -> int:
         from cometbft_tpu.store.db import open_db
 
         cfg = Config.load(_home(args))
-        bs = BlockStore(open_db(cfg.base.db_backend, cfg.db_path("blockstore")))
+        bs = BlockStore(open_db(cfg.base.db_backend,
+                                cfg.db_path("blockstore"),
+                                checksum=cfg.storage.checksum))
         blocks = loadtime.blocks_from_store(bs)
     reports = loadtime.report_from_blocks(blocks)
     for rep in reports.values():
@@ -606,6 +642,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--hard", action="store_true",
                     help="also remove the block at the rolled-back height")
     sp.set_defaults(fn=cmd_rollback)
+
+    sp = sub.add_parser(
+        "wal-repair",
+        help="quarantine mid-group consensus-WAL corruption on a stopped "
+             "node (the repair WALCorruptionError names)")
+    sp.set_defaults(fn=cmd_wal_repair)
 
     sp = sub.add_parser("inspect", help="serve read-only RPC over a stopped node's data")
     sp.add_argument("--rpc.laddr", dest="rpc_laddr", default="")

@@ -402,9 +402,43 @@ class StateSyncConfig:
 
 @dataclass
 class StorageConfig:
-    """config.go:1240-1265."""
+    """config.go:1240-1265, plus the storage-fault resilience plane
+    (libs/diskchaos, store/db hardening).
+
+    Durability semantics: `synchronous` is the sqlite pragma applied to
+    EVERY connection of the block/state/evidence/index DBs — NORMAL
+    (default) fsyncs the sqlite WAL at checkpoints (power loss can drop
+    the tail of recently-committed transactions, never corrupt; the
+    consensus WAL EndHeight fsync is what guards committed heights),
+    FULL fsyncs every commit. The privval sign-state is ALWAYS
+    FULL-grade (fsynced temp file + durable rename) regardless of this
+    knob — it is the one write whose loss enables a double-sign."""
 
     discard_abci_responses: bool = False
+    # sqlite synchronous pragma for the node's kv stores: NORMAL | FULL
+    synchronous: str = "NORMAL"
+    # CRC32-guard every block-store and state-store record value: a
+    # rotted bit surfaces as a typed ErrCorruptValue naming the repair
+    # path instead of a mis-parsed block. The guard changes the on-disk
+    # value format — a store written WITHOUT it must be read with
+    # checksum=false (or re-synced onto a fresh home); there is no
+    # mixed-format mode, by design: "maybe legacy" reads would give a
+    # rotted tag byte a way to smuggle a raw mis-parse past the guard
+    checksum: bool = True
+    # deterministic disk-fault schedule (libs/diskchaos.py syntax, e.g.
+    # "wal.fsync=fsync_lie:1,db.read=bitrot"); test/e2e only — the
+    # CBFT_DISK_CHAOS env var overlays this
+    chaos: str = ""
+
+    def validate_basic(self) -> None:
+        if self.synchronous not in ("NORMAL", "FULL"):
+            raise ValueError(
+                f"unknown storage.synchronous {self.synchronous!r} "
+                "(expected \"NORMAL\" or \"FULL\")")
+        if self.chaos:
+            from cometbft_tpu.libs import diskchaos as _diskchaos
+
+            _diskchaos.parse_spec(self.chaos)  # raises ValueError on any part
 
 
 @dataclass
@@ -496,7 +530,7 @@ class Config:
         """config.go:318 ValidateBasic: every section that defines one."""
         for section in (self.base, self.crypto, self.light, self.rpc,
                         self.p2p, self.mempool, self.block_sync,
-                        self.state_sync, self.tx_index,
+                        self.state_sync, self.storage, self.tx_index,
                         self.instrumentation):
             section.validate_basic()
 
@@ -558,7 +592,12 @@ class Config:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             f.write(self.to_toml())
-        os.replace(tmp, path)
+        # durable rename (libs/diskio): the e2e runner rewrites configs
+        # between respawns — a half-landed config after a crash-storm
+        # kill would boot the node with default knobs
+        from cometbft_tpu.libs import diskio
+
+        diskio.durable_replace(tmp, path)
         return path
 
     @classmethod

@@ -298,9 +298,23 @@ class ConsensusState(BaseService):
                 else:
                     if self.wal is not None:
                         if from_peer:
-                            self.wal.write(msg)
+                            # a failed peer-message WAL write is logged,
+                            # not fatal (reference state.go:822): the
+                            # message is DROPPED un-WALed — as if never
+                            # received — and gossip redelivers; a full or
+                            # failing disk degrades, it does not halt
+                            try:
+                                self.wal.write(msg)
+                            except OSError as e:
+                                self.logger.error(
+                                    "failed writing peer msg to WAL; "
+                                    "dropping msg", err=str(e))
+                                continue
                         else:
-                            self.wal.write_sync(msg)  # state.go:829 fsync own msgs
+                            # own messages MUST be durable before they
+                            # act (state.go:829 fsync): failure here is a
+                            # consensus failure, handled by containment
+                            self.wal.write_sync(msg)
                     await self._handle_msg(msg)
             except asyncio.CancelledError:
                 raise
@@ -727,7 +741,7 @@ class ConsensusState(BaseService):
                         parent=self._height_span, height=height):
             self.block_exec.validate_block(self.state, block)
 
-        fail.fail(0)  # state.go:1777
+        fail.fail_point("blockstore.save")  # state.go:1777 (legacy index 0)
         if self.block_store.height() < block.header.height:
             seen_extended = rs.votes.precommits(rs.commit_round).make_extended_commit()
             if self.state.consensus_params.abci.vote_extensions_enabled(block.header.height):
@@ -735,10 +749,12 @@ class ConsensusState(BaseService):
             else:
                 self.block_store.save_block(block, block_parts, seen_extended.to_commit())
 
-        fail.fail(1)  # state.go:1794
+        fail.fail_point("wal.endheight")  # state.go:1794 (legacy index 1)
         if self.wal is not None:
             self.wal.write_sync(EndHeightMessage(height))  # state.go:1810 fsync
-        fail.fail(2)  # state.go:1817 — the committed-but-unsaved crash window
+        # state.go:1817 (legacy index 2) — the committed-but-unapplied
+        # crash window: EndHeight is durable, ApplyBlock has not run
+        fail.fail_point("abci.apply")
 
         with trace.span("consensus.abci_exec", cat="consensus",
                         parent=self._height_span, height=height,

@@ -16,15 +16,50 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import struct
+import time
 import zlib
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Iterator
 
 from cometbft_tpu.consensus.round_state import RoundStepType
 from cometbft_tpu.consensus.ticker import TimeoutInfo
+from cometbft_tpu.libs import diskchaos, fail
 
 MAX_RECORD_SIZE = 4 * 1024 * 1024
+
+
+class WALCorruptionError(OSError):
+    """Mid-group WAL corruption: a chunk that is NOT the stream tail
+    failed its CRC/length framing — real disk damage, not a crash
+    artifact, so replay refuses to guess. The message names the chunk,
+    the byte offset of the first bad record, and the repair knob, so a
+    node that cannot boot tells the operator exactly what to run."""
+
+    def __init__(self, chunk: str, offset: int, detail: str):
+        super().__init__(
+            f"corrupted WAL chunk {chunk} at byte offset {offset} "
+            f"({detail}); this chunk is not the stream tail, so it is "
+            f"disk damage, not a torn crash-write. Repair: run "
+            f"`cometbft wal-repair --home <home>` — it quarantines the "
+            f"damaged chunk (kept as {os.path.basename(chunk)}.corrupt "
+            f"for forensics) and the unreplayable records after it, "
+            f"then the node boots and recovers the rest over "
+            f"handshake/blocksync.")
+        self.chunk = chunk
+        self.offset = offset
+        self.detail = detail
+
+
+@dataclass
+class RepairReport:
+    """What `WAL.repair()` (the `cometbft wal-repair` surface) did."""
+
+    corrupt_chunk: str | None = None
+    offset: int = 0
+    quarantined: list[str] = field(default_factory=list)
+    truncated_bytes: int = 0
 
 
 @dataclass
@@ -58,55 +93,135 @@ class WAL:
 
     def write_sync(self, msg) -> None:
         self._write_record(_encode_msg(msg))
-        self.group.fsync()
+        self._timed_fsync()
 
     def _write_record(self, body: bytes) -> None:
+        fail.fail_point("wal.write")
         crc = zlib.crc32(body) & 0xFFFFFFFF
         self.group.write(struct.pack(">II", crc, len(body)) + body)
         self.group.maybe_rotate()  # record boundary: safe rotation point
 
-    def flush(self) -> None:
+    def _timed_fsync(self) -> None:
+        from cometbft_tpu.libs import metrics as cmtmetrics
+
+        t0 = time.perf_counter()
         self.group.fsync()
+        cmtmetrics.storage_metrics().observe_wal_fsync(time.perf_counter() - t0)
+
+    def flush(self) -> None:
+        self._timed_fsync()
 
     def close(self) -> None:
         self.group.close()
 
     # -------------------------------------------------------------- read
 
+    @staticmethod
+    def _scan_chunk(path: str):
+        """Scan one chunk: yields (good_end, body) per valid record, then
+        returns via StopIteration.value a (good_end, detail|None) pair —
+        detail is None when the chunk is clean to its last byte."""
+        good_end = 0
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(8)
+                if len(hdr) < 8:
+                    detail = "torn record header" if hdr else None
+                    return good_end, detail
+                crc, n = struct.unpack(">II", hdr)
+                if n == 0:
+                    # crc32(b"") == 0, so an all-zero header would parse
+                    # as a "valid" empty record — but no encoded message
+                    # is empty; zeroed regions are damage
+                    return good_end, "zero-length record"
+                if n > MAX_RECORD_SIZE:
+                    return good_end, f"record length {n} exceeds {MAX_RECORD_SIZE}"
+                body = f.read(n)
+                body = diskchaos.fault_read("wal.read", body)
+                if len(body) < n:
+                    return good_end, f"torn record body ({len(body)} of {n} bytes)"
+                if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+                    return good_end, "crc32 mismatch"
+                good_end = f.tell()
+                yield good_end, body
+
     def iter_records(self) -> Iterator[object]:
         """Yield decoded messages across every chunk in stream order;
         stops at a corrupted record. Torn tails are repaired by truncation
         only in the FINAL file (a mid-group corruption means real damage,
-        not a crash artifact — reference wal.go repair semantics)."""
+        not a crash artifact — reference wal.go repair semantics) and the
+        truncation is counted on the storage metrics plane. Mid-group
+        corruption raises the TYPED WALCorruptionError naming the chunk,
+        the byte offset, and the `cometbft wal-repair` knob — never a
+        bare stack trace, and never a corrupt message."""
         paths = [p for p in self.group.chunk_paths() if os.path.exists(p)]
         for pi, path in enumerate(paths):
-            good_end = 0
-            corrupted = False
-            with open(path, "rb") as f:
-                while True:
-                    hdr = f.read(8)
-                    if len(hdr) < 8:
-                        break
-                    crc, n = struct.unpack(">II", hdr)
-                    if n > MAX_RECORD_SIZE:
-                        corrupted = True
-                        break
-                    body = f.read(n)
-                    if len(body) < n or (zlib.crc32(body) & 0xFFFFFFFF) != crc:
-                        corrupted = True
-                        break
-                    good_end = f.tell()
-                    yield _decode_msg(body)
+            scan = self._scan_chunk(path)
+            good_end, detail = 0, None
+            while True:
+                try:
+                    good_end, body = next(scan)
+                except StopIteration as stop:
+                    good_end, detail = stop.value
+                    break
+                yield _decode_msg(body)
             size = os.path.getsize(path)
             if good_end < size:
                 if pi == len(paths) - 1:
                     # torn tail: repair by truncation (reference auto-repair)
                     with open(path, "r+b") as f:
                         f.truncate(good_end)
+                    from cometbft_tpu.libs import metrics as cmtmetrics
+
+                    cmtmetrics.storage_metrics().wal_truncations.inc()
                 else:
-                    raise OSError(f"corrupted WAL chunk {path} (not the tail)")
-            if corrupted:
+                    raise WALCorruptionError(
+                        path, good_end, detail or "trailing garbage")
+            if detail is not None:
                 return
+
+    def repair(self) -> RepairReport:
+        """The `cometbft wal-repair` surface: make the group replayable
+        again after mid-group corruption. The damaged chunk keeps its
+        good prefix (the original is preserved as `<chunk>.corrupt` for
+        forensics) and every LATER chunk — records that cannot be safely
+        replayed across the gap — is quarantined as `<chunk>.quarantined`.
+        Sound because losing WAL tail records is equivalent to having
+        crashed slightly earlier: block/state stores and the privval
+        sign-state still guarantee no lost committed height and no
+        double-sign; the node recovers the gap over handshake/blocksync."""
+        report = RepairReport()
+        # quarantining may rename the head out from under the group's
+        # open handle — close first, reopen a fresh head after
+        self.group.close()
+        paths = [p for p in self.group.chunk_paths() if os.path.exists(p)]
+        for pi, path in enumerate(paths):
+            scan = self._scan_chunk(path)
+            while True:
+                try:
+                    next(scan)
+                except StopIteration as stop:
+                    good_end, detail = stop.value
+                    break
+            size = os.path.getsize(path)
+            if good_end >= size and detail is None:
+                continue
+            # first damage in stream order: truncate here, quarantine rest
+            report.corrupt_chunk, report.offset = path, good_end
+            report.truncated_bytes = size - good_end
+            shutil.copyfile(path, path + ".corrupt")
+            with open(path, "r+b") as f:
+                f.truncate(good_end)
+            for later in paths[pi + 1:]:
+                os.replace(later, later + ".quarantined")
+                report.quarantined.append(later)
+            from cometbft_tpu.libs import metrics as cmtmetrics
+
+            cmtmetrics.storage_metrics().wal_repairs.inc()
+            break
+        self.group._head = open(self.group.head_path, "ab", buffering=0)
+        diskchaos.track_open(self.group.head_path, fresh=True)
+        return report
 
     def search_for_end_height(self, height: int) -> bool:
         """True if EndHeightMessage(height) exists (wal.go:64)."""

@@ -30,17 +30,24 @@ VOTE_EXT_HEIGHT_OFFSETS = (0, 2)  # 0 = disabled
 # light-fleet restarts a node with the serving plane enabled, drives a
 # client swarm at light_verify, partitions the fleet node mid-soak, and
 # asserts post-heal p99 via the light_fleet metrics.
+# crash-storm cycles >= 3 kill-at-crash-site/respawns on one node
+# (CBFT_CRASH_SITE); disk-fault arms a bounded diskchaos schedule at
+# runtime (unsafe_disk_chaos) and asserts the faults were counted and
+# the node degraded or halted typed — never served a differing block.
 PERTURBATIONS = {"kill": 0.1, "pause": 0.1, "restart": 0.1,
                  "device-kill": 0.05, "device-flap": 0.05,
                  "chip-kill:1": 0.05, "chip-flap:1": 0.05,
                  "partition": 0.05, "byzantine": 0.05, "flood": 0.05,
-                 "light-fleet": 0.05}
+                 "light-fleet": 0.05,
+                 "crash-storm": 0.05, "crash-storm:abci.apply": 0.03,
+                 "disk-fault:bitrot": 0.04, "disk-fault:enospc": 0.03,
+                 "disk-fault:slow": 0.03}
 # perturbations that kill + respawn the OS process (a memdb node would
 # lose its stores while its out-of-process app keeps state); compared by
 # BASE name (chip-kill:N respawns too)
 RESPAWN_PERTURBATIONS = {"kill", "restart", "device-kill", "device-flap",
                          "chip-kill", "chip-flap", "byzantine", "flood",
-                         "light-fleet"}
+                         "light-fleet", "crash-storm", "disk-fault"}
 
 
 def generate_manifest(rng: random.Random, index: int) -> Manifest:

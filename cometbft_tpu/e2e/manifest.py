@@ -42,7 +42,16 @@ class NodeManifest:
     # serving faults: light-fleet (restart with the light-client fleet
     # service enabled, drive a simulated client swarm against
     # light_verify, partition the fleet node away mid-soak, and assert
-    # post-heal p99 recovery via the light_fleet metrics)
+    # post-heal p99 recovery via the light_fleet metrics);
+    # storage faults: crash-storm[:site] (>= 3 kill-at-crash-site /
+    # respawn cycles via CBFT_CRASH_SITE — site from libs/fail.SITES,
+    # default rotates through the commit-path sites; the chain must
+    # commit through the storm and the node rejoin fork-free),
+    # disk-fault[:kind] (arm a bounded libs/diskchaos schedule at
+    # runtime via unsafe_disk_chaos — kind from the non-crash subset
+    # below, default bitrot; every injected fault must be counted in
+    # storage_health and the node must degrade or halt typed, never
+    # serve a block that differs from the fault-free run)
     perturb: list[str] = field(default_factory=list)
     # fleet topologies: which region this node lives in (regional/hub
     # topologies wire peering and netchaos link profiles from this;
@@ -52,9 +61,14 @@ class NodeManifest:
     PERTURBATIONS = ("kill", "pause", "restart", "disconnect",
                      "device-kill", "device-flap",
                      "chip-kill", "chip-flap",
-                     "partition", "byzantine", "flood", "light-fleet")
+                     "partition", "byzantine", "flood", "light-fleet",
+                     "crash-storm", "disk-fault")
     # perturbations that take a ":<device-index>" argument
     INDEXED_PERTURBATIONS = ("chip-kill", "chip-flap")
+    # disk-fault kinds an OS process can survive to keep serving (the
+    # crash kinds torn_write/fsync_lie belong to the in-proc matrix,
+    # tests/test_storage_crash_matrix.py, which models the power cut)
+    DISK_FAULT_KINDS = ("bitrot", "enospc", "eio", "fsync_error", "slow")
 
     @staticmethod
     def split_perturb(p: str) -> tuple[str, str]:
@@ -75,10 +89,20 @@ class NodeManifest:
             base, arg = self.split_perturb(p)
             if base not in self.PERTURBATIONS:
                 raise ValueError(f"unknown perturbation {p!r}")
-            if arg:
-                if base not in self.INDEXED_PERTURBATIONS:
+            if not arg:
+                continue
+            if base == "crash-storm":
+                from cometbft_tpu.libs.fail import SITES
+
+                if arg not in SITES:
                     raise ValueError(
-                        f"perturbation {base!r} takes no index ({p!r})")
+                        f"unknown crash site in {p!r} (sites: {SITES})")
+            elif base == "disk-fault":
+                if arg not in self.DISK_FAULT_KINDS:
+                    raise ValueError(
+                        f"unknown disk-fault kind in {p!r} "
+                        f"(kinds: {self.DISK_FAULT_KINDS})")
+            elif base in self.INDEXED_PERTURBATIONS:
                 from cometbft_tpu.libs.chaos import MESH_CHAOS_DEVICES
 
                 try:
@@ -90,6 +114,9 @@ class NodeManifest:
                     raise ValueError(
                         f"device index out of range in {p!r} "
                         f"(0..{MESH_CHAOS_DEVICES - 1})")
+            else:
+                raise ValueError(
+                    f"perturbation {base!r} takes no index ({p!r})")
 
 
 @dataclass

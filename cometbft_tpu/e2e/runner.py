@@ -314,8 +314,11 @@ def _boot_staggered(net: _Net, wave: int = 12, pause: float = 1.0) -> None:
             time.sleep(pause)
 
 
-def _spawn_node(home: str, mesh_devices: int = 0):
+def _spawn_node(home: str, mesh_devices: int = 0,
+                extra_env: dict | None = None):
     env = _env()
+    if extra_env:
+        env.update(extra_env)
     if mesh_devices:
         # the axon TPU plugin self-registers from PYTHONPATH and ignores
         # JAX_PLATFORMS, which would leave this node with ONE real chip —
@@ -891,6 +894,88 @@ def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
                         raise RunError(
                             f"light-fleet on {name}: checkpoint cache "
                             f"recorded no hits")
+                elif p == "crash-storm":
+                    # >= 3 kill-at-crash-site / respawn cycles on ONE
+                    # node (CBFT_CRASH_SITE, libs/fail.py): each armed
+                    # incarnation must die at its site with exit 99, each
+                    # clean respawn must serve again; the shared tail
+                    # asserts the storm cost the chain nothing
+                    sites = ([p_arg] if p_arg else
+                             ["wal.endheight", "abci.apply", "state.save"])
+                    cycles = max(3, len(sites))
+                    for c in range(cycles):
+                        site = sites[c % len(sites)]
+                        log(f"[{manifest.name}] crash-storm {name} "
+                            f"cycle {c + 1}/{cycles} @ {site}")
+                        _kill(net.node_procs[i])
+                        proc = _spawn_node(
+                            net.homes[i],
+                            extra_env={"CBFT_CRASH_SITE": f"{site}:2"})
+                        net.node_procs[i] = proc
+                        t0 = time.time()
+                        while proc.poll() is None and time.time() - t0 < 150:
+                            time.sleep(0.5)
+                        if proc.poll() != 99:
+                            _kill(proc)
+                            raise RunError(
+                                f"crash-storm on {name}: site {site} never "
+                                f"fired (exit {proc.poll()})")
+                        net.node_procs[i] = _spawn_node(net.homes[i])
+                        _wait(lambda: _height(net, i) >= 1, 150,
+                              f"{name} serving after crash cycle {c + 1}")
+                elif p == "disk-fault":
+                    # arm a BOUNDED diskchaos schedule at runtime
+                    # (unsafe_disk_chaos): the node must degrade or halt
+                    # typed — never serve a block that differs from the
+                    # fault-free chain — and every injected fault must be
+                    # counted on the storage metrics plane
+                    kind = p_arg or "bitrot"
+                    spec = {"bitrot": "db.read=bitrot:2",
+                            "enospc": "wal.write=enospc:2",
+                            "eio": "db.write=eio:2",
+                            "fsync_error": "wal.fsync=fsync_error:1",
+                            "slow": "wal.fsync=slow:8"}[kind]
+                    log(f"[{manifest.name}] disk-fault {name} ({spec})")
+                    arg = urllib.parse.quote(f'"{spec}"')
+                    _rpc(net, i, f"unsafe_disk_chaos?spec={arg}")
+                    hq = manifest.initial_height + 1
+                    ref_hash = None
+                    if others:
+                        ref = _rpc(net, others[0], f"block?height={hq}")
+                        ref_hash = ref.get("result", {}).get(
+                            "block_id", {}).get("hash")
+                    deadline = time.time() + 60
+                    fired = 0.0
+                    while time.time() < deadline:
+                        # poke the read seam: the answer is the typed
+                        # error or the IDENTICAL block, never a wrong one
+                        try:
+                            doc = _rpc(net, i, f"block?height={hq}")
+                        except Exception:  # noqa: BLE001 - typed halt
+                            doc = {}
+                        if "result" in doc and ref_hash is not None:
+                            got = doc["result"]["block_id"]["hash"]
+                            if got != ref_hash:
+                                raise RunError(
+                                    f"disk-fault on {name}: served block "
+                                    f"{hq} hash {got} differs from fault-"
+                                    f"free {ref_hash}")
+                        fired = _metric_value(
+                            _metrics_text(net, i),
+                            "cometbft_storage_disk_faults")
+                        if fired >= 1:
+                            break
+                        time.sleep(1.0)
+                    if fired < 1:
+                        raise RunError(
+                            f"disk-fault on {name}: no injected fault "
+                            f"counted on /metrics within 60s")
+                    # clear the schedule and respawn: a node that halted
+                    # with the typed error must rejoin; a live one just
+                    # restarts (the shared tail asserts fork-free)
+                    _rpc(net, i, "unsafe_disk_chaos?clear=true")
+                    _kill(net.node_procs[i])
+                    net.node_procs[i] = _spawn_node(net.homes[i])
                 elif p in ("byzantine", "flood"):
                     # restart the node adversarially; the honest majority
                     # must DETECT it: equivocation -> DuplicateVoteEvidence

@@ -6,6 +6,13 @@ chunk_size (checked at record boundaries so records never split), it
 rotates to the next numbered chunk and a fresh head opens. Total size is
 bounded by pruning the oldest chunks (group.go:36 headSizeLimit /
 totalSizeLimit). Readers see one logical stream across chunks in order.
+
+Storage-fault plane: every append rides the `wal.write` disk-chaos seam,
+every fsync the `wal.fsync` seam, and the rotation rename is a
+durable_replace through `wal.rotate` — the head->chunk rename is only
+durable after the directory fsync, and a crash between the rename and
+the next write must leave a replayable group (tests: autofile
+rotation-crash cases in test_storage_crash_matrix.py).
 """
 
 from __future__ import annotations
@@ -13,6 +20,8 @@ from __future__ import annotations
 import os
 import re
 from typing import Iterator
+
+from cometbft_tpu.libs import diskchaos, diskio
 
 DEFAULT_CHUNK_SIZE = 10 * 1024 * 1024   # group.go:41 defaultHeadSizeLimit
 DEFAULT_TOTAL_SIZE = 1024 * 1024 * 1024  # group.go:42 defaultTotalSizeLimit
@@ -26,32 +35,47 @@ class Group:
         self.chunk_size = chunk_size
         self.total_size = total_size
         os.makedirs(os.path.dirname(head_path) or ".", exist_ok=True)
-        self._head = open(head_path, "ab")
+        # UNBUFFERED on purpose: a user-space Python buffer made every
+        # append's durability a lie — a kill -9 dropped records that
+        # write() had "accepted" but never handed to the OS. Unbuffered,
+        # a process kill loses nothing (the page cache survives); only
+        # power loss can, and that is exactly what the fsync discipline
+        # (and the fsync_lie chaos model) governs.
+        self._head = open(head_path, "ab", buffering=0)
+        # fsync-lie rewind anchor: bytes on disk at open are durable
+        diskchaos.track_open(head_path)
 
     # ------------------------------------------------------------- write
 
     def write(self, data: bytes) -> None:
-        self._head.write(data)
+        diskchaos.fault_write("wal.write", self._head, data)
 
     def flush(self) -> None:
         self._head.flush()
 
     def fsync(self) -> None:
         self._head.flush()
-        os.fsync(self._head.fileno())
+        diskchaos.fault_fsync("wal.fsync", self._head.fileno(), self.head_path)
 
     def maybe_rotate(self) -> bool:
         """Call at a record boundary; rotates the head into a numbered
         chunk when it exceeds chunk_size (group.go:190 checkHeadSizeLimit).
-        Returns True if a rotation happened."""
+        Returns True if a rotation happened. The rename is durable (dir
+        fsync) before the fresh head opens — a crash anywhere in between
+        leaves either the old head or the completed chunk, never a
+        half-renamed group."""
         if self._head.tell() < self.chunk_size:
             return False
         self.fsync()
         self._head.close()
         idx = self._chunk_indexes()
         nxt = (idx[-1] + 1) if idx else 0
-        os.replace(self.head_path, f"{self.head_path}.{nxt:03d}")
-        self._head = open(self.head_path, "ab")
+        diskio.durable_replace(
+            self.head_path, f"{self.head_path}.{nxt:03d}", site="wal.rotate")
+        self._head = open(self.head_path, "ab", buffering=0)
+        # fresh=True: the head path is a NEW empty file now — the renamed
+        # chunk's durable anchor must not ride along
+        diskchaos.track_open(self.head_path, fresh=True)
         self._prune()
         return True
 
@@ -72,6 +96,16 @@ class Group:
         except (OSError, ValueError):
             pass
         self._head.close()
+
+    def abandon(self) -> None:
+        """Crash-simulation teardown: close the raw handle WITHOUT the
+        close() fsync — the disk keeps exactly what the process had
+        handed the OS at 'death', so the crash-matrix harness examines
+        the same bytes a kill -9 would leave behind."""
+        try:
+            self._head.close()  # raw unbuffered: close never fsyncs
+        except (OSError, ValueError):
+            pass
 
     # -------------------------------------------------------------- read
 

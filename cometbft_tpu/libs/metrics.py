@@ -11,6 +11,7 @@ route (config.instrumentation.prometheus).
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import Optional, Sequence
 
 
@@ -655,6 +656,95 @@ class NetChaosMetrics:
             "Injected network faults by kind", labels=("kind",))
 
 
+class StorageMetrics:
+    """Storage-plane observability (libs/diskchaos, consensus/wal,
+    store/db — no reference analog): WAL fsync latency, torn-tail
+    truncations and wal-repair runs, db write latency, CRC-guard
+    corruption detections, and a per-(site,kind) counter for every
+    injected disk fault. Process-global like CryptoMetrics — the disk
+    chaos registry and the latency rollups are one per process. The
+    `storage_health` RPC section is rendered from health()."""
+
+    # rolling percentile windows: Prometheus histograms lose p50/p99
+    # resolution to bucket edges; operators reading storage_health get
+    # exact percentiles over the recent window instead
+    WINDOW = 4096
+
+    def __init__(self, reg: Registry):
+        self.wal_fsync_seconds = reg.histogram(
+            "storage", "wal_fsync_seconds", "Consensus WAL fsync latency",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 1.0))
+        self.wal_truncations = reg.counter(
+            "storage", "wal_truncations",
+            "Torn WAL tails repaired by truncation during replay")
+        self.wal_repairs = reg.counter(
+            "storage", "wal_repairs",
+            "wal-repair runs that quarantined a mid-group corrupt chunk")
+        self.db_write_seconds = reg.histogram(
+            "storage", "db_write_seconds",
+            "SQLite write-transaction latency (set/delete/batch)",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 1.0))
+        self.disk_faults = reg.counter(
+            "storage", "disk_faults",
+            "Injected disk faults by seam and kind (libs/diskchaos)",
+            labels=("site", "kind"))
+        self.corruption_detected = reg.counter(
+            "storage", "corruption_detected",
+            "CRC-guarded records that failed their checksum on read")
+        self._lock = threading.Lock()
+        self._wal_lat: deque[float] = deque(maxlen=self.WINDOW)
+        self._db_lat: deque[float] = deque(maxlen=self.WINDOW)
+
+    def observe_wal_fsync(self, seconds: float) -> None:
+        self.wal_fsync_seconds.observe(seconds)
+        with self._lock:
+            self._wal_lat.append(seconds)
+
+    def observe_db_write(self, seconds: float) -> None:
+        self.db_write_seconds.observe(seconds)
+        with self._lock:
+            self._db_lat.append(seconds)
+
+    @staticmethod
+    def _pct(sorted_vals: list[float], q: float) -> float | None:
+        if not sorted_vals:
+            return None
+        return sorted_vals[min(len(sorted_vals) - 1,
+                               int(len(sorted_vals) * q))]
+
+    def health(self) -> dict:
+        """The storage_health RPC's metric section: exact p50/p99 over
+        the recent latency windows plus the counter rollups."""
+        with self._lock:
+            wal = sorted(self._wal_lat)
+            db = sorted(self._db_lat)
+        # snapshot under the counter's own lock: a fault firing on
+        # another thread may be inserting a new (site,kind) series
+        with self.disk_faults._lock:
+            fault_items = sorted(self.disk_faults._values.items())
+        ms = 1000.0
+        return {
+            "wal": {
+                "fsyncs": self.wal_fsync_seconds.count_value(),
+                "fsync_p50_ms": (self._pct(wal, 0.50) or 0.0) * ms if wal else None,
+                "fsync_p99_ms": (self._pct(wal, 0.99) or 0.0) * ms if wal else None,
+                "truncations": self.wal_truncations.value(),
+                "repairs": self.wal_repairs.value(),
+            },
+            "db": {
+                "writes": self.db_write_seconds.count_value(),
+                "write_p50_ms": (self._pct(db, 0.50) or 0.0) * ms if db else None,
+                "write_p99_ms": (self._pct(db, 0.99) or 0.0) * ms if db else None,
+            },
+            "corruption_detected": self.corruption_detected.value(),
+            "disk_faults": {
+                "{}:{}".format(*key): v for key, v in fault_items
+            },
+        }
+
+
 _crypto: Optional[CryptoMetrics] = None
 _crypto_lock = threading.Lock()
 
@@ -726,3 +816,17 @@ def netchaos_metrics() -> NetChaosMetrics:
             if _netchaos is None:
                 _netchaos = NetChaosMetrics(global_registry())
     return _netchaos
+
+
+_storage: Optional[StorageMetrics] = None
+
+
+def storage_metrics() -> StorageMetrics:
+    """Process-global StorageMetrics on the global registry (same
+    double-checked init discipline as crypto_metrics)."""
+    global _storage
+    if _storage is None:
+        with _crypto_lock:
+            if _storage is None:
+                _storage = StorageMetrics(global_registry())
+    return _storage

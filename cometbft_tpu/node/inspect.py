@@ -34,8 +34,14 @@ class InspectNode:
         self.config = config
         self.logger = logger
         backend = config.base.db_backend
-        self.block_store = BlockStore(open_db(backend, config.db_path("blockstore")))
-        self.state_store = StateStore(open_db(backend, config.db_path("state")))
+        # honor the node's CRC-guard knob: the data was WRITTEN through
+        # the wrapper, so reading it raw would misparse every record
+        self.block_store = BlockStore(open_db(
+            backend, config.db_path("blockstore"),
+            checksum=config.storage.checksum))
+        self.state_store = StateStore(open_db(
+            backend, config.db_path("state"),
+            checksum=config.storage.checksum))
         self.node_key = NodeKey.load_or_gen(config.node_key_path())
         with open(config.genesis_path()) as f:
             from cometbft_tpu.types.genesis import GenesisDoc

@@ -163,6 +163,12 @@ class Node(BaseService):
 
             netchaos.arm_spec(config.p2p.chaos)
 
+        # disk-fault schedule (libs/diskchaos.py; CBFT_DISK_CHAOS overlays)
+        if config.storage.chaos:
+            from cometbft_tpu.libs import diskchaos
+
+            diskchaos.arm_spec(config.storage.chaos)
+
         # ---- genesis + identity (node.go:274-300)
         if genesis_doc is None:
             with open(config.genesis_path()) as f:
@@ -172,8 +178,15 @@ class Node(BaseService):
 
         # ---- storage (node/setup.go:127 initDBs)
         backend = config.base.db_backend
-        self.block_store = BlockStore(open_db(backend, config.db_path("blockstore")))
-        self.state_store = StateStore(open_db(backend, config.db_path("state")))
+        sync_mode = config.storage.synchronous
+        # CRC-guard exactly the stores a rotted bit can turn into an
+        # accepted-but-wrong block: block records and state records
+        self.block_store = BlockStore(open_db(
+            backend, config.db_path("blockstore"),
+            synchronous=sync_mode, checksum=config.storage.checksum))
+        self.state_store = StateStore(open_db(
+            backend, config.db_path("state"),
+            synchronous=sync_mode, checksum=config.storage.checksum))
         state = self.state_store.load()
         if state is None:
             state = State.from_genesis(genesis_doc)
@@ -201,7 +214,8 @@ class Node(BaseService):
 
         # ---- mempool + evidence (node.go:369-388)
         self.mempool = CListMempool(config.mempool, None)  # app conn wired on start
-        self._evidence_db = open_db(backend, config.db_path("evidence"))
+        self._evidence_db = open_db(backend, config.db_path("evidence"),
+                                    synchronous=sync_mode)
         self.evidence_pool = EvidencePool(self._evidence_db, self.state_store,
                                           block_store=self.block_store)
         self.event_switch = EventSwitch()
@@ -210,7 +224,8 @@ class Node(BaseService):
         # ---- indexers (node.go:311-320 createAndStartIndexerService)
         self._sql_sink = None
         if config.tx_index.indexer == "kv":
-            self._indexer_db = open_db(backend, config.db_path("tx_index"))
+            self._indexer_db = open_db(backend, config.db_path("tx_index"),
+                                       synchronous=sync_mode)
             self.tx_indexer = TxIndexer(self._indexer_db)
             self.block_indexer = BlockIndexer(self._indexer_db)
         elif config.tx_index.indexer == "sql":

@@ -18,11 +18,11 @@ from __future__ import annotations
 import base64
 import json
 import os
-import tempfile
 from dataclasses import dataclass
 
 from cometbft_tpu import crypto
 from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.libs import diskio, fail
 from cometbft_tpu.types.basic import SignedMsgType
 from cometbft_tpu.types.proposal import Proposal
 from cometbft_tpu.types.vote import Vote
@@ -56,15 +56,13 @@ class PrivValidator:
         raise NotImplementedError
 
 
-def _atomic_write(path: str, data: bytes) -> None:
-    d = os.path.dirname(path) or "."
-    fd, tmp = tempfile.mkstemp(dir=d)
-    try:
-        os.write(fd, data)
-        os.fsync(fd)
-    finally:
-        os.close(fd)
-    os.replace(tmp, path)
+def _atomic_write(path: str, data: bytes, site: str | None = None) -> None:
+    """FULL-grade durability: temp-file fsync AND directory fsync after
+    the rename (libs/diskio.durable_replace). The sign-state is the one
+    write whose loss enables a double-sign — a bare os.replace left the
+    rename in the un-fsynced directory, where power loss could resurrect
+    the OLD sign state with the new signature already on the wire."""
+    diskio.atomic_write_durable(path, data, site=site)
 
 
 @dataclass
@@ -141,6 +139,10 @@ class FilePV(PrivValidator):
     def _save_state(self) -> None:
         if not self.state_file:
             return
+        # crash window: signed in memory, nothing persisted, signature
+        # NOT yet released to the caller — dying here must never enable
+        # a double-sign (the restarted signer may legally re-sign)
+        fail.fail_point("privval.save")
         st = self.last_sign_state
         doc = {
             "height": st.height,
@@ -149,7 +151,8 @@ class FilePV(PrivValidator):
             "signature": base64.b64encode(st.signature).decode(),
             "signbytes": st.sign_bytes.hex(),
         }
-        _atomic_write(self.state_file, json.dumps(doc, indent=2).encode())
+        _atomic_write(self.state_file, json.dumps(doc, indent=2).encode(),
+                      site="privval.save")
 
     def _load_state(self) -> None:
         with open(self.state_file) as f:

@@ -113,6 +113,27 @@ class Environment:
 
         return dispatch.health_snapshot()
 
+    async def storage_health(self, _params: dict) -> dict:
+        """The storage-fault resilience snapshot (crypto_health's disk
+        sibling): WAL fsync p50/p99 and truncation/repair counts, db
+        write latency, CRC-guard corruption detections, per-(site,kind)
+        injected-fault counters, the armed disk-chaos schedule, and the
+        node's durability knobs. Served in inspect mode too — a crashed
+        node's storage plane remains examinable."""
+        from cometbft_tpu.libs import diskchaos
+        from cometbft_tpu.libs import metrics as cmtmetrics
+
+        snap = cmtmetrics.storage_metrics().health()
+        snap["disk_chaos"] = diskchaos.snapshot()
+        cfg = getattr(self.node, "config", None)
+        if cfg is not None:
+            snap["config"] = {
+                "synchronous": cfg.storage.synchronous,
+                "checksum": cfg.storage.checksum,
+                "db_backend": cfg.base.db_backend,
+            }
+        return snap
+
     async def status(self, _params: dict) -> dict:
         """rpc/core/status.go."""
         n = self.node
@@ -1088,6 +1109,24 @@ class Environment:
             netchaos.clear_partition()
         return {"net_chaos": netchaos.snapshot()}
 
+    async def unsafe_disk_chaos(self, params: dict) -> dict:
+        """Framework extension (the e2e disk-fault perturbations): arm or
+        clear the process-global disk-chaos registry at runtime. `spec`
+        uses the CBFT_DISK_CHAOS syntax (libs/diskchaos.py); `clear`
+        resets everything."""
+        from cometbft_tpu.libs import diskchaos
+
+        if self._bool_param(params.get("clear", False)):
+            diskchaos.reset()
+            return {"disk_chaos": diskchaos.snapshot()}
+        spec = str(params.get("spec", "") or "")
+        if spec:
+            try:
+                diskchaos.arm_spec(spec)
+            except ValueError as e:
+                raise RPCError(-32602, str(e)) from None
+        return {"disk_chaos": diskchaos.snapshot()}
+
     # ------------------------------------------------------------ table
 
     def routes(self) -> dict:
@@ -1101,6 +1140,7 @@ class Environment:
                 "unsafe_flush_mempool": self.unsafe_flush_mempool,
                 "unsafe_disconnect_peers": self.unsafe_disconnect_peers,
                 "unsafe_net_chaos": self.unsafe_net_chaos,
+                "unsafe_disk_chaos": self.unsafe_disk_chaos,
             })
         return table
 
@@ -1108,6 +1148,7 @@ class Environment:
         return {
             "health": self.health,
             "crypto_health": self.crypto_health,
+            "storage_health": self.storage_health,
             "trace_dump": self.trace_dump,
             "status": self.status,
             "net_info": self.net_info,

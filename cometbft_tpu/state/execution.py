@@ -297,14 +297,17 @@ class BlockExecutor:
                 f"app returned {len(resp.tx_results)} tx results for {len(block.data.txs)} txs"
             )
         self.state_store.save_finalize_block_response(block.header.height, resp)
-        fail.fail(3)  # execution.go:251
+        fail.fail_point("state.finalize")  # execution.go:251 (legacy index 3)
 
         new_state = self._update_state(state, block_id, block, resp)
         self.state_store.save(new_state)
-        fail.fail(4)  # execution.go:258
+        fail.fail_point("state.save")  # execution.go:258 (legacy index 4)
 
         # Commit: app state persistence + mempool maintenance
         commit_resp = await self.app_conn.commit(abci.RequestCommit())
+        # app and node state now agree on the height; only the mempool
+        # rebuild and event fan-out remain (recovered by re-check)
+        fail.fail_point("app.commit")
         await self.mempool.update(block.header.height, block.data.txs, resp.tx_results)
 
         if self.evidence_pool is not None:

@@ -15,6 +15,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass
 
+from cometbft_tpu.libs import diskchaos
 from cometbft_tpu.store.db import KVStore
 from cometbft_tpu.types.basic import BlockID
 from cometbft_tpu.types.block import Block, Header
@@ -108,6 +109,10 @@ class BlockStore:
     ) -> None:
         if block is None or not part_set.is_complete():
             raise ValueError("BlockStore can only save complete block part sets")
+        # the block-store disk seam: an injected ENOSPC/EIO here must
+        # surface BEFORE any pair lands (the batch below is one
+        # transaction either way)
+        diskchaos.fault_op("blockstore.save")
         height = block.header.height
         with self._lock:
             if self._height > 0 and height != self._height + 1:

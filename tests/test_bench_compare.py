@@ -195,6 +195,37 @@ class TestDirectionAwareCompare:
         assert row["verdict"] == "info"
         assert "backend-dependent" in row["why_info"]
 
+    def test_wal_fsync_is_enforced_lower_better(self):
+        """Storage sentinel wiring (ISSUE 14): the consensus-WAL fsync
+        p99 regressing UP past 75% fails — both the bare detail key and
+        the storage.-prefixed section key; the same delta as an
+        improvement passes."""
+        old = _record(wal_fsync_p99_ms=2.0,
+                      storage={"wal_fsync_p99_ms": 2.0,
+                               "db_write_p50_ms": 0.4})
+        worse = _record(wal_fsync_p99_ms=6.0,
+                        storage={"wal_fsync_p99_ms": 6.0,
+                                 "db_write_p50_ms": 0.4})
+        v = bc.compare(old, worse)
+        assert v["verdict"] == "fail"
+        assert "wal_fsync_p99_ms" in v["regressions"]
+        assert "storage.wal_fsync_p99_ms" in v["regressions"]
+        assert bc.compare(worse, old)["verdict"] == "pass"
+
+    def test_wal_fsync_sentinel_self_test_case(self):
+        """--self-test contract on a storage-shaped record: an injected
+        wal-fsync regression is flagged; the identical snapshot and the
+        improvement direction are not."""
+        rec = _record(wal_fsync_p99_ms=2.0)
+        worse, metric, pct = bc.inject_regression(
+            rec, metric="wal_fsync_p99_ms")
+        assert metric == "wal_fsync_p99_ms" and pct > 75.0
+        caught = bc.compare(rec, worse)
+        assert caught["verdict"] == "fail"
+        assert metric in caught["regressions"]
+        assert bc.compare(rec, rec)["verdict"] == "pass"
+        assert bc.compare(worse, rec)["verdict"] == "pass"
+
     def test_bls_sentinel_self_test_case(self):
         """--self-test contract on a bls-shaped record: an injected
         aggregate-ms regression is flagged; the identical snapshot and

@@ -102,6 +102,57 @@ def test_light_fleet_perturbation_is_legal_and_roundtrips():
     assert "light-fleet" in RESPAWN_PERTURBATIONS
 
 
+def test_storage_fault_perturbations_are_legal_and_roundtrip():
+    """crash-storm[:site] / disk-fault[:kind] (runner.py: CBFT_CRASH_SITE
+    kill/respawn cycles and runtime unsafe_disk_chaos schedules) are
+    first-class matrix cells, validated like chip-kill."""
+    m = Manifest(nodes={
+        "a": NodeManifest(perturb=["crash-storm:abci.apply"]),
+        "b": NodeManifest(perturb=["disk-fault:bitrot"]),
+        "c": NodeManifest(perturb=["crash-storm", "disk-fault"]),
+        "d": NodeManifest(),
+    })
+    m.validate()
+    assert Manifest.from_toml(m.to_toml()) == m
+    # bad args are rejected with the legal sets named
+    import pytest
+
+    with pytest.raises(ValueError, match="crash site"):
+        Manifest(nodes={
+            "a": NodeManifest(perturb=["crash-storm:no.such.site"]),
+        }).validate()
+    with pytest.raises(ValueError, match="disk-fault kind"):
+        Manifest(nodes={
+            "a": NodeManifest(perturb=["disk-fault:torn_write"]),
+        }).validate()
+    # every disk-fault kind the manifest allows maps to a runner spec
+    # that parses against the live diskchaos registry
+    from cometbft_tpu.libs import diskchaos
+
+    for kind in NodeManifest.DISK_FAULT_KINDS:
+        m2 = Manifest(nodes={
+            "a": NodeManifest(perturb=[f"disk-fault:{kind}"]),
+            "b": NodeManifest(), "c": NodeManifest(), "d": NodeManifest(),
+        })
+        m2.validate()
+        assert kind in diskchaos.KINDS
+    # crash-storm sites come from the fail registry
+    from cometbft_tpu.libs import fail
+
+    for site in ("wal.endheight", "abci.apply", "state.save"):
+        assert site in fail.SITES
+    # both are matrix cells that respawn -> must force sqlite
+    from cometbft_tpu.e2e.generator import (
+        PERTURBATIONS,
+        RESPAWN_PERTURBATIONS,
+    )
+
+    assert "crash-storm" in RESPAWN_PERTURBATIONS
+    assert "disk-fault" in RESPAWN_PERTURBATIONS
+    assert any(p.partition(":")[0] == "crash-storm" for p in PERTURBATIONS)
+    assert any(p.partition(":")[0] == "disk-fault" for p in PERTURBATIONS)
+
+
 def test_runner_setup_materializes_manifest(tmp_path):
     from cometbft_tpu.config import Config
     from cometbft_tpu.e2e.runner import setup

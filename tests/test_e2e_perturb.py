@@ -255,6 +255,33 @@ def test_runner_partition_byzantine_flood_matrix(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.crash
+def test_runner_crash_storm_and_disk_fault(tmp_path):
+    """The storage-plane perturbations on real OS processes: node0 rides
+    >= 3 kill-at-crash-site/respawn cycles (each armed incarnation must
+    die at its site with exit 99, each respawn must rejoin), then an
+    armed bitrot schedule on its db.read seam — the runner asserts every
+    injected fault is counted on /metrics and that the node never serves
+    a block that differs from the fault-free chain; the net must end
+    fork-free at the target height."""
+    from cometbft_tpu.e2e.manifest import Manifest, NodeManifest
+    from cometbft_tpu.e2e.runner import run_manifest
+
+    m = Manifest(
+        name="crash-storm-disk-fault",
+        nodes={
+            "node0": NodeManifest(perturb=["crash-storm",
+                                           "disk-fault:bitrot"]),
+            "node1": NodeManifest(),
+            "node2": NodeManifest(),
+            "node3": NodeManifest(),
+        },
+    )
+    m.validate()
+    run_manifest(m, str(tmp_path / "net"), base_port=30900)
+
+
+@pytest.mark.slow
 @pytest.mark.chaos
 def test_runner_light_fleet_perturbation(tmp_path):
     """The serving-plane perturbation on real OS processes: one node is

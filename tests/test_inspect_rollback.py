@@ -67,8 +67,14 @@ def test_rollback_soft_and_hard(tmp_path):
         from cometbft_tpu.store import BlockStore
         from cometbft_tpu.store.db import open_db
 
-        block_store = BlockStore(open_db(cfg.base.db_backend, cfg.db_path("blockstore")))
-        state_store = StateStore(open_db(cfg.base.db_backend, cfg.db_path("state")))
+        # the node wrote through the CRC guard (storage.checksum): read
+        # back through it too, like cmd_rollback does
+        block_store = BlockStore(open_db(
+            cfg.base.db_backend, cfg.db_path("blockstore"),
+            checksum=cfg.storage.checksum))
+        state_store = StateStore(open_db(
+            cfg.base.db_backend, cfg.db_path("state"),
+            checksum=cfg.storage.checksum))
         h0 = block_store.height()
         s0 = state_store.load()
         assert s0.last_block_height in (h0, h0 - 1)

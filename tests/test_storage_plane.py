@@ -1,0 +1,683 @@
+"""Storage-fault plane units: the libs/diskchaos fault registry and its
+seams, libs/diskio durable-rename primitives, the hardened SQLiteDB
+(explicit transactions, per-connection synchronous pragma, cross-thread
+close), the CRCStore bit-rot guard, the typed WAL corruption error +
+wal-repair surface, the [storage] config knobs, and the storage_health /
+unsafe_disk_chaos RPC routes.
+
+The crash-matrix and fuzz coverage lives in test_storage_crash_matrix.py;
+this file proves each primitive's contract in isolation.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import sqlite3
+import threading
+
+import pytest
+
+from cometbft_tpu.consensus.wal import (
+    WAL,
+    EndHeightMessage,
+    WALCorruptionError,
+)
+from cometbft_tpu.libs import diskchaos, diskio
+from cometbft_tpu.libs import metrics as cmtmetrics
+from cometbft_tpu.store.db import (
+    CRCStore,
+    ErrCorruptValue,
+    MemDB,
+    SQLiteDB,
+    open_db,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_diskchaos():
+    diskchaos.reset()
+    yield
+    diskchaos.reset()
+
+
+def _crash_recorder():
+    """A crash hook that records the site and raises SimulatedCrash."""
+    hits = []
+
+    def hook(site):
+        hits.append(site)
+        raise diskchaos.SimulatedCrash(site)
+
+    return hits, hook
+
+
+# ---------------------------------------------------------------- registry
+
+
+class TestDiskChaosRegistry:
+    def test_parse_spec(self):
+        triples = diskchaos.parse_spec(
+            "wal.fsync=fsync_lie:2, db.read=bitrot")
+        assert triples == [("wal.fsync", "fsync_lie", 2),
+                          ("db.read", "bitrot", None)]
+
+    @pytest.mark.parametrize("spec,msg", [
+        ("wal.nope=eio", "unknown disk-chaos site"),
+        ("wal.write=melt", "unknown disk-chaos kind"),
+        ("wal.write=eio:x", "bad disk-chaos count"),
+        ("wal.write=eio:-1", "negative disk-chaos count"),
+    ])
+    def test_parse_spec_rejects(self, spec, msg):
+        with pytest.raises(ValueError, match=msg):
+            diskchaos.parse_spec(spec)
+
+    def test_arm_spec_validates_whole_spec_before_arming_any(self):
+        with pytest.raises(ValueError):
+            diskchaos.arm_spec("db.read=bitrot,wal.write=melt")
+        assert diskchaos.armed("db.read") is None
+
+    def test_counted_firings_exhaust_and_snapshot(self):
+        diskchaos.arm("db.write", "enospc", count=2)
+        m = cmtmetrics.storage_metrics()
+        before = m.disk_faults.value("db.write", "enospc")
+        for _ in range(2):
+            with pytest.raises(diskchaos.DiskChaosError):
+                diskchaos.fault_op("db.write")
+        diskchaos.fault_op("db.write")  # exhausted: passes clean
+        snap = diskchaos.snapshot()
+        assert snap["db.write"]["fired"] == 2
+        assert snap["db.write"]["remaining"] == 0
+        assert diskchaos.armed("db.write") is None
+        assert m.disk_faults.value("db.write", "enospc") == before + 2
+
+    def test_inapplicable_kind_waits_at_wrong_seam(self):
+        # bitrot applies at read seams only: a write seam must pass it
+        # through un-consumed, still armed for the read that follows
+        diskchaos.arm("db.read", "bitrot", count=1)
+        diskchaos.fault_op("db.read")  # write-shaped seam: no fire
+        assert diskchaos.fired("db.read") == 0
+        assert diskchaos.armed("db.read") == "bitrot"
+        assert diskchaos.fault_read("db.read", b"\x00") == b"\x01"
+        assert diskchaos.fired("db.read") == 1
+
+    def test_env_schedule_loads_lazily(self, monkeypatch):
+        monkeypatch.setenv("CBFT_DISK_CHAOS", "wal.write=eio:1")
+        diskchaos.reset()
+        # reset() pins the env as consumed; force a fresh lazy load
+        diskchaos._env_loaded = False
+        assert diskchaos.armed("wal.write") == "eio"
+        diskchaos.reset()
+        assert diskchaos.armed("wal.write") is None
+
+
+# ------------------------------------------------------------------- seams
+
+
+class TestSeams:
+    def test_fault_write_torn_leaves_strict_prefix_then_crashes(self, tmp_path):
+        hits, hook = _crash_recorder()
+        diskchaos.set_crash_hook(hook)
+        diskchaos.arm("wal.write", "torn_write")
+        p = tmp_path / "f"
+        with open(p, "wb", buffering=0) as fh:
+            with pytest.raises(diskchaos.SimulatedCrash):
+                diskchaos.fault_write("wal.write", fh, b"x" * 100)
+        assert hits == ["wal.write"]
+        torn = p.read_bytes()
+        assert 0 < len(torn) < 100
+
+    @pytest.mark.parametrize("kind,eno", [("enospc", errno.ENOSPC),
+                                          ("eio", errno.EIO)])
+    def test_fault_write_errno_kinds(self, tmp_path, kind, eno):
+        diskchaos.arm("wal.write", kind)
+        p = tmp_path / "f"
+        with open(p, "wb") as fh:
+            with pytest.raises(diskchaos.DiskChaosError) as ei:
+                diskchaos.fault_write("wal.write", fh, b"data")
+        assert ei.value.errno == eno
+        assert p.read_bytes() == b""  # nothing landed
+
+    def test_fsync_lie_rewinds_to_last_real_fsync(self, tmp_path):
+        p = str(tmp_path / "f")
+        with open(p, "wb", buffering=0) as fh:
+            diskchaos.track_open(p)
+            fh.write(b"AAAA")
+            diskchaos.fault_fsync("wal.fsync", fh.fileno(), p)  # real
+            fh.write(b"BBBB")
+            diskchaos.arm("wal.fsync", "fsync_lie", count=1)
+            diskchaos.fault_fsync("wal.fsync", fh.fileno(), p)  # the lie
+        repaired = diskchaos.crash_truncate()
+        assert p in repaired
+        # the lied-about bytes are gone; the genuinely-fsynced ones stay
+        assert open(p, "rb").read() == b"AAAA"
+
+    def test_fsync_error_raises_eio(self, tmp_path):
+        p = str(tmp_path / "f")
+        diskchaos.arm("wal.fsync", "fsync_error", count=1)
+        with open(p, "wb", buffering=0) as fh:
+            with pytest.raises(diskchaos.DiskChaosError) as ei:
+                diskchaos.fault_fsync("wal.fsync", fh.fileno(), p)
+        assert ei.value.errno == errno.EIO
+
+    def test_replace_lie_rolls_back_to_old_content(self, tmp_path):
+        src, dst = str(tmp_path / "s"), str(tmp_path / "d")
+        open(dst, "wb").write(b"OLD")
+        open(src, "wb").write(b"NEW")
+        diskchaos.arm("privval.save", "fsync_lie", count=1)
+        diskchaos.fault_replace("privval.save", src, dst)
+        assert open(dst, "rb").read() == b"NEW"  # visible until the crash
+        diskchaos.crash_truncate()
+        assert open(dst, "rb").read() == b"OLD"  # the power cut undid it
+        # the OLD directory entry wins: src is back with the new content
+        assert open(src, "rb").read() == b"NEW"
+
+    def test_replace_lie_unlinks_when_dst_was_absent(self, tmp_path):
+        src, dst = str(tmp_path / "s"), str(tmp_path / "d")
+        open(src, "wb").write(b"NEW")
+        diskchaos.arm("privval.save", "fsync_lie", count=1)
+        diskchaos.fault_replace("privval.save", src, dst)
+        diskchaos.crash_truncate()
+        assert not os.path.exists(dst)
+        assert open(src, "rb").read() == b"NEW"  # content not destroyed
+
+    def test_replace_torn_crashes_before_rename_lands(self, tmp_path):
+        _, hook = _crash_recorder()
+        diskchaos.set_crash_hook(hook)
+        src, dst = str(tmp_path / "s"), str(tmp_path / "d")
+        open(dst, "wb").write(b"OLD")
+        open(src, "wb").write(b"NEW")
+        diskchaos.arm("wal.rotate", "torn_write", count=1)
+        with pytest.raises(diskchaos.SimulatedCrash):
+            diskchaos.fault_replace("wal.rotate", src, dst)
+        assert open(dst, "rb").read() == b"OLD"
+        assert os.path.exists(src)
+
+    def test_fault_read_bitrot_flips_exactly_one_bit(self):
+        diskchaos.arm("db.read", "bitrot", count=1)
+        out = diskchaos.fault_read("db.read", b"\xff\xff")
+        assert out == b"\xfe\xff"
+        assert diskchaos.fault_read("db.read", b"\xff\xff") == b"\xff\xff"
+
+    def test_honest_fsync_cancels_pending_lie(self, tmp_path):
+        """An honest fsync flushes ALL dirty pages — including bytes an
+        earlier lie dropped. The recorded lie must not survive it, or
+        crash_truncate would destroy genuinely-durable data."""
+        p = str(tmp_path / "f")
+        with open(p, "wb", buffering=0) as fh:
+            diskchaos.track_open(p)
+            fh.write(b"AAAA")
+            diskchaos.arm("wal.fsync", "fsync_lie", count=1)
+            diskchaos.fault_fsync("wal.fsync", fh.fileno(), p)  # lie
+            fh.write(b"BBBB")
+            diskchaos.fault_fsync("wal.fsync", fh.fileno(), p)  # honest
+        assert diskchaos.crash_truncate() == []
+        assert open(p, "rb").read() == b"AAAABBBB"
+
+    def test_crash_truncate_never_zero_extends(self, tmp_path):
+        """Power loss can only SHRINK a file: a stale anchor larger than
+        the file must clamp, not zero-fill (zeroed regions would parse
+        as 'valid' empty WAL records — crc32(b'') == 0)."""
+        p = str(tmp_path / "f")
+        with open(p, "wb", buffering=0) as fh:
+            fh.write(b"x" * 100)
+            diskchaos.fault_fsync("wal.fsync", fh.fileno(), p)  # anchor 100
+            diskchaos.arm("wal.fsync", "fsync_lie", count=1)
+            fh.write(b"y" * 10)
+            diskchaos.fault_fsync("wal.fsync", fh.fileno(), p)  # lie @ 100
+        with open(p, "r+b") as f:
+            f.truncate(50)  # the file shrank after the anchor was taken
+        diskchaos.crash_truncate()
+        assert os.path.getsize(p) == 50  # clamped, not zero-extended
+
+    def test_rotation_reanchors_fresh_head(self, tmp_path):
+        """fresh=True at rotation: the renamed-away chunk's durable
+        anchor must not ride along onto the NEW empty head — a lie there
+        would rewind (and zero-extend) the wrong file."""
+        head = str(tmp_path / "wal.bin")
+        wal = WAL(head, chunk_size=512)
+        written = []
+        for h in range(1, 30):  # crosses at least one rotation
+            wal.write_sync(EndHeightMessage(h))
+            written.append(h)
+        assert os.path.exists(head + ".000")
+        diskchaos.arm("wal.fsync", "fsync_lie")
+        pre_lie_size = os.path.getsize(head)
+        wal.write_sync(EndHeightMessage(99))
+        wal.group.abandon()
+        diskchaos.crash_truncate()
+        diskchaos.reset()
+        # the lied record is gone, the pre-lie head bytes survive, and
+        # nothing was zero-extended
+        assert os.path.getsize(head) == pre_lie_size
+        wal2 = WAL(head, chunk_size=512)
+        assert [m.height for m in wal2.iter_records()] == written
+        wal2.close()
+
+    def test_honest_dir_fsync_cancels_rename_lies_in_dir(self, tmp_path):
+        """A genuine directory fsync persists EVERY pending rename entry
+        in that directory — earlier recorded rename lies must not roll
+        back at crash time."""
+        a_src, a_dst = str(tmp_path / "a_src"), str(tmp_path / "a")
+        b_src, b_dst = str(tmp_path / "b_src"), str(tmp_path / "b")
+        open(a_src, "wb").write(b"A-NEW")
+        open(b_src, "wb").write(b"B-NEW")
+        diskchaos.arm("privval.save", "fsync_lie", count=1)
+        diskchaos.fault_replace("privval.save", a_src, a_dst)  # lied
+        diskchaos.fault_replace("privval.save", b_src, b_dst)  # honest
+        assert diskchaos.crash_truncate() == []
+        assert open(a_dst, "rb").read() == b"A-NEW"
+        assert open(b_dst, "rb").read() == b"B-NEW"
+
+
+# ------------------------------------------------------------------ diskio
+
+
+class TestDiskIO:
+    def test_durable_replace(self, tmp_path):
+        src, dst = str(tmp_path / "s"), str(tmp_path / "d")
+        open(src, "wb").write(b"NEW")
+        diskio.durable_replace(src, dst)
+        assert open(dst, "rb").read() == b"NEW"
+        assert not os.path.exists(src)
+
+    def test_atomic_write_durable_failure_keeps_old_and_cleans_tmp(self, tmp_path):
+        dst = str(tmp_path / "d")
+        open(dst, "wb").write(b"OLD")
+        diskchaos.arm("privval.save", "enospc", count=1)
+        with pytest.raises(diskchaos.DiskChaosError):
+            diskio.atomic_write_durable(dst, b"NEW", site="privval.save")
+        assert open(dst, "rb").read() == b"OLD"
+        assert os.listdir(tmp_path) == ["d"]  # temp file removed
+
+    def test_atomic_write_durable_happy_path(self, tmp_path):
+        dst = str(tmp_path / "d")
+        diskio.atomic_write_durable(dst, b"NEW")
+        assert open(dst, "rb").read() == b"NEW"
+        assert os.listdir(tmp_path) == ["d"]
+
+
+# ---------------------------------------------------------------- SQLiteDB
+
+
+class TestSQLiteDB:
+    def test_synchronous_mode_validated(self, tmp_path):
+        with pytest.raises(ValueError, match="synchronous"):
+            SQLiteDB(str(tmp_path / "x.db"), synchronous="OFF")
+
+    @pytest.mark.parametrize("mode,pragma", [("NORMAL", 1), ("FULL", 2)])
+    def test_synchronous_pragma_on_every_connection(self, tmp_path, mode, pragma):
+        db = SQLiteDB(str(tmp_path / "x.db"), synchronous=mode)
+        seen = []
+
+        def worker():
+            # a SECOND thread mints its own connection — the pragma must
+            # ride along (the old code set it on the first conn only)
+            seen.append(db._conn().execute("PRAGMA synchronous").fetchone()[0])
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert db._conn().execute("PRAGMA synchronous").fetchone()[0] == pragma
+        assert seen == [pragma]
+        db.close()
+
+    def test_close_closes_other_threads_connections(self, tmp_path):
+        db = SQLiteDB(str(tmp_path / "x.db"))
+        minted = []
+
+        def worker():
+            db.set(b"k", b"v")
+            minted.append(db._local.conn)
+
+        threads = [threading.Thread(target=worker) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(db._conns) == 4  # main + 3 workers
+        db.close()
+        assert db._conns == []
+        for conn in minted:
+            with pytest.raises(sqlite3.ProgrammingError):
+                conn.execute("SELECT 1")
+
+    def test_use_after_close_reopens(self, tmp_path):
+        db = SQLiteDB(str(tmp_path / "x.db"))
+        db.set(b"k", b"v")
+        db.close()
+        assert db.get(b"k") == b"v"
+        db.close()
+
+    def test_torn_batch_rolls_back_whole_transaction(self, tmp_path):
+        _, hook = _crash_recorder()
+        diskchaos.set_crash_hook(hook)
+        db = SQLiteDB(str(tmp_path / "x.db"))
+        db.set(b"pre", b"1")
+        diskchaos.arm("db.write", "torn_write", count=1)
+        pairs = [(b"k%d" % i, b"v%d" % i) for i in range(6)]
+        with pytest.raises(diskchaos.SimulatedCrash):
+            db.batch_set(pairs)
+        # the mid-batch death is inside one transaction: NO pair landed
+        assert db.get(b"pre") == b"1"
+        for k, _ in pairs:
+            assert db.get(k) is None
+        db.batch_set(pairs)  # the connection survived the rollback
+        assert db.get(b"k5") == b"v5"
+        db.close()
+
+    def test_enospc_batch_rolls_back_and_surfaces(self, tmp_path):
+        db = SQLiteDB(str(tmp_path / "x.db"))
+        diskchaos.arm("db.write", "enospc", count=1)
+        with pytest.raises(diskchaos.DiskChaosError):
+            db.batch_set([(b"a", b"1"), (b"b", b"2"), (b"c", b"3")])
+        assert db.get(b"a") is None and db.get(b"c") is None
+        db.close()
+
+    def test_set_seam_fires_before_the_write(self, tmp_path):
+        db = SQLiteDB(str(tmp_path / "x.db"))
+        diskchaos.arm("db.write", "eio", count=1)
+        with pytest.raises(diskchaos.DiskChaosError):
+            db.set(b"k", b"v")
+        assert db.get(b"k") is None
+        db.delete(b"k")  # seam exhausted: normal ops resume
+        db.close()
+
+
+# ---------------------------------------------------------------- CRCStore
+
+
+class TestCRCStore:
+    def test_round_trip_all_ops(self):
+        s = CRCStore(MemDB())
+        s.set(b"a", b"1")
+        s.batch_set([(b"b", b"2"), (b"c", b"3")])
+        assert s.get(b"a") == b"1"
+        assert [(k, v) for k, v in s.iterate()] == [
+            (b"a", b"1"), (b"b", b"2"), (b"c", b"3")]
+        s.batch_set([(b"b", None)])
+        assert s.get(b"b") is None
+        s.delete(b"a")
+        assert s.get(b"a") is None
+
+    def test_values_are_wrapped_on_the_inner_store(self):
+        inner = MemDB()
+        s = CRCStore(inner)
+        s.set(b"k", b"payload")
+        raw = inner.get(b"k")
+        assert raw != b"payload" and len(raw) == len(b"payload") + 5
+
+    def test_flipped_bit_raises_typed_error_and_counts(self):
+        inner = MemDB()
+        s = CRCStore(inner)
+        s.set(b"k", b"payload")
+        raw = bytearray(inner.get(b"k"))
+        raw[3] ^= 0x10
+        inner.set(b"k", bytes(raw))
+        before = cmtmetrics.storage_metrics().corruption_detected.value()
+        with pytest.raises(ErrCorruptValue, match="crc32"):
+            s.get(b"k")
+        assert cmtmetrics.storage_metrics().corruption_detected.value() == before + 1
+        # the message names the repair path, not just the failure
+        with pytest.raises(ErrCorruptValue, match="rollback"):
+            s.get(b"k")
+
+    def test_missing_envelope_raises_and_counts(self):
+        inner = MemDB()
+        inner.set(b"k", b"zz")  # written past the guard
+        before = cmtmetrics.storage_metrics().corruption_detected.value()
+        with pytest.raises(ErrCorruptValue, match="envelope"):
+            CRCStore(inner).get(b"k")
+        # a rotted TAG byte takes this branch — it must count too
+        assert cmtmetrics.storage_metrics().corruption_detected.value() == before + 1
+
+    def test_bitrot_injection_is_caught_not_served(self, tmp_path):
+        db = open_db("sqlite", str(tmp_path / "x.db"), checksum=True)
+        db.set(b"height", b"block-bytes")
+        diskchaos.arm("db.read", "bitrot", count=1)
+        with pytest.raises(ErrCorruptValue):
+            db.get(b"height")
+        assert db.get(b"height") == b"block-bytes"
+        db.close()
+
+    def test_open_db_knobs(self, tmp_path):
+        assert isinstance(open_db("memdb"), MemDB)
+        guarded = open_db("memdb", checksum=True)
+        assert isinstance(guarded, CRCStore)
+        sq = open_db("sqlite", str(tmp_path / "s.db"), synchronous="FULL")
+        assert isinstance(sq, SQLiteDB) and sq.synchronous == "FULL"
+        sq.close()
+
+
+# ------------------------------------------------------- WAL typed error
+
+
+def _corrupt_mid_group_wal(tmp_path) -> str:
+    """A 3-chunk WAL with one flipped byte inside chunk .000's first
+    record body; returns the head path."""
+    path = str(tmp_path / "wal.bin")
+    wal = WAL(path, chunk_size=512)
+    for h in range(1, 60):
+        wal.write_sync(EndHeightMessage(h))
+    wal.close()
+    chunks = [p for p in wal.group.chunk_paths() if os.path.exists(p)]
+    assert len(chunks) >= 3
+    with open(chunks[0], "r+b") as f:
+        f.seek(12)
+        b = f.read(1)
+        f.seek(12)
+        f.write(bytes([b[0] ^ 0x40]))
+    return path
+
+
+class TestWALCorruption:
+    def test_mid_group_corruption_raises_typed_error(self, tmp_path):
+        path = _corrupt_mid_group_wal(tmp_path)
+        wal = WAL(path, chunk_size=512)
+        with pytest.raises(WALCorruptionError) as ei:
+            list(wal.iter_records())
+        err = ei.value
+        assert err.chunk.endswith(".000")
+        assert err.offset == 0  # the first record is the damaged one
+        # the message is the operator runbook: chunk, offset, and knob
+        s = str(err)
+        assert "wal-repair" in s and "byte offset" in s and ".000" in s
+        wal.close()
+
+    def test_repair_quarantines_and_makes_replayable(self, tmp_path):
+        path = _corrupt_mid_group_wal(tmp_path)
+        m = cmtmetrics.storage_metrics()
+        before = m.wal_repairs.value()
+        wal = WAL(path, chunk_size=512)
+        report = wal.repair()
+        assert report.corrupt_chunk.endswith(".000")
+        assert os.path.exists(report.corrupt_chunk + ".corrupt")
+        assert report.quarantined  # every later chunk moved aside
+        for q in report.quarantined:
+            assert os.path.exists(q + ".quarantined")
+            if q != path:
+                assert not os.path.exists(q)
+        # the head was quarantined too and reopened FRESH for new writes
+        assert os.path.getsize(path) == 0
+        assert m.wal_repairs.value() == before + 1
+        # the group replays clean after repair and accepts new records
+        assert list(wal.iter_records()) == []
+        wal.write_sync(EndHeightMessage(99))
+        assert wal.search_for_end_height(99)
+        wal.close()
+
+    def test_repair_on_clean_wal_is_noop(self, tmp_path):
+        path = str(tmp_path / "wal.bin")
+        wal = WAL(path)
+        wal.write_sync(EndHeightMessage(1))
+        report = wal.repair()
+        assert report.corrupt_chunk is None and not report.quarantined
+        assert wal.search_for_end_height(1)
+        wal.close()
+
+    def test_zeroed_tail_region_is_damage_not_empty_records(self, tmp_path):
+        """crc32(b'') == 0, so an all-zero 8-byte header would otherwise
+        parse as a valid zero-length record; no encoded message is ever
+        empty, so zeroed regions must repair away like any torn tail."""
+        path = str(tmp_path / "wal.bin")
+        wal = WAL(path)
+        wal.write_sync(EndHeightMessage(1))
+        wal.close()
+        with open(path, "ab") as f:
+            f.write(b"\x00" * 16)
+        wal2 = WAL(path)
+        msgs = list(wal2.iter_records())
+        assert [m.height for m in msgs] == [1]
+        wal2.close()
+
+    def test_torn_tail_still_truncation_repaired(self, tmp_path):
+        # the tail chunk keeps reference auto-repair: no typed error
+        path = str(tmp_path / "wal.bin")
+        wal = WAL(path)
+        wal.write_sync(EndHeightMessage(1))
+        wal.write_sync(EndHeightMessage(2))
+        wal.close()
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size - 3)
+        m = cmtmetrics.storage_metrics()
+        before = m.wal_truncations.value()
+        wal2 = WAL(path)
+        msgs = list(wal2.iter_records())
+        assert [x.height for x in msgs] == [1]
+        assert m.wal_truncations.value() == before + 1
+        wal2.close()
+
+
+class TestWalRepairCLI:
+    def _run(self, argv):
+        from cometbft_tpu import cmd as cli
+
+        parser = cli.build_parser()
+        args = parser.parse_args(argv)
+        return args.fn(args)
+
+    def test_wal_repair_command(self, tmp_path, capsys):
+        home = str(tmp_path / "home")
+        self._run(["--home", home, "init"])
+        capsys.readouterr()
+        from cometbft_tpu.config import Config
+
+        cfg = Config.load(home)
+        head = os.path.join(cfg.wal_path(), "wal")
+        wal = WAL(head, chunk_size=512)
+        for h in range(1, 60):
+            wal.write_sync(EndHeightMessage(h))
+        wal.close()
+        chunks = [p for p in wal.group.chunk_paths() if os.path.exists(p)]
+        with open(chunks[0], "r+b") as f:
+            f.seek(10)
+            f.write(b"\xde\xad")
+        assert self._run(["--home", home, "wal-repair"]) == 0
+        out = capsys.readouterr().out
+        assert "quarantined" in out and "handshake/blocksync" in out
+        # idempotent: a second run finds a clean WAL
+        assert self._run(["--home", home, "wal-repair"]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_wal_repair_clean_home(self, tmp_path, capsys):
+        home = str(tmp_path / "home")
+        self._run(["--home", home, "init"])
+        assert self._run(["--home", home, "wal-repair"]) == 0
+        assert "clean" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------------ config
+
+
+class TestStorageConfig:
+    def test_validate_rejects_bad_synchronous(self):
+        from cometbft_tpu.config.config import StorageConfig
+
+        cfg = StorageConfig(synchronous="EXTRA")
+        with pytest.raises(ValueError, match="storage.synchronous"):
+            cfg.validate_basic()
+
+    def test_validate_rejects_bad_chaos_spec(self):
+        from cometbft_tpu.config.config import StorageConfig
+
+        cfg = StorageConfig(chaos="wal.write=melt")
+        with pytest.raises(ValueError, match="disk-chaos kind"):
+            cfg.validate_basic()
+
+    def test_toml_round_trip(self, tmp_path):
+        from cometbft_tpu.config import Config
+
+        home = str(tmp_path / "home")
+        cfg = Config(home=home)
+        cfg.storage.synchronous = "FULL"
+        cfg.storage.checksum = False
+        cfg.storage.chaos = "wal.fsync=fsync_lie:1,db.read=bitrot"
+        cfg.validate_basic()
+        cfg.save()
+        cfg2 = Config.load(home)
+        assert cfg2.storage.synchronous == "FULL"
+        assert cfg2.storage.checksum is False
+        assert cfg2.storage.chaos == "wal.fsync=fsync_lie:1,db.read=bitrot"
+
+
+# ----------------------------------------------------------- metrics + RPC
+
+
+class _StubNode:
+    def __init__(self, config=None):
+        if config is not None:
+            self.config = config
+
+
+class TestStorageHealthRoutes:
+    def test_metrics_health_shape(self):
+        m = cmtmetrics.storage_metrics()
+        m.observe_wal_fsync(0.002)
+        m.observe_wal_fsync(0.004)
+        m.observe_db_write(0.001)
+        h = m.health()
+        assert h["wal"]["fsyncs"] >= 2
+        assert h["wal"]["fsync_p50_ms"] > 0
+        assert h["wal"]["fsync_p99_ms"] >= h["wal"]["fsync_p50_ms"]
+        assert h["db"]["write_p50_ms"] > 0
+        assert {"truncations", "repairs"} <= h["wal"].keys()
+        assert "corruption_detected" in h and "disk_faults" in h
+
+    def test_storage_health_route(self):
+        import asyncio
+
+        from cometbft_tpu.config.config import test_config
+        from cometbft_tpu.rpc.core import Environment
+
+        diskchaos.arm("db.read", "bitrot", count=3)
+        cfg = test_config(home="/tmp/does-not-matter")
+        cfg.storage.synchronous = "FULL"
+        env = Environment(_StubNode(config=cfg))
+        snap = asyncio.run(env.storage_health({}))
+        assert snap["disk_chaos"]["db.read"]["kind"] == "bitrot"
+        assert snap["config"]["synchronous"] == "FULL"
+        assert "wal" in snap and "db" in snap
+
+    def test_unsafe_disk_chaos_route(self):
+        import asyncio
+
+        from cometbft_tpu.rpc.core import Environment, RPCError
+
+        env = Environment(_StubNode())
+        out = asyncio.run(env.unsafe_disk_chaos(
+            {"spec": "wal.fsync=fsync_error:2"}))
+        assert out["disk_chaos"]["wal.fsync"]["kind"] == "fsync_error"
+        assert diskchaos.armed("wal.fsync") == "fsync_error"
+        with pytest.raises(RPCError):
+            asyncio.run(env.unsafe_disk_chaos({"spec": "bad=worse"}))
+        out = asyncio.run(env.unsafe_disk_chaos({"clear": True}))
+        assert out["disk_chaos"] == {}
+        assert diskchaos.armed("wal.fsync") is None
+
+    def test_unsafe_route_is_gated(self):
+        from cometbft_tpu.rpc.core import Environment
+
+        env = Environment(_StubNode())
+        assert "unsafe_disk_chaos" not in env.routes()
+        assert "storage_health" in env.routes()

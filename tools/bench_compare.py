@@ -103,6 +103,13 @@ TRACKED: dict[str, tuple[str, float]] = {
     # section-prefixed like the mesh keys.
     "bls_aggregate_verify_ms_10k": (LOWER, 50.0),
     "bls.bls_aggregate_verify_ms_10k": (LOWER, 50.0),
+    # consensus-WAL fsync p99 (bench_storage): the disk floor under
+    # every committed height. Wide threshold — absolute fsync latency is
+    # a property of the bench host's disk — but a multiple-of-itself
+    # jump means the WAL write path grew extra syncs/copies. Bare and
+    # storage.-prefixed like the mesh/bls keys.
+    "wal_fsync_p99_ms": (LOWER, 75.0),
+    "storage.wal_fsync_p99_ms": (LOWER, 75.0),
 }
 
 # informational-by-design (wire/tunnel-bound): listed so the verdict can
