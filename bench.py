@@ -973,15 +973,22 @@ def bench_consensus_tpu(detail: dict) -> None:
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
     from net_harness import make_net
 
+    from cometbft_tpu.consensus import timeline as cmttimeline
     from cometbft_tpu.consensus.config import test_consensus_config
     from cometbft_tpu.crypto import batch as crypto_batch
 
     crypto_batch.set_backend("tpu")
+    # heightline armed for the run: the per-phase anatomy
+    # (propose/prevote/precommit/commit/apply) of the same heights the
+    # p50 below times, and the fleet propagation p99
+    cmttimeline.configure(enabled=True)
 
     async def run():
         cfg = test_consensus_config()
         cfg.batch_vote_verification = True
         net = await make_net(4, config=cfg, chain_id="bench-consensus")
+        for nd in net.nodes:
+            nd.cs.timeline.node = nd.name
         heights = 10  # r4 verdict: 6 heights gave ~5 gaps, too thin a p50
         stamps = {}
 
@@ -1000,21 +1007,33 @@ def bench_consensus_tpu(detail: dict) -> None:
                 await asyncio.sleep(0.005)
         finally:
             await net.stop()
+        docs = [{"node_id": nd.name, "heights": nd.cs.timeline.snapshot(),
+                 "skew": {}} for nd in net.nodes]
+        agg = cmttimeline.aggregate(docs)
         if len(stamps) < 2:
-            return None
+            return None, agg
         # gaps only between ADJACENT observed heights (both really seen)
         gaps = sorted(
             stamps[i + 1] - stamps[i]
             for i in stamps if i + 1 in stamps
         )
         if not gaps:
-            return None
-        return gaps[len(gaps) // 2], len(stamps)
+            return None, agg
+        return (gaps[len(gaps) // 2], len(stamps)), agg
 
     try:
-        out = asyncio.run(run())
+        out, agg = asyncio.run(run())
     finally:
         crypto_batch.set_backend("auto")
+        cmttimeline.reset()
+    s = agg.get("summary") or {}
+    if s.get("phase_ms"):
+        detail["height_phase_ms"] = s["phase_ms"]
+    if s.get("phase_total_ms") is not None:
+        detail["height_phase_total_ms"] = s["phase_total_ms"]
+    if s.get("proposal_propagation_p99_ms") is not None:
+        detail["proposal_propagation_p99_ms"] = s[
+            "proposal_propagation_p99_ms"]
     if out is None:
         detail["consensus_tpu"] = "FAILED: net did not commit 2+ heights in 120s"
     else:

@@ -564,6 +564,87 @@ def cmd_netinfo(args) -> int:
     return 0 if all("error" not in n for n in nodes) else 1
 
 
+def cmd_heightline(args) -> int:
+    """Fleet consensus anatomy: pull the `consensus_timeline` route off
+    every RPC endpoint in --endpoints (defaults to the single
+    --rpc.laddr), fuse the per-node rings onto one skew-corrected clock
+    axis (consensus/timeline.aggregate) and print per-height phase
+    anatomy — propose -> prevote-quorum -> precommit-quorum -> commit ->
+    apply durations, per-node proposal propagation, the straggler and
+    the slowest vote link — plus the fleet summary. --trace additionally
+    writes a Perfetto-loadable Chrome trace of the fused timeline."""
+    import urllib.parse
+    import urllib.request
+
+    from cometbft_tpu.consensus import timeline
+    from cometbft_tpu.libs import trace as cmttrace
+
+    endpoints = [e for e in (args.endpoints or args.rpc_laddr).split(",") if e]
+    q = urllib.parse.urlencode(
+        {k: v for k, v in (("min_height", args.min_height),
+                           ("limit", args.limit)) if v})
+    docs, errors = [], []
+    for ep in endpoints:
+        base = ep.removeprefix("tcp://")
+        if not base.startswith("http"):
+            base = "http://" + base
+        url = f"{base}/consensus_timeline" + (f"?{q}" if q else "")
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                env = json.loads(r.read())
+            doc = env.get("result", env)
+        except Exception as e:  # noqa: BLE001 - report reachability per node
+            errors.append({"endpoint": ep, "error": str(e)})
+            continue
+        doc["endpoint"] = ep
+        docs.append(doc)
+    agg = timeline.aggregate(docs)
+    disabled = [d.get("moniker") or d.get("node_id", "")
+                for d in docs if not d.get("enabled", False)]
+    if args.json:
+        print(json.dumps({"aggregate": agg, "errors": errors,
+                          "timeline_disabled": disabled},
+                         indent=None if args.compact else 1))
+    else:
+        s = agg["summary"]
+        print(f"heightline: {s.get('heights', 0)} heights across "
+              f"{len(agg.get('offsets_ms', {}))} nodes "
+              f"(ref {agg.get('ref', '')!r})")
+        for nid, off in sorted((agg.get("offsets_ms") or {}).items()):
+            print(f"  clock offset {nid}: {off:+.3f} ms")
+        for rec in agg["heights"]:
+            parts = []
+            for phase in timeline.PHASES:
+                p = (rec["phases"] or {}).get(phase)
+                parts.append(f"{phase}={p['max_ms']:.1f}ms"
+                             if p else f"{phase}=?")
+            line = f"  h{rec['height']}: " + " ".join(parts)
+            if rec.get("straggler"):
+                lag = rec["proposal_propagation_ms"].get(rec["straggler"])
+                line += f"  straggler={rec['straggler']} ({lag:.1f}ms)"
+            link = rec.get("slowest_link")
+            if link:
+                line += (f"  slowest_link={link['from']}->{link['to']} "
+                         f"({link['lag_ms']:.1f}ms)")
+            print(line)
+        if s:
+            print(f"  phase_total_ms={s.get('phase_total_ms')}  "
+                  f"propagation p50={s.get('proposal_propagation_p50_ms')} "
+                  f"p99={s.get('proposal_propagation_p99_ms')}  "
+                  f"top_straggler={s.get('top_straggler')}")
+        for e in errors:
+            print(f"  unreachable {e['endpoint']}: {e['error']}")
+    if disabled:
+        print("note: timeline DISABLED on "
+              + ", ".join(disabled)
+              + " (instrumentation.timeline / CBFT_TIMELINE)")
+    if args.trace:
+        n_ev = cmttrace.write_chrome_trace(
+            args.trace, timeline.chrome_spans(agg, docs))
+        print(f"wrote {args.trace} ({n_ev} events; load at ui.perfetto.dev)")
+    return 0 if docs and not errors else 1
+
+
 def cmd_loadtime(args) -> int:
     """test/loadtime analog: 'run' drives stamped-tx load at RPC
     endpoints; 'report' recomputes per-tx latency from committed blocks."""
@@ -700,6 +781,28 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--compact", action="store_true",
                     help="single-line JSON output")
     sp.set_defaults(fn=cmd_netinfo)
+
+    sp = sub.add_parser(
+        "heightline",
+        help="fleet consensus anatomy: skew-aligned per-height phase "
+             "durations, proposal propagation, stragglers + slow links "
+             "across RPC endpoints")
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr",
+                    default="tcp://127.0.0.1:26657")
+    sp.add_argument("--endpoints", default="",
+                    help="comma-separated RPC endpoints (overrides "
+                         "--rpc.laddr; one consensus_timeline pull each)")
+    sp.add_argument("--min-height", type=int, default=0)
+    sp.add_argument("--limit", type=int, default=0,
+                    help="newest N heights per node (0 = all retained)")
+    sp.add_argument("--json", action="store_true",
+                    help="print the raw aggregate as JSON")
+    sp.add_argument("--compact", action="store_true",
+                    help="single-line JSON output (with --json)")
+    sp.add_argument("--trace", default="",
+                    help="also write a Chrome trace of the fused "
+                         "timeline to this path")
+    sp.set_defaults(fn=cmd_heightline)
 
     sp = sub.add_parser("loadtime", help="tx load generator + latency report")
     sp.add_argument("mode", choices=["run", "report"])

@@ -488,12 +488,28 @@ class InstrumentationConfig:
     trace_slow_ms: float = 250.0
     # how many slow captures are retained (FIFO)
     trace_slow_captures: int = 32
+    # --- consensus heightline (consensus/timeline.py) ---
+    # per-height critical-path event ring + clock-skew model; the
+    # CBFT_TIMELINE env var overlays `timeline` at node boot
+    timeline: bool = False
+    # bounded ring: how many recent heights keep their event records
+    timeline_heights: int = 64
+    # a height whose wall time exceeds this auto-captures a postmortem
+    # bundle (timeline + span captures + gossip/wire/scheduler context),
+    # served by the `postmortems` RPC route; <= 0 disables capture
+    height_slow_ms: float = 0.0
+    # how many postmortem bundles are retained (FIFO)
+    postmortem_captures: int = 8
 
     def validate_basic(self) -> None:
         if self.trace_buffer_spans < 1:
             raise ValueError("trace_buffer_spans must be >= 1")
         if self.trace_slow_captures < 1:
             raise ValueError("trace_slow_captures must be >= 1")
+        if self.timeline_heights < 1:
+            raise ValueError("timeline_heights must be >= 1")
+        if self.postmortem_captures < 1:
+            raise ValueError("postmortem_captures must be >= 1")
 
 
 @dataclass

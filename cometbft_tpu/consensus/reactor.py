@@ -22,6 +22,8 @@ import asyncio
 
 from cometbft_tpu.consensus import messages as M
 from cometbft_tpu.consensus import reactor_codec as codec
+from cometbft_tpu.consensus import timeline
+from cometbft_tpu.libs import linkmodel
 from cometbft_tpu.consensus.peer_state import PeerState
 from cometbft_tpu.consensus.round_state import RoundStepType
 from cometbft_tpu.consensus.state import ConsensusState
@@ -267,6 +269,14 @@ class ConsensusReactor(Reactor):
                 ps.ensure_vote_bit_arrays(height, valsize)
                 ps.ensure_vote_bit_arrays(height - 1, last_size)
                 self._account_vote_received(ps, rs, msg.vote)
+                if timeline.enabled() and msg.vote.height == rs.height:
+                    # vote-timestamp delta cross-check for the skew model:
+                    # only current-height votes (a gossiped old vote's age
+                    # would read as clock offset)
+                    linkmodel.skew().observe_vote(
+                        peer.id, msg.vote.timestamp.unix_ns(),
+                        cmttime.now().unix_ns(),
+                        getattr(peer.mconn, "_ping_rtt_s", 0.0))
                 ps.set_has_vote(
                     msg.vote.height, msg.vote.round_, msg.vote.type_,
                     msg.vote.validator_index,

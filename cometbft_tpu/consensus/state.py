@@ -26,6 +26,7 @@ import traceback
 from typing import Callable, Optional
 
 from cometbft_tpu.consensus import messages as M
+from cometbft_tpu.consensus import timeline
 from cometbft_tpu.consensus.config import ConsensusConfig
 from cometbft_tpu.consensus.height_vote_set import HeightVoteSet
 from cometbft_tpu.consensus.round_state import RoundState, RoundStepType
@@ -141,6 +142,13 @@ class ConsensusState(BaseService):
         # whole tree in the slow capture ring
         self._height_span = None
         self._height_span_h = 0
+
+        # heightline event ring (consensus/timeline.py): per-height
+        # critical-path marks + per-peer vote lag. Every hook is gated on
+        # the module _enabled flag, so the disabled consensus path pays
+        # one call + one bool test. Node boot labels it with the node id
+        # and installs the postmortem context collector.
+        self.timeline = timeline.Recorder()
 
         self.sync_to_state(state)
 
@@ -266,6 +274,12 @@ class ConsensusState(BaseService):
     # --------------------------------------------------------- public input
 
     async def add_vote_from_peer(self, vote: Vote, peer_id: str) -> None:
+        if timeline.enabled() and vote.height == self.rs.height:
+            # arrival lag against the vote's signing timestamp; recorded at
+            # enqueue so queue depth doesn't read as network lag
+            self.timeline.vote_arrival(
+                vote.height, vote.round_, int(vote.type_), peer_id,
+                vote.timestamp.unix_ns())
         await self.msg_queue.put((True, M.VoteMessage(vote=vote, peer_id=peer_id)))
 
     async def add_proposal_from_peer(self, proposal: Proposal, peer_id: str) -> None:
@@ -377,6 +391,9 @@ class ConsensusState(BaseService):
     def _new_step(self, step: RoundStepType) -> None:
         self.rs.step = step
         self.n_steps += 1
+        # stamp height/round into every log record this task emits from
+        # here on (libs/log.py context — grep-by-height works node-wide)
+        cmtlog.set_height_round(self.rs.height, self.rs.round_)
         trace.event(f"consensus.step.{step.name.lower()}", cat="consensus",
                     parent=self._height_span, height=self.rs.height,
                     round=self.rs.round_)
@@ -405,6 +422,7 @@ class ConsensusState(BaseService):
                 "consensus.height", cat="consensus", height=height,
                 slow_ms=trace.slow_budget_ms() + wait_ms)
             self._height_span_h = height
+        self.timeline.mark(height, timeline.NEW_HEIGHT, round_=round_)
         validators = rs.validators
         if rs.round_ < round_:
             validators = validators.copy()
@@ -445,6 +463,9 @@ class ConsensusState(BaseService):
         ):
             return
         rs.round_ = round_
+        # backstop for vote-driven height entries that skip enter_new_round
+        # (first-wins: a no-op when enter_new_round already stamped it)
+        self.timeline.mark(height, timeline.NEW_HEIGHT, round_=round_)
         self._new_step(RoundStepType.PROPOSE)
         self._schedule_timeout(
             self.config.propose_timeout(round_), height, round_, RoundStepType.PROPOSE
@@ -483,6 +504,7 @@ class ConsensusState(BaseService):
             part_msg = M.BlockPartMessage(height=rs.height, round_=rs.round_, part=block_parts.get_part(i))
             await self.msg_queue.put((False, part_msg))
             self._gossip(part_msg)
+        self.timeline.mark(height, timeline.PROPOSAL_SENT, round_=round_)
         self.logger.info("signed proposal", height=height, round=round_, proposal=str(proposal.block_id))
 
     async def _create_proposal_block(self) -> Block | None:
@@ -535,6 +557,8 @@ class ConsensusState(BaseService):
         rs.proposal = proposal
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet.from_header(proposal.block_id.part_set_header)
+        self.timeline.mark(rs.height, timeline.PROPOSAL_RECEIVED,
+                           round_=rs.round_, peer=peer_id)
         self.logger.info("received proposal", proposal=str(proposal.block_id), peer=peer_id)
 
     async def _add_proposal_block_part(self, msg: M.BlockPartMessage) -> bool:
@@ -547,9 +571,13 @@ class ConsensusState(BaseService):
         added = rs.proposal_block_parts.add_part(msg.part)
         if not added:
             return False
+        self.timeline.mark(msg.height, timeline.FIRST_BLOCK_PART,
+                           round_=msg.round_, peer=msg.peer_id)
         if rs.proposal_block_parts.is_complete():
             block = Block.from_proto(rs.proposal_block_parts.get_reader())
             rs.proposal_block = block
+            self.timeline.mark(msg.height, timeline.PROPOSAL_COMPLETE,
+                               round_=msg.round_)
             self.logger.info("received complete proposal block",
                              height=block.header.height, hash=block.hash().hex()[:12])
             await self._handle_complete_proposal(msg.height)
@@ -706,6 +734,7 @@ class ConsensusState(BaseService):
         rs.commit_round = commit_round
         rs.commit_time = cmttime.now()
         self._new_step(RoundStepType.COMMIT)
+        self.timeline.mark(height, timeline.COMMIT, round_=commit_round)
         if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
             rs.proposal_block = rs.locked_block
             rs.proposal_block_parts = rs.locked_block_parts
@@ -766,6 +795,8 @@ class ConsensusState(BaseService):
                 rounds=rs.commit_round, txs=len(block.data.txs))
             self._height_span.finish()
             self._height_span = None
+        self.timeline.mark(height, timeline.APPLY_DONE, round_=rs.commit_round)
+        self.timeline.height_done(height)
         self.logger.info(
             "finalized block", height=height, hash=block.hash().hex()[:12],
             txs=len(block.data.txs), app_hash=new_state.app_hash.hex()[:12],
@@ -1029,6 +1060,17 @@ class ConsensusState(BaseService):
         rs = self.rs
         vote_round = round_
         prevotes = rs.votes.prevotes(vote_round)
+        if timeline.enabled() and prevotes is not None:
+            # threshold crossings (first-wins marks): rs.height read before
+            # any enter_* below can advance it
+            self.timeline.mark(rs.height, timeline.PREVOTE_FIRST,
+                               round_=vote_round)
+            if prevotes.has_one_third_any():
+                self.timeline.mark(rs.height, timeline.PREVOTE_THIRD,
+                                   round_=vote_round)
+            if prevotes.has_two_thirds_any():
+                self.timeline.mark(rs.height, timeline.PREVOTE_QUORUM,
+                                   round_=vote_round)
         block_id, has_maj = prevotes.two_thirds_majority()
         if has_maj:
             # unlock on POL for a different block (state.go:2290-2305)
@@ -1066,6 +1108,12 @@ class ConsensusState(BaseService):
         rs = self.rs
         vote_round = round_
         precommits = rs.votes.precommits(vote_round)
+        if timeline.enabled() and precommits is not None:
+            self.timeline.mark(rs.height, timeline.PRECOMMIT_FIRST,
+                               round_=vote_round)
+            if precommits.has_two_thirds_any():
+                self.timeline.mark(rs.height, timeline.PRECOMMIT_QUORUM,
+                                   round_=vote_round)
         block_id, has_maj = precommits.two_thirds_majority()
         if has_maj:
             await self._enter_new_round(rs.height, vote_round)

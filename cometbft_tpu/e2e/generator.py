@@ -136,6 +136,7 @@ def generate_fleet_manifest(
     target_height_delta: int = 4,
     name: str = "",
     vote_summaries: bool = True,
+    height_slow_ms: float = 0.0,
 ) -> Manifest:
     """One fleet testnet: `n_nodes` sqlite+builtin validators wired by
     `topology`, regions assigned round-robin, with the given net-level
@@ -160,6 +161,7 @@ def generate_fleet_manifest(
         net_perturb=list(net_perturb),
         target_height_delta=target_height_delta,
         vote_summaries=vote_summaries,
+        height_slow_ms=height_slow_ms,
     )
     for i in range(n_nodes):
         m.nodes[f"node{i:03d}"] = NodeManifest(

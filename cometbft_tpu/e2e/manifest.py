@@ -157,6 +157,10 @@ class Manifest:
     # for every node: False = the full-gossip baseline, the control arm
     # of the amplification measurement
     vote_summaries: bool = True
+    # instrumentation.height_slow_ms for every node: a height whose wall
+    # time exceeds this captures a postmortem bundle (consensus/
+    # timeline.py) served by the `postmortems` route; <= 0 disables
+    height_slow_ms: float = 0.0
     nodes: dict[str, NodeManifest] = field(default_factory=dict)
 
     TOPOLOGIES = ("full", "hub", "regional")
@@ -232,6 +236,7 @@ class Manifest:
             "net_perturb = ["
             + ", ".join(q(p) for p in self.net_perturb) + "]",
             f"vote_summaries = {'true' if self.vote_summaries else 'false'}",
+            f"height_slow_ms = {float(self.height_slow_ms)}",
         ]
         if self.initial_state:
             out.append("")
@@ -270,6 +275,7 @@ class Manifest:
             link_profile=str(doc.get("link_profile", "")),
             net_perturb=list(doc.get("net_perturb", [])),
             vote_summaries=bool(doc.get("vote_summaries", True)),
+            height_slow_ms=float(doc.get("height_slow_ms", 0.0)),
         )
         for name, nd in doc.get("node", {}).items():
             m.nodes[name] = NodeManifest(

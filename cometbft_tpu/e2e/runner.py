@@ -238,6 +238,11 @@ def setup(manifest: Manifest, out_dir: str, base_port: int) -> _Net:
             cfg.p2p.chaos = chaos_spec
         cfg.crypto.backend = "cpu"  # N processes cannot share one chip
         cfg.consensus.timeout_commit = 0.1
+        # heightline on every node: the run report's consensus anatomy
+        # section needs the per-height rings, and the recorder's armed
+        # cost is a few dict writes per height
+        cfg.instrumentation.timeline = True
+        cfg.instrumentation.height_slow_ms = manifest.height_slow_ms
         # reconciliation arm: the manifest picks the protocol (the
         # full-gossip control arm measures amplification WITHOUT it); a
         # fleet repairs vote views on a tighter cadence than the 0.5 s
@@ -523,12 +528,58 @@ def _fleet_rollup(report: dict, net: _Net, names: list[str]) -> dict:
     }
 
 
+def _heightline_section(net: _Net, names: list[str]) -> dict:
+    """The run report's consensus-anatomy section: each live node's
+    `consensus_timeline` ring plus the skew-aligned fleet aggregate
+    (consensus/timeline.aggregate) and postmortem summaries.  Per-node
+    pull failures are recorded, never raised — like the wire section,
+    this is an artifact."""
+    from cometbft_tpu.consensus import timeline
+
+    docs, per_node = [], {}
+    for i, name in enumerate(names):
+        try:
+            doc = _rpc(net, i, "consensus_timeline",
+                       timeout=5.0).get("result", {})
+        except Exception as e:  # noqa: BLE001
+            per_node[name] = {"error": str(e)}
+            continue
+        doc["name"] = name
+        docs.append(doc)
+        entry = {"node_id": doc.get("node_id", ""),
+                 "heights": len(doc.get("heights", [])),
+                 "enabled": doc.get("enabled", False)}
+        try:
+            pm = _rpc(net, i, "postmortems", timeout=5.0).get("result", {})
+            entry["postmortems"] = pm.get("captures", [])
+        except Exception as e:  # noqa: BLE001
+            entry["postmortems_error"] = str(e)
+        per_node[name] = entry
+    section = {"nodes": per_node}
+    try:
+        agg = timeline.aggregate(docs)
+        # regional manifests read straggler REGIONS, not just node ids
+        regions = net.manifest.region_names()
+        id_to_name = {d.get("node_id", ""): d["name"] for d in docs}
+        top = agg["summary"].get("top_straggler")
+        if top is not None and id_to_name.get(top) in regions:
+            agg["summary"]["top_straggler_name"] = id_to_name[top]
+            agg["summary"]["top_straggler_region"] = regions[id_to_name[top]]
+        section["aggregate"] = agg
+    except Exception as e:  # noqa: BLE001
+        section["aggregate"] = {"error": str(e)}
+    return section
+
+
 def _write_net_report(net: _Net, names: list[str], log=print) -> str | None:
     """Snapshot net_telemetry from every live node into
     <out_dir>/net_report.json (the run report's wire-plane section),
-    plus the `fleet` rollup aggregating them into one record.
-    Telemetry failures are recorded per node, never raised — the report
-    is an artifact, not an assertion."""
+    plus the `fleet` rollup aggregating them into one record and the
+    `heightline` consensus-anatomy section. Telemetry failures are
+    recorded per node, never raised — the report is an artifact, not an
+    assertion, and it must land on FAILED runs too (a perturbation
+    assert mid-run reaches here via run_manifest's finally), so every
+    section degrades independently instead of losing the whole file."""
     report = {"manifest": net.manifest.name, "nodes": {}}
     for i, name in enumerate(names):
         try:
@@ -540,10 +591,16 @@ def _write_net_report(net: _Net, names: list[str], log=print) -> str | None:
         report["fleet"] = _fleet_rollup(report, net, names)
     except Exception as e:  # noqa: BLE001 - the rollup must never cost
         report["fleet"] = {"error": str(e)}  # the per-node forensics
+    try:
+        report["heightline"] = _heightline_section(net, names)
+    except Exception as e:  # noqa: BLE001 - ditto
+        report["heightline"] = {"error": str(e)}
     path = os.path.join(net.dir, "net_report.json")
     try:
         with open(path, "w") as f:
-            json.dump(report, f, indent=1)
+            # default=str: one unserializable telemetry value must not
+            # cost the failed-run forensics record
+            json.dump(report, f, indent=1, default=str)
     except OSError as e:
         log(f"[{net.manifest.name}] net report not written: {e}")
         return None
@@ -1102,8 +1159,12 @@ def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
         # wire-plane report: snapshot every node's net_telemetry into the
         # run dir BEFORE teardown — on FAILED runs especially, this is the
         # forensics record of where the wire bytes went (nodes that died
-        # are recorded as per-node errors, never raised)
-        _write_net_report(net, names, log=log)
+        # are recorded as per-node errors, never raised). A report bug
+        # must neither mask the run's real error nor skip the kills below.
+        try:
+            _write_net_report(net, names, log=log)
+        except Exception as e:  # noqa: BLE001
+            log(f"[{manifest.name}] net report failed: {e}")
         for p in net.node_procs:
             if p is not None:
                 _kill(p)

@@ -141,6 +141,129 @@ class LinkModel:
             self._seconds_total = 0.0
 
 
+class SkewEstimator:
+    """Per-peer wall-clock offset model (peer_clock - local_clock, ms).
+
+    Two sample sources, kept as separate EWMAs so they cross-check each
+    other:
+
+      ping      the pong packet carries the responder's wall clock
+                (p2p/conn/connection.py); with the send stamped at wall
+                t0 and a measured RTT, ``offset = remote_wall -
+                (t0 + rtt/2)``. Exact up to path asymmetry, so the
+                per-sample error is bounded by rtt/2 plus jitter.
+      vote      a received vote's signing timestamp against the local
+                arrival clock, credited rtt/2 of flight time. Network
+                delay is at least rtt/2, so vote samples are a LOWER
+                bound on the true offset — they serve as the
+                cross-check, not the estimate.
+
+    ``offset_ms()`` prefers the ping EWMA and falls back to votes.  The
+    documented error bound (asserted by tests/test_skew.py) is::
+
+        |estimate - true| <= max(2 ms, rtt/2 * 1e3 + 3 * dev_ms)
+
+    after ~50 samples, where dev_ms is the EWMA of absolute residuals —
+    i.e. the estimator converges to within half the round trip plus
+    three deviations of the observed jitter.  Thread-safe: samples
+    arrive from per-connection recv tasks, reads from the RPC thread.
+    """
+
+    def __init__(self, alpha: float = 0.1):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError("alpha must be in (0, 1]")
+        self.alpha = alpha
+        self._lock = threading.Lock()
+        self._peers: dict[str, dict] = {}
+
+    def _peer(self, peer: str) -> dict:
+        p = self._peers.get(peer)
+        if p is None:
+            p = {"ping_off": None, "ping_dev": 0.0, "ping_n": 0,
+                 "vote_off": None, "vote_n": 0, "rtt_s": 0.0}
+            self._peers[peer] = p
+        return p
+
+    def observe_ping(self, peer: str, remote_wall_ns: int,
+                     midpoint_wall_ns: int, rtt_s: float) -> None:
+        """A pong that carried the responder's wall clock; midpoint is
+        the sender's wall clock at t0 + rtt/2."""
+        sample = (remote_wall_ns - midpoint_wall_ns) / 1e6
+        with self._lock:
+            p = self._peer(peer)
+            p["ping_n"] += 1
+            if rtt_s > 0:
+                p["rtt_s"] = (rtt_s if p["rtt_s"] == 0.0
+                              else p["rtt_s"] + self.alpha * (rtt_s - p["rtt_s"]))
+            if p["ping_off"] is None:
+                p["ping_off"] = sample
+                return
+            resid = abs(sample - p["ping_off"])
+            p["ping_dev"] += self.alpha * (resid - p["ping_dev"])
+            p["ping_off"] += self.alpha * (sample - p["ping_off"])
+
+    def observe_vote(self, peer: str, vote_wall_ns: int,
+                     arrival_wall_ns: int, rtt_s: float = 0.0) -> None:
+        """Vote-timestamp delta cross-check (lower bound on the offset:
+        gossip delay exceeds rtt/2, pulling the sample down)."""
+        sample = (vote_wall_ns - arrival_wall_ns) / 1e6 + rtt_s * 500.0
+        with self._lock:
+            p = self._peer(peer)
+            p["vote_n"] += 1
+            if p["vote_off"] is None:
+                p["vote_off"] = sample
+            else:
+                p["vote_off"] += self.alpha * (sample - p["vote_off"])
+
+    def offset_ms(self, peer: str) -> float | None:
+        """Best offset estimate for peer (peer clock minus local), ms."""
+        with self._lock:
+            p = self._peers.get(peer)
+            if p is None:
+                return None
+            if p["ping_off"] is not None:
+                return p["ping_off"]
+            return p["vote_off"]
+
+    def error_bound_ms(self, peer: str) -> float | None:
+        with self._lock:
+            p = self._peers.get(peer)
+            if p is None or p["ping_off"] is None:
+                return None
+            return max(2.0, p["rtt_s"] * 500.0 + 3.0 * p["ping_dev"])
+
+    def snapshot(self) -> dict:
+        """Per-peer skew table for consensus_timeline / net_telemetry."""
+        out = {}
+        with self._lock:
+            for peer, p in self._peers.items():
+                off = p["ping_off"] if p["ping_off"] is not None else p["vote_off"]
+                ent = {
+                    "offset_ms": None if off is None else round(off, 3),
+                    "source": ("ping" if p["ping_off"] is not None
+                               else "vote" if p["vote_off"] is not None
+                               else "none"),
+                    "ping_samples": p["ping_n"],
+                    "vote_samples": p["vote_n"],
+                    "rtt_ms": round(p["rtt_s"] * 1e3, 3),
+                }
+                if p["ping_off"] is not None:
+                    ent["error_bound_ms"] = round(
+                        max(2.0, p["rtt_s"] * 500.0 + 3.0 * p["ping_dev"]), 3)
+                    ent["dev_ms"] = round(p["ping_dev"], 3)
+                if p["ping_off"] is not None and p["vote_off"] is not None:
+                    # votes lower-bound the offset; a vote EWMA far ABOVE
+                    # the ping estimate means one of the clocks lies
+                    ent["cross_check_ms"] = round(
+                        p["vote_off"] - p["ping_off"], 3)
+                out[peer] = ent
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._peers.clear()
+
+
 # ---------------------------------------------------------------------------
 # process-global links. The device tunnel is a process-global resource
 # (like the device supervisors); the p2p aggregate pools every peer's ping
@@ -150,6 +273,7 @@ class LinkModel:
 _lock = threading.Lock()
 _tunnel: LinkModel | None = None
 _p2p: LinkModel | None = None
+_skew: SkewEstimator | None = None
 
 
 def tunnel() -> LinkModel:
@@ -178,9 +302,22 @@ def p2p() -> LinkModel:
     return _p2p
 
 
+def skew() -> SkewEstimator:
+    """The per-peer clock-skew table (fed by MConnection pong wall stamps
+    and the consensus reactor's vote-timestamp deltas; read by the
+    heightline aggregator to project node clocks onto one fleet axis)."""
+    global _skew
+    if _skew is None:
+        with _lock:
+            if _skew is None:
+                _skew = SkewEstimator(alpha=0.1)
+    return _skew
+
+
 def reset() -> None:
-    """Forget both process links (tests)."""
-    global _tunnel, _p2p
+    """Forget the process links and the skew table (tests)."""
+    global _tunnel, _p2p, _skew
     with _lock:
         _tunnel = None
         _p2p = None
+        _skew = None

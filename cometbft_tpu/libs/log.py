@@ -7,13 +7,17 @@ the reference's log.NewLazySprintf (consensus/state.go:1654).
 Trace correlation: when the flight recorder (libs/trace.py) is armed and a
 span is active on the emitting thread/task, every record is stamped with
 `trace_id`/`span_id` — a slow-batch capture and its log lines correlate by
-id. JSON output is opt-in process-wide via set_default_format("json") (node
-boot wires base.log_format through it) or CBFT_LOG_FORMAT=json, so library
-code calling default() follows the node's choice.
+id. Consensus-path records additionally carry `height`/`round` from the
+contextvar set by ConsensusState._new_step (set_height_round), so
+grep-by-height works across the whole node log. JSON output is opt-in
+process-wide via set_default_format("json") (node boot wires
+base.log_format through it) or CBFT_LOG_FORMAT=json, so library code
+calling default() follows the node's choice.
 """
 
 from __future__ import annotations
 
+import contextvars
 import io
 import json
 import os
@@ -23,6 +27,25 @@ import time
 from typing import Any, Callable, Optional, TextIO
 
 from cometbft_tpu.libs import trace as _trace
+
+# (height, round) of the consensus step the emitting task is in, or None
+# outside the consensus path. A contextvar, so the stamp follows the
+# consensus receive task without leaking into reactor/RPC tasks.
+_height_ctx: contextvars.ContextVar[Optional[tuple]] = contextvars.ContextVar(
+    "cbft_log_height", default=None)
+
+
+def set_height_round(height: int, round_: int) -> None:
+    """Stamp subsequent log records from this task with height/round."""
+    _height_ctx.set((height, round_))
+
+
+def clear_height_round() -> None:
+    _height_ctx.set(None)
+
+
+def current_height_round() -> Optional[tuple]:
+    return _height_ctx.get()
 
 DEBUG, INFO, WARN, ERROR, NONE = 0, 1, 2, 3, 4
 _LEVEL_NAMES = {DEBUG: "debug", INFO: "info", WARN: "warn", ERROR: "error"}
@@ -88,6 +111,9 @@ class Logger:
         ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime())
         ids = _trace.current_ids()  # None in two reads when tracing is off
         items = self._fields + tuple(kv.items())
+        hr = _height_ctx.get()  # None outside the consensus path
+        if hr is not None:
+            items += (("height", hr[0]), ("round", hr[1]))
         if ids is not None:
             items += (("trace_id", ids[0]), ("span_id", ids[1]))
         if self._fmt == "json":

@@ -20,6 +20,7 @@ from cometbft_tpu.abci.kvstore import KVStoreApplication
 from cometbft_tpu.blocksync import BlocksyncReactor
 from cometbft_tpu.config import Config
 from cometbft_tpu.consensus import ConsensusState
+from cometbft_tpu.consensus import timeline as cmttimeline
 from cometbft_tpu.consensus.reactor import ConsensusReactor
 from cometbft_tpu.consensus.wal import WAL
 from cometbft_tpu.crypto import batch as crypto_batch
@@ -134,6 +135,17 @@ class Node(BaseService):
                 enabled=True, capacity=inst.trace_buffer_spans,
                 slow_ms=inst.trace_slow_ms,
                 slow_captures=inst.trace_slow_captures)
+        # consensus heightline (consensus/timeline.py): ARM-only, the
+        # same overlay pattern — CBFT_TIMELINE wins over the config knob
+        env_tl = os.environ.get("CBFT_TIMELINE")
+        timeline_on = (env_tl.strip().lower() not in ("", "0", "false",
+                                                      "off", "no")
+                       if env_tl is not None else inst.timeline)
+        if timeline_on:
+            cmttimeline.configure(
+                enabled=True, heights=inst.timeline_heights,
+                slow_ms=inst.height_slow_ms,
+                postmortems=inst.postmortem_captures)
 
         # crypto backend selection + device-fault supervision knobs
         # (BASELINE: --crypto.backend flag; ops/dispatch.py supervisor)
@@ -254,6 +266,24 @@ class Node(BaseService):
         from cometbft_tpu.libs import metrics as cmtmetrics
 
         self.metrics_registry = cmtmetrics.Registry()
+        # cometbft_build_info: constant-1 gauge whose labels carry the
+        # build — fleet scrapes correlate behavior with version/backend
+        # (the node_exporter build_info convention)
+        from cometbft_tpu import version as _version
+
+        schemes = ["ed25519", "secp256k1", "sr25519"]
+        if getattr(config.crypto, "bls_enabled", False):
+            schemes.append("bls12381")
+        self.metrics_registry.gauge(
+            "build", "info", "Build/version information (value is always 1).",
+            labels=("version", "abci", "block_protocol", "p2p_protocol",
+                    "tpu_crypto_backend", "backend", "schemes"),
+        ).labels(
+            _version.CMTSemVer, _version.ABCIVersion,
+            str(_version.BlockProtocol), str(_version.P2PProtocol),
+            str(_version.TPUCryptoBackend), config.crypto.backend,
+            ",".join(schemes),
+        ).set(1)
         self.consensus_metrics = cmtmetrics.ConsensusMetrics(self.metrics_registry)
         self.mempool_metrics = cmtmetrics.MempoolMetrics(self.metrics_registry)
         self.p2p_metrics = cmtmetrics.P2PMetrics(
@@ -301,6 +331,14 @@ class Node(BaseService):
         self.statesync_active = (
             config.state_sync.enable and state.last_block_height == 0
         )
+        # heightline recorder identity + slow-height postmortem collector:
+        # the recorder exists either way (disabled marks are near-free);
+        # the collector only fires on a slow height
+        tlr = self.consensus_state.timeline
+        tlr.node = self.node_key.id()
+        tlr.slow_ms = config.instrumentation.height_slow_ms
+        tlr.collector = self._postmortem_context
+        self._postmortem_wire_prev: dict = {}
         self.consensus_reactor = ConsensusReactor(
             self.consensus_state,
             wait_sync=self.blocksync_active or self.statesync_active,
@@ -485,6 +523,67 @@ class Node(BaseService):
         self.pprof_server = None
         self.grpc_server = None
         self.grpc_priv_server = None
+
+    # ------------------------------------------------- slow-height bundles
+
+    def _postmortem_context(self, height: int) -> dict:
+        """Bounded node context captured into a slow-height postmortem
+        bundle (consensus/timeline.py Recorder): the matching slow span
+        capture from the flight recorder, the gossip-accounting snapshot,
+        wire-counter deltas since the previous capture, and scheduler /
+        verify-mesh health. Every section degrades to None independently
+        — a broken subsystem must not cost the bundle."""
+        ctx: dict = {}
+        try:
+            caps = cmttrace.slow_captures()
+            # prefer the capture of THIS height's span tree; else newest
+            pick = None
+            for c in reversed(caps):
+                if (c.get("root") == "consensus.height"
+                        and c.get("attrs", {}).get("height") == height):
+                    pick = c
+                    break
+            if pick is None and caps:
+                pick = caps[-1]
+            if pick is not None:
+                ctx["span_capture"] = {
+                    "root": pick.get("root"),
+                    "dur_ms": pick.get("dur_ms"),
+                    "attrs": pick.get("attrs"),
+                    "spans": pick.get("spans", [])[:200],
+                }
+        except Exception:  # noqa: BLE001
+            ctx["span_capture"] = None
+        try:
+            ctx["gossip"] = self.consensus_reactor.gossip_accounting()
+        except Exception:  # noqa: BLE001
+            ctx["gossip"] = None
+        try:
+            tele = self.switch.net_telemetry()
+            totals = dict(tele.get("totals") or {})
+            prev = self._postmortem_wire_prev
+            ctx["wire_totals"] = totals
+            ctx["wire_deltas"] = {
+                k: round(v - prev.get(k, 0), 3) if isinstance(v, float)
+                else v - prev.get(k, 0)
+                for k, v in totals.items() if isinstance(v, (int, float))}
+            self._postmortem_wire_prev = totals
+            ctx["channels"] = tele.get("channels")
+        except Exception:  # noqa: BLE001
+            ctx["wire_totals"] = ctx["wire_deltas"] = None
+        try:
+            from cometbft_tpu import sched
+
+            ctx["scheduler"] = sched.health_snapshot()
+        except Exception:  # noqa: BLE001
+            ctx["scheduler"] = None
+        try:
+            from cometbft_tpu.ops import dispatch
+
+            ctx["crypto_backend"] = dispatch.health_snapshot()
+        except Exception:  # noqa: BLE001
+            ctx["crypto_backend"] = None
+        return ctx
 
     # ------------------------------------------------------------ lifecycle
 

@@ -115,6 +115,7 @@ class MConnection:
         logger: cmtlog.Logger | None = None,
         metrics=None,  # libs.metrics.P2PMetrics | None
         peer_label: str = "",  # pre-capped metrics label for this peer
+        peer_id: str = "",  # node id, keys the clock-skew table
     ):
         self.config = config or MConnConfig()
         self._conn = conn
@@ -145,6 +146,10 @@ class MConnection:
         self._ping_rtt_s = 0.0
         self._ping_rtt_last_s = 0.0
         self._ping_samples = 0
+        # clock-skew sampling: the last pong's remote wall stamp, consumed
+        # by the ping routine against its own wall t0 + rtt/2
+        self.peer_id = peer_id
+        self._last_pong_wall_ns = 0
 
     # ----------------------------------------------------------- lifecycle
 
@@ -220,7 +225,9 @@ class MConnection:
                     continue
                 batch = bytearray()
                 if self._pong_pending:
-                    batch += _encode_packet_pong()
+                    # stamp our wall clock into the pong so the pinger can
+                    # estimate clock skew from the RTT midpoint
+                    batch += _encode_packet_pong(time.time_ns())
                     self._pong_pending = False
                 # coalesce a few packets per flush (the reference's
                 # 100ms flush throttle analog — we flush per loop, batching
@@ -286,11 +293,14 @@ class MConnection:
                 delay = self._recv_monitor.update(wire_len)
                 if delay > 0:
                     await asyncio.sleep(delay)
-                kind, chan_id, eof, data = _decode_packet(packet)
+                kind, chan_id, eof, data, pong_wall = _decode_packet(packet)
                 if kind == 1:  # ping
                     self._pong_pending = True
                     self._send_wake.set()
                 elif kind == 2:  # pong
+                    # an extended pong carries the responder's wall clock
+                    # for the skew estimator (0 from old senders)
+                    self._last_pong_wall_ns = pong_wall
                     self._pong_received.set()
                 elif kind == 3:
                     ch = self._channels.get(chan_id)
@@ -350,9 +360,11 @@ class MConnection:
             await asyncio.sleep(self.config.ping_interval)
             try:
                 self._pong_received.clear()
+                self._last_pong_wall_ns = 0
                 ping = _encode_packet_ping()
                 self._send_monitor.update(len(ping))  # keepalives count too
                 t0 = time.monotonic()
+                t0_wall = time.time_ns()
                 await self._conn.write(ping)
                 try:
                     await asyncio.wait_for(
@@ -360,7 +372,14 @@ class MConnection:
                     )
                 except asyncio.TimeoutError:
                     raise ConnectionError("pong timeout") from None
-                self._note_ping_rtt(time.monotonic() - t0)
+                rtt = time.monotonic() - t0
+                self._note_ping_rtt(rtt)
+                if self._last_pong_wall_ns and self.peer_id:
+                    # RTT-midpoint skew sample: the responder stamped its
+                    # wall clock; ours at the midpoint is t0 + rtt/2
+                    linkmodel.skew().observe_ping(
+                        self.peer_id, self._last_pong_wall_ns,
+                        t0_wall + int(rtt * 5e8), rtt)
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001
@@ -440,8 +459,13 @@ def _encode_packet_ping() -> bytes:
     return encode_uvarint(len(body)) + body
 
 
-def _encode_packet_pong() -> bytes:
-    body = Writer().message(2, b"", always=True).output()
+def _encode_packet_pong(wall_ns: int = 0) -> bytes:
+    """Pong, optionally carrying the responder's wall clock (uvarint
+    field 1 of the pong submessage). Forward-compatible: old decoders
+    skip the submessage content of fields 1/2, so an extended pong reads
+    as a plain pong to them."""
+    inner = Writer().uvarint(1, wall_ns).output() if wall_ns else b""
+    body = Writer().message(2, inner, always=True).output()
     return encode_uvarint(len(body)) + body
 
 
@@ -451,17 +475,29 @@ def _encode_packet_msg(chan_id: int, eof: bool, data: bytes) -> bytes:
     return encode_uvarint(len(body)) + body
 
 
-def _decode_packet(body: bytes) -> tuple[int, int, bool, bytes]:
-    """Return (kind, chan_id, eof, data); kind 1=ping 2=pong 3=msg."""
+def _decode_packet(body: bytes) -> tuple[int, int, bool, bytes, int]:
+    """Return (kind, chan_id, eof, data, pong_wall_ns); kind 1=ping
+    2=pong 3=msg. pong_wall_ns is the responder clock an extended pong
+    carried (0 for a plain pong or any other packet kind)."""
     r = Reader(body)
     kind = chan_id = 0
     eof = False
     data = b""
+    pong_wall_ns = 0
     while not r.at_end():
         f, w = r.read_tag()
-        if f in (1, 2):
+        if f == 1:
             r.skip(w)
             kind = f
+        elif f == 2:
+            kind = f
+            mr = r.read_message()
+            while not mr.at_end():
+                mf, mw = mr.read_tag()
+                if mf == 1:
+                    pong_wall_ns = mr.read_uvarint()
+                else:
+                    mr.skip(mw)
         elif f == 3:
             kind = 3
             mr = r.read_message()
@@ -479,4 +515,4 @@ def _decode_packet(body: bytes) -> tuple[int, int, bool, bytes]:
             r.skip(w)
     if kind == 0:
         raise ValueError("empty packet")
-    return kind, chan_id, eof, data
+    return kind, chan_id, eof, data, pong_wall_ns

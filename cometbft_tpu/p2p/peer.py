@@ -50,6 +50,7 @@ class Peer(BaseService):
             conn, channels, _mconn_receive, _mconn_error,
             config=mconn_config, logger=self.logger,
             metrics=metrics, peer_label=peer_label,
+            peer_id=node_info.node_id,
         )
 
     # ------------------------------------------------------------- identity

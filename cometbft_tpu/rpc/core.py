@@ -167,6 +167,27 @@ class Environment:
                 if pub_key else None,
                 "voting_power": "0",
             },
+            "versions": self._versions_block(),
+        }
+
+    def _versions_block(self) -> dict:
+        """Build/version identity — mirrored as the cometbft_build_info
+        gauge on /metrics so dashboards and RPC agree on what is running."""
+        from cometbft_tpu import version as _version
+
+        cfg = getattr(self.node, "config", None)
+        crypto_cfg = getattr(cfg, "crypto", None)
+        schemes = ["ed25519", "secp256k1", "sr25519"]
+        if crypto_cfg is None or getattr(crypto_cfg, "bls_enabled", True):
+            schemes.append("bls12381")
+        return {
+            "version": _version.CMTSemVer,
+            "abci": _version.ABCIVersion,
+            "block_protocol": str(_version.BlockProtocol),
+            "p2p_protocol": str(_version.P2PProtocol),
+            "tpu_crypto_backend": str(_version.TPUCryptoBackend),
+            "backend": getattr(crypto_cfg, "backend", "cpu"),
+            "schemes": schemes,
         }
 
     async def net_info(self, _params: dict) -> dict:
@@ -1042,6 +1063,63 @@ class Environment:
                 None, trace.slow_captures)
         return out
 
+    async def consensus_timeline(self, params: dict) -> dict:
+        """Per-height consensus phase timeline (no reference analog):
+        the node's bounded heightline ring — one record per recent height
+        with mono+wall timestamps for every critical-path event (proposal
+        sent/received, first block part, proposal complete, prevote
+        first/⅓/⅔, precommit quorum, commit, ABCI apply done) plus
+        per-peer vote-arrival lag — and the per-peer clock-skew estimates
+        needed to align timelines across nodes. `cometbft heightline`
+        pulls this from a fleet and renders skew-corrected per-height
+        anatomy. `min_height`/`limit` bound the response."""
+        from cometbft_tpu.consensus import timeline
+        from cometbft_tpu.libs import linkmodel
+
+        min_height = int(params.get("min_height", 0) or 0)
+        limit = int(params.get("limit", 0) or 0)
+        cs = getattr(self.node, "consensus_state", None)
+        rec = getattr(cs, "timeline", None)
+        node_key = getattr(self.node, "node_key", None)
+        node_info = getattr(self.node, "node_info", None)
+        cfg = getattr(self.node, "config", None)
+        inst = getattr(cfg, "instrumentation", None)
+        import time as _time
+        return {
+            "node_id": node_key.id() if node_key is not None else "",
+            "moniker": node_info.moniker if node_info is not None else "",
+            "now_wall_ns": _time.time_ns(),
+            "enabled": timeline.enabled(),
+            "height_slow_ms": (getattr(inst, "height_slow_ms", 0.0)
+                               if inst is not None else 0.0),
+            "heights": (rec.snapshot(min_height=min_height, limit=limit)
+                        if rec is not None else []),
+            "skew": linkmodel.skew().snapshot(),
+        }
+
+    async def postmortems(self, params: dict) -> dict:
+        """Slow-height postmortem bundles (no reference analog): heights
+        whose wall time exceeded instrumentation.height_slow_ms each
+        auto-captured one bounded bundle (timeline, span tree, gossip
+        accounting, wire-counter deltas, scheduler/crypto health). No
+        `height` param lists capture summaries; `height=N` returns the
+        full bundle for that height or errors if none was captured."""
+        cs = getattr(self.node, "consensus_state", None)
+        rec = getattr(cs, "timeline", None)
+        node_key = getattr(self.node, "node_key", None)
+        out: dict = {
+            "node_id": node_key.id() if node_key is not None else "",
+            "captures": rec.postmortems() if rec is not None else [],
+        }
+        h = params.get("height")
+        if h is not None:
+            bundle = rec.postmortem(int(h)) if rec is not None else None
+            if bundle is None:
+                raise RPCError(
+                    -32603, f"no postmortem captured for height {h}")
+            out["postmortem"] = bundle
+        return out
+
     # ------------------------------------------------------ unsafe routes
 
     @staticmethod
@@ -1150,6 +1228,8 @@ class Environment:
             "crypto_health": self.crypto_health,
             "storage_health": self.storage_health,
             "trace_dump": self.trace_dump,
+            "consensus_timeline": self.consensus_timeline,
+            "postmortems": self.postmortems,
             "status": self.status,
             "net_info": self.net_info,
             "net_telemetry": self.net_telemetry,

@@ -240,6 +240,66 @@ class TestDirectionAwareCompare:
         assert bc.compare(rec, rec)["verdict"] == "pass"
         assert bc.compare(worse, rec)["verdict"] == "pass"
 
+    def test_height_phase_total_is_enforced_lower_better(self):
+        """Heightline sentinel wiring (ISSUE 16): the fleet-aggregated
+        per-height phase total regressing UP past 75% fails — both the
+        bare detail key and the consensus.-prefixed section key; the
+        same delta as an improvement passes; the per-phase split and the
+        propagation p99 are informational with a stated why."""
+        old = _record(height_phase_total_ms=40.0,
+                      height_phase_ms={"propose": 5.0, "prevote": 15.0,
+                                       "precommit": 10.0, "commit": 4.0,
+                                       "apply": 6.0},
+                      proposal_propagation_p99_ms=3.0,
+                      consensus={"height_phase_total_ms": 40.0})
+        worse = _record(height_phase_total_ms=90.0,
+                        height_phase_ms={"propose": 50.0, "prevote": 15.0,
+                                         "precommit": 10.0, "commit": 4.0,
+                                         "apply": 11.0},
+                        proposal_propagation_p99_ms=40.0,
+                        consensus={"height_phase_total_ms": 90.0})
+        v = bc.compare(old, worse)
+        assert v["verdict"] == "fail"
+        assert "height_phase_total_ms" in v["regressions"]
+        assert "consensus.height_phase_total_ms" in v["regressions"]
+        assert bc.compare(worse, old)["verdict"] == "pass"
+        # the split is attribution for the enforced total, not its own
+        # regression surface; the p99 stays a trend line
+        for name, why in (("height_phase_ms.propose", "phase split"),
+                          ("proposal_propagation_p99_ms", "trend")):
+            row = v["metrics"][name]
+            assert row["verdict"] == "info"
+            assert why in row["why_info"]
+
+    def test_height_phase_missing_baseline_guard(self):
+        """A baseline recorded before the heightline existed must not
+        fail the current run: absent-in-baseline reports `new`,
+        absent-in-current reports `missing` — both informational."""
+        old = _record()  # no heightline metrics at all
+        new = _record(height_phase_total_ms=40.0,
+                      proposal_propagation_p99_ms=3.0)
+        v = bc.compare(old, new)
+        assert v["verdict"] == "pass"
+        assert v["metrics"]["height_phase_total_ms"]["verdict"] == "new"
+        back = bc.compare(new, old)
+        assert back["verdict"] == "pass"
+        assert back["metrics"]["height_phase_total_ms"]["verdict"] == "missing"
+
+    def test_heightline_sentinel_self_test_case(self):
+        """--self-test contract on a heightline-shaped record: an
+        injected phase-total regression is flagged; the identical
+        snapshot and the improvement direction are not."""
+        rec = _record(height_phase_total_ms=40.0)
+        worse, metric, pct = bc.inject_regression(
+            rec, metric="height_phase_total_ms")
+        assert metric == "height_phase_total_ms" and pct > 75.0
+        assert worse["detail"]["height_phase_total_ms"] > 40.0  # LOWER dir
+        caught = bc.compare(rec, worse)
+        assert caught["verdict"] == "fail"
+        assert metric in caught["regressions"]
+        assert bc.compare(rec, rec)["verdict"] == "pass"
+        assert bc.compare(worse, rec)["verdict"] == "pass"
+
     def test_fleet_curve_leaves_are_informational(self):
         """Nested fleet curve values (fleet.curve.<n>.*) flatten into
         dotted names that are NOT tracked — they must report as info,

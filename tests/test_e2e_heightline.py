@@ -1,0 +1,246 @@
+"""Heightline wire-through on the e2e fleet plane (ISSUE 16).
+
+Fast tests: net_report.json (wire forensics + the new `heightline`
+section) must land on FAILED runs — run_manifest's finally writes it
+even when the boot/perturbation assert already raised, dead nodes
+degrade to per-node errors, an unserializable telemetry value cannot
+cost the file, and a bug in the report writer itself must neither mask
+the run's real error nor skip the process kills.
+
+Slow test: the ISSUE 16 acceptance — a regional fleet on slow cross-
+region links produces a skew-aligned per-height anatomy naming the
+straggler region, and the injected slow-height budget yields bounded,
+once-per-height postmortems pulled over the `postmortems` RPC route.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from cometbft_tpu.consensus import timeline
+from cometbft_tpu.e2e import runner as R
+from cometbft_tpu.e2e.generator import generate_fleet_manifest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_timeline():
+    timeline.reset()
+    yield
+    timeline.reset()
+
+
+def _fake_net(tmp_path, n=3, **gen_kw):
+    m = generate_fleet_manifest(n, name="hl-report", **gen_kw)
+    d = str(tmp_path / "net")
+    os.makedirs(d, exist_ok=True)
+    return R._Net(manifest=m, dir=d, base_port=29000)
+
+
+def _timeline_doc(node_id, heights=2):
+    """A canned consensus_timeline RPC result built with the real
+    Recorder, so the report sees the same shapes a live node serves."""
+    t = {"v": 0}
+
+    def mono():
+        t["v"] += 1_000_000
+        return t["v"]
+
+    timeline.configure(enabled=True, clock_mono=mono, clock_wall=mono)
+    rec = timeline.Recorder(node=node_id)
+    for h in range(1, heights + 1):
+        for mark in (timeline.NEW_HEIGHT, timeline.PROPOSAL_SENT,
+                     timeline.PROPOSAL_COMPLETE, timeline.PREVOTE_QUORUM,
+                     timeline.PRECOMMIT_QUORUM, timeline.COMMIT,
+                     timeline.APPLY_DONE):
+            rec.mark(h, mark)
+        rec.height_done(h)
+    return {"node_id": node_id, "moniker": node_id, "enabled": True,
+            "heights": rec.snapshot(), "skew": {}}
+
+
+class TestReportOnFailure:
+    def test_all_nodes_dead_still_writes_full_report(self, tmp_path,
+                                                     monkeypatch):
+        """Every RPC pull fails (the post-perturbation reality of a run
+        that died): the report still lands with per-node errors in BOTH
+        the wire and heightline sections and a degraded aggregate."""
+        net = _fake_net(tmp_path)
+
+        def rpc_dead(net_, i, route, timeout=2.0):
+            raise OSError("connection refused")
+
+        monkeypatch.setattr(R, "_rpc", rpc_dead)
+        path = R._write_net_report(net, sorted(net.manifest.nodes),
+                                   log=lambda *_: None)
+        assert path is not None
+        with open(path) as f:
+            report = json.load(f)
+        names = sorted(net.manifest.nodes)
+        assert all("error" in report["nodes"][nm] for nm in names)
+        hl = report["heightline"]
+        assert all("error" in hl["nodes"][nm] for nm in names)
+        assert hl["aggregate"]["heights"] == []
+        assert report["fleet"]["nodes_reporting"] == 0
+
+    def test_unserializable_telemetry_cannot_cost_the_file(self, tmp_path,
+                                                           monkeypatch):
+        """The satellite-(c) audit: report fields added AFTER the finally
+        was written must survive a failing run. One node returns a value
+        json can't encode (the original loss mode) — default=str keeps
+        the file, including the heightline aggregate."""
+        net = _fake_net(tmp_path)
+        names = sorted(net.manifest.nodes)
+        docs = {nm: _timeline_doc(f"id-{nm}") for nm in names}
+
+        def rpc(net_, i, route, timeout=2.0):
+            nm = names[i]
+            if route.startswith("consensus_timeline"):
+                return {"result": docs[nm]}
+            if route.startswith("postmortems"):
+                return {"result": {"node_id": f"id-{nm}", "captures": []}}
+            if route.startswith("status"):
+                return {"result": {"sync_info": {"latest_block_height": 3}}}
+            # net_telemetry with a non-JSON value (bytes)
+            return {"result": {"totals": {"send_bytes": 10,
+                                          "recv_bytes": 20},
+                               "oops": b"\x00raw"}}
+
+        monkeypatch.setattr(R, "_rpc", rpc)
+        path = R._write_net_report(net, names, log=lambda *_: None)
+        assert path is not None
+        with open(path) as f:
+            report = json.load(f)
+        agg = report["heightline"]["aggregate"]
+        assert agg["summary"]["heights"] == 2
+        assert agg["summary"]["top_straggler"] is not None
+        # the straggler is mapped back to its manifest region
+        assert "top_straggler_region" in agg["summary"]
+        per = report["heightline"]["nodes"][names[0]]
+        assert per["enabled"] is True and per["heights"] == 2
+        assert per["postmortems"] == []
+
+    def test_run_manifest_failure_still_lands_the_report(self, tmp_path,
+                                                         monkeypatch):
+        """A perturbation/boot assert raising mid-run reaches the finally:
+        RunError propagates AND net_report.json (with the heightline
+        section) is on disk."""
+        net = _fake_net(tmp_path, n=2)
+        monkeypatch.setattr(R, "_resource_guard", lambda *a, **k: None)
+        monkeypatch.setattr(R, "setup", lambda m, out, bp: net)
+        monkeypatch.setattr(R, "_boot_staggered", lambda *a, **k: None)
+        monkeypatch.setattr(R, "_spawn_app", lambda addr: None)
+        monkeypatch.setattr(time, "sleep", lambda s: None)
+
+        def wait_fails(cond, timeout, what):
+            raise R.RunError(f"timed out waiting for {what}")
+
+        monkeypatch.setattr(R, "_wait", wait_fails)
+        monkeypatch.setattr(
+            R, "_rpc",
+            lambda *a, **k: (_ for _ in ()).throw(OSError("down")))
+        with pytest.raises(R.RunError, match="timed out"):
+            R.run_manifest(net.manifest, net.dir, base_port=29000)
+        with open(os.path.join(net.dir, "net_report.json")) as f:
+            report = json.load(f)
+        assert "heightline" in report and "fleet" in report
+
+    def test_report_writer_bug_masks_nothing(self, tmp_path, monkeypatch):
+        """If the report writer itself dies, the run's REAL error still
+        propagates and the teardown kills still run."""
+        net = _fake_net(tmp_path, n=2)
+        killed = []
+        monkeypatch.setattr(R, "_resource_guard", lambda *a, **k: None)
+        monkeypatch.setattr(R, "setup", lambda m, out, bp: net)
+        monkeypatch.setattr(R, "_boot_staggered", lambda *a, **k: None)
+        monkeypatch.setattr(time, "sleep", lambda s: None)
+        monkeypatch.setattr(R, "_kill", lambda p: killed.append(p))
+        net.node_procs = [object(), object()]
+
+        def wait_fails(cond, timeout, what):
+            raise R.RunError("the real failure")
+
+        monkeypatch.setattr(R, "_wait", wait_fails)
+        monkeypatch.setattr(
+            R, "_write_net_report",
+            lambda *a, **k: (_ for _ in ()).throw(TypeError("report bug")))
+        with pytest.raises(R.RunError, match="the real failure"):
+            R.run_manifest(net.manifest, net.dir, base_port=29000)
+        assert len(killed) == 2  # teardown ran despite the report bug
+
+
+class TestManifestPlumbing:
+    def test_height_slow_ms_round_trips_and_reaches_config(self, tmp_path):
+        m = generate_fleet_manifest(2, height_slow_ms=750.0,
+                                    name="hl-toml")
+        from cometbft_tpu.e2e.manifest import Manifest
+
+        m2 = Manifest.from_toml(m.to_toml())
+        assert m2.height_slow_ms == 750.0
+        net = R.setup(m2, str(tmp_path / "net"), base_port=29000)
+        from cometbft_tpu.config import Config
+
+        cfg = Config.load(net.homes[0])
+        assert cfg.instrumentation.timeline is True
+        assert cfg.instrumentation.height_slow_ms == 750.0
+
+
+# ------------------------------------------------------ slow acceptance
+
+
+@pytest.mark.slow
+def test_regional_fleet_heightline_names_straggler_region(tmp_path):
+    """ISSUE 16 acceptance: a regional fleet on slow cross-region links
+    (wan profile) run to completion produces a skew-aligned heightline
+    aggregate that names the straggler region, and the injected slow-
+    height budget (every height exceeds 1 ms) yields bounded postmortems
+    over the `postmortems` RPC route — at most one bundle per height,
+    at most postmortem_captures retained."""
+    n = 6
+    m = generate_fleet_manifest(
+        n, topology="regional", regions=2, link_profile="wan",
+        target_height_delta=4, height_slow_ms=1.0,
+        name="hl-regional")
+    out = str(tmp_path / "hl")
+    R.run_manifest(m, out, base_port=16000)
+    with open(os.path.join(out, "net_report.json")) as f:
+        report = json.load(f)
+
+    hl = report["heightline"]
+    names = sorted(m.nodes)
+    live = [nm for nm in names if "error" not in hl["nodes"][nm]]
+    assert len(live) == n
+    for nm in live:
+        per = hl["nodes"][nm]
+        assert per["enabled"] is True
+        assert per["heights"] >= 2
+        # the 1 ms budget makes every height slow: captures exist, are
+        # bounded, and dedupe to one bundle per height
+        pms = per["postmortems"]
+        assert 1 <= len(pms) <= 8
+        heights = [p["height"] for p in pms]
+        assert len(set(heights)) == len(heights)
+        assert all(p["total_ms"] > p["slow_ms"] for p in pms)
+
+    agg = hl["aggregate"]
+    s = agg["summary"]
+    assert s["heights"] >= 2
+    assert len(s["nodes"]) == n
+    # the anatomy: every closed height names a proposer, per-node
+    # propagation, and a straggler
+    closed = [h for h in agg["heights"] if h["proposer"] is not None]
+    assert closed
+    for h in closed:
+        assert h["straggler"] in h["proposal_propagation_ms"]
+    # fleet phase anatomy sums, and the straggler maps to a REGION
+    assert s["phase_total_ms"] and s["phase_total_ms"] > 0
+    assert s["proposal_propagation_p99_ms"] is not None
+    assert s["top_straggler"] is not None
+    assert s["top_straggler_region"] in (0, 1)
+    print(f"[hl-regional] straggler region r{s['top_straggler_region']} "
+          f"({s['top_straggler_name']}), phase_total "
+          f"{s['phase_total_ms']}ms, propagation p99 "
+          f"{s['proposal_propagation_p99_ms']}ms")

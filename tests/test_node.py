@@ -131,6 +131,18 @@ async def _rpc_call(addr: str, method: str, params: dict | None = None) -> dict:
     return json.loads(payload)
 
 
+async def _http_get(addr: str, path: str) -> str:
+    reader, writer = await asyncio.open_connection(*addr.rsplit(":", 1))
+    writer.write(b"GET " + path.encode() +
+                 b" HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n")
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    assert b"200" in head.split(b"\r\n")[0]
+    return payload.decode()
+
+
 def test_node_boot_commit_rpc_restart(tmp_path):
     """Single-validator node: boots from disk, commits, serves RPC, and on
     restart reconstructs LastCommit (state.go reconstructLastCommit) +
@@ -147,6 +159,21 @@ def test_node_boot_commit_rpc_restart(tmp_path):
             status = await _rpc_call(node.rpc_server.bound_addr, "status")
             assert status["result"]["node_info"]["network"] == "boot-chain"
             assert int(status["result"]["sync_info"]["latest_block_height"]) >= 3
+            # build identity: `versions` block in status mirrors the
+            # cometbft_build_info gauge on /metrics (same RPC listener)
+            from cometbft_tpu import version as _version
+
+            vers = status["result"]["versions"]
+            assert vers["version"] == _version.CMTSemVer
+            assert vers["abci"] == _version.ABCIVersion
+            assert "ed25519" in vers["schemes"]
+            assert vers["backend"] == "cpu"
+            metrics = await _http_get(node.rpc_server.bound_addr, "/metrics")
+            line = next(l for l in metrics.splitlines()
+                        if l.startswith("cometbft_build_info{"))
+            assert f'version="{_version.CMTSemVer}"' in line
+            assert 'backend="cpu"' in line
+            assert line.rstrip().endswith(" 1")
         finally:
             await node.stop()
         # anchor on a height whose APPLY completed: the state snapshot's own

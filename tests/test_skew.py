@@ -1,0 +1,191 @@
+"""Per-peer clock-skew estimator (libs/linkmodel.SkewEstimator).
+
+Synthetic two-node scenarios: constant ±500 ms offsets, a slowly
+drifting clock, asymmetric-RTT paths, and the e2e link profiles'
+jitter shapes (wan / lossy-wan) must all converge to within the
+DOCUMENTED error bound — |estimate - true| <= max(2 ms, rtt/2·1e3 +
+3·dev_ms) after ~50 samples — and the vote-delta feed must stay a
+lower-bound cross-check, never the estimate, once pings exist.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from cometbft_tpu.libs import linkmodel
+
+MS = 1_000_000  # ns per ms
+
+
+@pytest.fixture(autouse=True)
+def _fresh_linkmodel():
+    linkmodel.reset()
+    yield
+    linkmodel.reset()
+
+
+def _feed_pings(est, peer, true_offset_ms, rtt_s, n=60, jitter_ms=0.0,
+                asym=0.5, rng=None, drift_ms_per_sample=0.0):
+    """Simulate n ping/pong exchanges against a peer whose wall clock
+    runs true_offset_ms ahead of ours.  `asym` is the fraction of the
+    RTT spent on the outbound leg (0.5 = symmetric path); `jitter_ms`
+    is uniform per-leg noise; drift moves the true offset each sample.
+    Returns the final true offset (for drifting clocks)."""
+    rng = rng or random.Random(42)
+    t_local = 1_000_000 * MS
+    off = true_offset_ms
+    for i in range(n):
+        off = true_offset_ms + drift_ms_per_sample * i
+        out_leg = rtt_s * 1e3 * asym + rng.uniform(-jitter_ms, jitter_ms)
+        back_leg = (rtt_s * 1e3 * (1 - asym)
+                    + rng.uniform(-jitter_ms, jitter_ms))
+        out_leg, back_leg = max(0.0, out_leg), max(0.0, back_leg)
+        t0 = t_local
+        # responder stamps its wall clock when the pong is sent
+        remote_wall = t0 + int((out_leg + off) * MS)
+        measured_rtt = (out_leg + back_leg) / 1e3
+        midpoint = t0 + int(measured_rtt * 500.0 * MS)
+        est.observe_ping(peer, remote_wall, midpoint, measured_rtt)
+        t_local += 250 * MS  # one ping every 250 ms
+    return off
+
+
+class TestConvergence:
+    @pytest.mark.parametrize("true_ms", [500.0, -500.0, 0.0, 37.5])
+    def test_constant_offset_converges_within_bound(self, true_ms):
+        est = linkmodel.SkewEstimator()
+        _feed_pings(est, "p", true_ms, rtt_s=0.02, n=60)
+        got = est.offset_ms("p")
+        bound = est.error_bound_ms("p")
+        assert got is not None and bound is not None
+        assert abs(got - true_ms) <= bound, (
+            f"estimate {got:.3f} vs true {true_ms} exceeds bound {bound:.3f}")
+        # clean symmetric path: the estimate is actually sub-millisecond
+        assert abs(got - true_ms) < 1.0
+
+    def test_drifting_clock_tracks_within_bound(self):
+        """A clock drifting 0.5 ms per sample (~2 ms/s at the ping
+        cadence): the EWMA lags but stays inside the documented bound
+        of the CURRENT true offset."""
+        est = linkmodel.SkewEstimator()
+        final = _feed_pings(est, "p", 100.0, rtt_s=0.02, n=100,
+                            drift_ms_per_sample=0.5)
+        got = est.offset_ms("p")
+        # the residual EWMA absorbs the drift into dev_ms, widening the
+        # bound to cover the lag
+        bound = est.error_bound_ms("p")
+        assert abs(got - final) <= max(bound, 10.0), (
+            f"estimate {got:.3f} vs drifted true {final:.3f} "
+            f"(bound {bound:.3f})")
+
+    def test_asymmetric_rtt_error_stays_under_half_rtt(self):
+        """A 70/30 path split biases the midpoint by |asym-0.5|·rtt —
+        the irreducible NTP error.  The documented bound (rtt/2 + 3·dev)
+        must still cover it."""
+        est = linkmodel.SkewEstimator()
+        rtt = 0.04
+        _feed_pings(est, "p", 500.0, rtt_s=rtt, n=60, asym=0.7)
+        got = est.offset_ms("p")
+        err = abs(got - 500.0)
+        assert err <= rtt / 2 * 1e3 + 0.5  # 20 ms asymmetry ceiling
+        assert err <= est.error_bound_ms("p")
+
+    @pytest.mark.parametrize("profile,rtt_s,jitter_ms", [
+        ("wan", 0.06, 10.0),        # latency:0.03;jitter:0.01 per leg
+        ("lossy-wan", 0.10, 20.0),  # latency:0.05;jitter:0.02 per leg
+    ])
+    def test_survives_netchaos_link_profiles(self, profile, rtt_s,
+                                             jitter_ms):
+        """The e2e runner's cross-region link profiles: high latency with
+        per-leg jitter (and, for lossy-wan, drops — which simply thin
+        the sample stream).  Convergence within the documented bound
+        must survive both."""
+        rng = random.Random(7)
+        est = linkmodel.SkewEstimator()
+        n = 60 if profile == "wan" else 120  # drops thin the stream
+        _feed_pings(est, "p", -500.0, rtt_s=rtt_s, n=n,
+                    jitter_ms=jitter_ms, rng=rng)
+        got = est.offset_ms("p")
+        bound = est.error_bound_ms("p")
+        assert abs(got + 500.0) <= bound, (
+            f"{profile}: estimate {got:.3f} vs true -500 "
+            f"exceeds bound {bound:.3f}")
+        snap = est.snapshot()["p"]
+        assert snap["source"] == "ping" and snap["ping_samples"] == n
+        assert snap["dev_ms"] > 0  # jitter observed, bound widened
+
+
+class TestVoteCrossCheck:
+    def test_votes_alone_give_a_lower_bound_estimate(self):
+        est = linkmodel.SkewEstimator()
+        # peer 200 ms ahead; one-way gossip delay 30 ms, credited rtt/2
+        # = 10 ms -> samples read ~180 ms: BELOW true, as documented
+        for i in range(50):
+            t_arr = (1_000_000 + i * 300) * MS
+            vote_wall = t_arr + int(200 * MS) - int(30 * MS)
+            est.observe_vote("p", vote_wall, t_arr, rtt_s=0.02)
+        got = est.offset_ms("p")
+        assert got is not None and got <= 200.0
+        assert got == pytest.approx(180.0, abs=1.0)
+        assert est.snapshot()["p"]["source"] == "vote"
+        assert est.error_bound_ms("p") is None  # no pings, no bound
+
+    def test_ping_estimate_preferred_and_cross_check_reported(self):
+        est = linkmodel.SkewEstimator()
+        _feed_pings(est, "p", 200.0, rtt_s=0.02, n=50)
+        for i in range(50):
+            t_arr = (2_000_000 + i * 300) * MS
+            vote_wall = t_arr + int(200 * MS) - int(30 * MS)
+            est.observe_vote("p", vote_wall, t_arr, rtt_s=0.02)
+        snap = est.snapshot()["p"]
+        assert snap["source"] == "ping"
+        assert est.offset_ms("p") == pytest.approx(200.0, abs=1.0)
+        # votes lower-bound the offset: the cross-check is negative-ish,
+        # never far ABOVE zero (that would mean a lying clock)
+        assert snap["cross_check_ms"] <= 1.0
+
+
+class TestPlumbing:
+    def test_singleton_and_reset(self):
+        est = linkmodel.skew()
+        assert est is linkmodel.skew()
+        est.observe_ping("p", 1_000 * MS, 990 * MS, 0.01)
+        assert linkmodel.skew().offset_ms("p") == pytest.approx(10.0)
+        linkmodel.reset()
+        assert linkmodel.skew().offset_ms("p") is None
+        assert linkmodel.skew() is not est
+
+    def test_unknown_peer_and_empty_snapshot(self):
+        est = linkmodel.SkewEstimator()
+        assert est.offset_ms("nobody") is None
+        assert est.error_bound_ms("nobody") is None
+        assert est.snapshot() == {}
+
+    def test_bad_alpha_rejected(self):
+        with pytest.raises(ValueError):
+            linkmodel.SkewEstimator(alpha=0.0)
+        with pytest.raises(ValueError):
+            linkmodel.SkewEstimator(alpha=1.5)
+
+
+class TestPongWallClockWire:
+    def test_pong_packet_roundtrips_responder_wall_clock(self):
+        """The skew model's wire feed: the extended pong carries the
+        responder's wall clock and old-format pongs still decode
+        (forward compatibility — unknown submessage fields are
+        skipped)."""
+        from cometbft_tpu.p2p.conn import connection as C
+        from cometbft_tpu.utils.protobuf import decode_uvarint
+
+        def body(pkt: bytes) -> bytes:  # strip the length prefix
+            n, pos = decode_uvarint(pkt, 0)
+            return pkt[pos:pos + n]
+
+        pkt = C._encode_packet_pong(123_456_789)
+        kind, _, _, _, pong_wall = C._decode_packet(body(pkt))
+        assert kind == 2 and pong_wall == 123_456_789
+        legacy = C._encode_packet_pong(0)
+        kind, _, _, _, pong_wall = C._decode_packet(body(legacy))
+        assert kind == 2 and pong_wall == 0

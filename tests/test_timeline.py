@@ -1,0 +1,648 @@
+"""Consensus heightline (consensus/timeline.py) — ISSUE 16 tentpole.
+
+Covers the recorder contract (first-wins marks, bounded height ring,
+per-peer vote-lag aggregates, exactly-one bounded postmortem per slow
+height), contiguous phase anatomy, fleet aggregation with clock-skew
+alignment (straggler + slowest-link attribution), the Chrome-trace
+export, near-zero disabled-mode overhead on the consensus hot path
+(tier-1 asserts <3% of a 1k-row verify), the `consensus_timeline` /
+`postmortems` RPC surface, height/round-stamped log records, and the
+acceptance run: a 4-validator in-proc net whose aggregated phase
+durations sum to >=95% of each height's measured wall time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import json
+import time
+
+import pytest
+
+from cometbft_tpu.consensus import timeline
+
+
+@pytest.fixture(autouse=True)
+def _fresh_timeline():
+    timeline.reset()
+    yield
+    timeline.reset()
+
+
+class FakeClocks:
+    """Deterministic mono+wall pair; tick advances both in lockstep
+    (wall can be offset to model a skewed node)."""
+
+    def __init__(self, wall_offset_ns: int = 0):
+        self.mono = 1_000_000
+        self.off = wall_offset_ns
+
+    def mono_ns(self) -> int:
+        return self.mono
+
+    def wall_ns(self) -> int:
+        return self.mono + 1_000_000_000_000 + self.off
+
+    def tick(self, ms: float) -> None:
+        self.mono += int(ms * 1e6)
+
+
+def _arm(clk: FakeClocks | None = None, heights=64, slow_ms=0.0,
+         postmortems=8):
+    timeline.configure(
+        enabled=True, heights=heights, slow_ms=slow_ms,
+        postmortems=postmortems,
+        clock_mono=clk.mono_ns if clk else time.monotonic_ns,
+        clock_wall=clk.wall_ns if clk else time.time_ns)
+
+
+def _play_height(rec, clk, h, phase_ms=(5, 10, 8, 3, 4)):
+    """Drive one height through all critical-path marks with known
+    per-phase durations (propose, prevote, precommit, commit, apply)."""
+    rec.mark(h, timeline.NEW_HEIGHT)
+    clk.tick(phase_ms[0] / 2)
+    rec.mark(h, timeline.PROPOSAL_RECEIVED, peer="proposer")
+    rec.mark(h, timeline.FIRST_BLOCK_PART, peer="proposer")
+    clk.tick(phase_ms[0] / 2)
+    rec.mark(h, timeline.PROPOSAL_COMPLETE)
+    clk.tick(phase_ms[1] / 2)
+    rec.mark(h, timeline.PREVOTE_FIRST)
+    rec.mark(h, timeline.PREVOTE_THIRD)
+    clk.tick(phase_ms[1] / 2)
+    rec.mark(h, timeline.PREVOTE_QUORUM)
+    clk.tick(phase_ms[2] / 2)
+    rec.mark(h, timeline.PRECOMMIT_FIRST)
+    clk.tick(phase_ms[2] / 2)
+    rec.mark(h, timeline.PRECOMMIT_QUORUM)
+    clk.tick(phase_ms[3])
+    rec.mark(h, timeline.COMMIT)
+    clk.tick(phase_ms[4])
+    rec.mark(h, timeline.APPLY_DONE)
+    rec.height_done(h)
+
+
+# ---------------------------------------------------------------- recorder
+
+
+class TestRecorder:
+    def test_marks_are_first_wins(self):
+        clk = FakeClocks()
+        _arm(clk)
+        rec = timeline.Recorder(node="n0")
+        rec.mark(5, timeline.NEW_HEIGHT)
+        t0 = clk.wall_ns()
+        clk.tick(10)
+        rec.mark(5, timeline.NEW_HEIGHT)  # backstop repeat: ignored
+        snap = rec.snapshot()
+        assert snap[0]["events"][timeline.NEW_HEIGHT]["wall_ns"] == t0
+
+    def test_phases_tile_the_height_exactly(self):
+        clk = FakeClocks()
+        _arm(clk)
+        rec = timeline.Recorder(node="n0")
+        _play_height(rec, clk, 7, phase_ms=(6, 10, 8, 2, 4))
+        r = rec.snapshot()[0]
+        assert r["phases"] == {"propose": 6.0, "prevote": 10.0,
+                               "precommit": 8.0, "commit": 2.0,
+                               "apply": 4.0}
+        assert r["total_ms"] == 30.0
+        assert sum(r["phases"].values()) == r["total_ms"]
+
+    def test_missing_marks_give_none_phases_not_errors(self):
+        _arm()
+        rec = timeline.Recorder()
+        rec.mark(1, timeline.NEW_HEIGHT)
+        r = rec.snapshot()[0]
+        assert r["phases"]["propose"] is None
+        assert "total_ms" not in r
+        rec.height_done(1)  # no APPLY_DONE: stays open, no crash
+        assert "total_ms" not in rec.snapshot()[0]
+
+    def test_height_ring_is_bounded(self):
+        clk = FakeClocks()
+        _arm(clk, heights=4)
+        rec = timeline.Recorder()
+        for h in range(1, 11):
+            _play_height(rec, clk, h)
+        snap = rec.snapshot()
+        assert [r["height"] for r in snap] == [7, 8, 9, 10]
+        assert len(rec._by_height) == 4  # evicted, not leaked
+
+    def test_snapshot_min_height_and_limit(self):
+        clk = FakeClocks()
+        _arm(clk)
+        rec = timeline.Recorder()
+        for h in range(1, 9):
+            _play_height(rec, clk, h)
+        assert [r["height"] for r in rec.snapshot(min_height=6)] == [6, 7, 8]
+        assert [r["height"] for r in rec.snapshot(limit=2)] == [7, 8]
+
+    def test_vote_lag_aggregates_per_peer(self):
+        clk = FakeClocks()
+        _arm(clk)
+        rec = timeline.Recorder()
+        for lag_ms in (10, 30, 20):
+            rec.vote_arrival(3, 0, 1, "peerA",
+                             clk.wall_ns() - int(lag_ms * 1e6))
+        rec.vote_arrival(3, 0, 1, "peerB", clk.wall_ns() - int(5 * 1e6))
+        votes = rec.snapshot()[0]["votes"]
+        assert votes["peerA"]["n"] == 3
+        assert votes["peerA"]["lag_ms_mean"] == 20.0
+        assert votes["peerA"]["lag_ms_max"] == 30.0
+        assert votes["peerB"]["n"] == 1
+
+    def test_vote_peer_table_is_capped(self):
+        _arm()
+        rec = timeline.Recorder()
+        for i in range(timeline._VOTE_PEER_CAP + 10):
+            rec.vote_arrival(1, 0, 1, f"p{i}", 0)
+        assert len(rec.snapshot()[0]["votes"]) == timeline._VOTE_PEER_CAP
+
+    def test_disabled_recorder_writes_nothing(self):
+        assert not timeline.enabled()
+        rec = timeline.Recorder()
+        rec.mark(1, timeline.NEW_HEIGHT)
+        rec.vote_arrival(1, 0, 1, "p", 0)
+        rec.height_done(1)
+        assert rec.snapshot() == [] and rec.postmortems() == []
+
+    def test_clear(self):
+        clk = FakeClocks()
+        _arm(clk, slow_ms=1.0)
+        rec = timeline.Recorder()
+        _play_height(rec, clk, 1)
+        assert rec.snapshot() and rec.postmortems()
+        rec.clear()
+        assert rec.snapshot() == [] and rec.postmortems() == []
+
+
+# ------------------------------------------------------------- postmortems
+
+
+class TestPostmortems:
+    def test_slow_height_captures_exactly_once(self):
+        clk = FakeClocks()
+        _arm(clk, slow_ms=20.0)
+        rec = timeline.Recorder(node="n0")
+        _play_height(rec, clk, 1, phase_ms=(1, 2, 2, 1, 1))   # 7ms: fast
+        _play_height(rec, clk, 2, phase_ms=(10, 20, 10, 5, 5))  # 50ms: slow
+        rec.height_done(2)  # double close: still one bundle
+        pms = rec.postmortems()
+        assert [p["height"] for p in pms] == [2]
+        assert pms[0]["total_ms"] == 50.0 and pms[0]["slow_ms"] == 20.0
+        full = rec.postmortem(2)
+        assert full["node"] == "n0"
+        assert full["timeline"]["phases"]["prevote"] == 20.0
+        assert rec.postmortem(1) is None
+
+    def test_capture_ring_bounded_fifo(self):
+        clk = FakeClocks()
+        _arm(clk, slow_ms=1.0, postmortems=2)
+        rec = timeline.Recorder()
+        for h in range(1, 5):
+            _play_height(rec, clk, h)  # every height is "slow" at 1ms
+        assert [p["height"] for p in rec.postmortems()] == [3, 4]
+
+    def test_collector_context_attached_and_errors_degrade(self):
+        clk = FakeClocks()
+        _arm(clk, slow_ms=1.0)
+        rec = timeline.Recorder()
+        rec.collector = lambda h: {"gossip": {"h": h}}
+        _play_height(rec, clk, 1)
+        assert rec.postmortem(1)["context"] == {"gossip": {"h": 1}}
+
+        def boom(h):
+            raise RuntimeError("collector died")
+
+        rec.collector = boom
+        _play_height(rec, clk, 2)
+        pm = rec.postmortem(2)
+        assert "context" not in pm
+        assert "collector died" in pm["context_error"]
+
+    def test_disabled_slow_ms_never_captures(self):
+        clk = FakeClocks()
+        _arm(clk, slow_ms=0.0)
+        rec = timeline.Recorder()
+        _play_height(rec, clk, 1, phase_ms=(100, 100, 100, 100, 100))
+        assert rec.postmortems() == []
+
+
+# --------------------------------------------------------------- aggregate
+
+
+def _doc(node_id, heights, skew=None):
+    return {"node_id": node_id, "heights": heights, "skew": skew or {}}
+
+
+def _synthetic_fleet(straggler_extra_ms=40.0, skew_b_ms=500.0):
+    """Three nodes: n0 proposes; n1 is straggling on proposal assembly;
+    n1's wall clock runs skew_b_ms ahead (its raw stamps lie)."""
+    docs = []
+    for nid, wall_off, extra in (("n0", 0, 0.0), ("n1", skew_b_ms,
+                                                  straggler_extra_ms),
+                                 ("n2", 0, 5.0)):
+        clk = FakeClocks(wall_offset_ns=int(wall_off * 1e6))
+        _arm(clk)
+        rec = timeline.Recorder(node=nid)
+        rec.mark(4, timeline.NEW_HEIGHT)
+        if nid == "n0":
+            rec.mark(4, timeline.PROPOSAL_SENT)
+        clk.tick(2 + extra)
+        rec.mark(4, timeline.PROPOSAL_COMPLETE)
+        clk.tick(10)
+        rec.mark(4, timeline.PREVOTE_QUORUM)
+        clk.tick(8)
+        rec.mark(4, timeline.PRECOMMIT_QUORUM)
+        clk.tick(3)
+        rec.mark(4, timeline.COMMIT)
+        clk.tick(4)
+        rec.mark(4, timeline.APPLY_DONE)
+        rec.height_done(4)
+        skew = ({"n1": {"offset_ms": skew_b_ms, "source": "ping"}}
+                if nid == "n0" else {})
+        docs.append(_doc(nid, rec.snapshot(), skew))
+    return docs
+
+
+class TestAggregate:
+    def test_straggler_named_despite_clock_skew(self):
+        """n1's raw wall stamps run +500 ms; without skew correction its
+        propagation would read ~502 ms. With the ref node's skew entry
+        the aggregate must name it a ~42 ms straggler instead."""
+        docs = _synthetic_fleet()
+        agg = timeline.aggregate(docs)
+        assert agg["ref"] == "n0"
+        assert agg["offsets_ms"] == {"n0": 0.0, "n1": 500.0, "n2": 0.0}
+        h = agg["heights"][0]
+        assert h["height"] == 4 and h["proposer"] == "n0"
+        assert h["straggler"] == "n1"
+        prop = h["proposal_propagation_ms"]
+        assert prop["n1"] == pytest.approx(42.0, abs=1.0)
+        assert prop["n1"] < 100.0  # the +500ms skew was corrected away
+        assert h["phases"]["propose"]["slowest"] == "n1"
+        assert h["phases"]["propose"]["max_ms"] == pytest.approx(42.0)
+        s = agg["summary"]
+        assert s["top_straggler"] == "n1"
+        assert s["straggler_heights"] == {"n1": 1}
+        assert s["proposal_propagation_p99_ms"] == max(prop.values())
+        assert s["phase_total_ms"] == pytest.approx(
+            sum(p["max_ms"] for p in h["phases"].values()))
+
+    def test_reverse_skew_entry_used_when_ref_lacks_one(self):
+        docs = _synthetic_fleet()
+        # move the skew knowledge to n1's own table (about the ref)
+        docs[0]["skew"] = {}
+        docs[1]["skew"] = {"n0": {"offset_ms": -500.0, "source": "ping"}}
+        agg = timeline.aggregate(docs)
+        assert agg["offsets_ms"]["n1"] == 500.0
+
+    def test_slowest_link_skew_corrected(self):
+        clk = FakeClocks()
+        _arm(clk)
+        rec = timeline.Recorder(node="n0")
+        # raw lag 520ms from n1 — but n1's clock is +500ms, so the true
+        # link lag is 20ms... wait, vote lag = arrival - signing: a peer
+        # AHEAD by 500ms makes raw lag read 500ms LOW, so raw -480 means
+        # true 20. Model the raw read the hook would produce:
+        rec.vote_arrival(4, 0, 1, "n1", clk.wall_ns() + int(480 * 1e6))
+        rec.vote_arrival(4, 0, 1, "n2", clk.wall_ns() - int(25 * 1e6))
+        rec.mark(4, timeline.NEW_HEIGHT)
+        docs = [_doc("n0", rec.snapshot(),
+                     {"n1": {"offset_ms": 500.0, "source": "ping"}}),
+                _doc("n1", []), _doc("n2", [])]
+        agg = timeline.aggregate(docs)
+        link = agg["heights"][0]["slowest_link"]
+        # raw n1 lag (-480) + skew(+500 on the SIGNER side) = 20; n2's
+        # honest 25ms link is the real slowest
+        assert link["from"] == "n2" and link["to"] == "n0"
+        assert link["lag_ms"] == pytest.approx(25.0, abs=0.5)
+
+    def test_empty_and_disabled_docs(self):
+        assert timeline.aggregate([]) == {
+            "ref": "", "offsets_ms": {}, "heights": [], "summary": {}}
+        agg = timeline.aggregate([_doc("n0", []), None])
+        assert agg["ref"] == "n0" and agg["heights"] == []
+        assert agg["summary"]["phase_total_ms"] is None
+
+
+# ------------------------------------------------------------ chrome export
+
+
+class TestChromeExport:
+    def test_spans_feed_trace_exporter(self, tmp_path):
+        from cometbft_tpu.libs import trace
+
+        docs = _synthetic_fleet()
+        agg = timeline.aggregate(docs)
+        spans = timeline.chrome_spans(agg, docs)
+        # per node: 1 height X span + 5 phase spans + instants per mark
+        assert sum(1 for s in spans if s["name"].startswith("height ")) == 3
+        phases = [s for s in spans if s["name"] in timeline.PHASES
+                  and not s["attrs"].get("instant")]
+        assert len(phases) == 15
+        tids = {s["tid"] for s in spans}
+        assert len(tids) == 3  # one lane per node
+        path = str(tmp_path / "heightline.json")
+        n = trace.write_chrome_trace(path, spans)
+        with open(path) as f:
+            doc = json.load(f)
+        assert len(doc["traceEvents"]) == n
+        assert {e["ph"] for e in doc["traceEvents"]} >= {"X", "i"}
+        json.dumps(doc)  # pure JSON
+
+    def test_empty_docs_export_no_spans(self):
+        agg = timeline.aggregate([_doc("n0", [])])
+        assert timeline.chrome_spans(agg, [_doc("n0", [])]) == []
+
+
+# ------------------------------------------------------ disabled overhead
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+class TestDisabledOverhead:
+    def test_disabled_mark_cost_under_3pct_of_1k_row_verify(self):
+        """Tier-1 acceptance: with the timeline OFF, the instrumented
+        consensus path pays <3% overhead. A height makes a couple dozen
+        recorder touches; assert that even 1000 disabled touches
+        (mark+vote_arrival+height_done, ~30x the real count) cost under
+        3% of the measured 1k-row verify wall."""
+        from cometbft_tpu.crypto import ed25519
+        from cometbft_tpu.ops import ed25519_kernel as K
+
+        assert not timeline.enabled()
+        priv = ed25519.gen_priv_key()
+        msgs = [b"ovh-%d" % i for i in range(1000)]
+        sigs = [priv.sign(m) for m in msgs]
+        pubs = [priv.pub_key().bytes_()] * 1000
+        cache = K.PubKeyCache()
+        ok, _ = K.verify_batch(pubs, msgs, sigs, cache=cache)  # warm
+        assert ok
+        t_verify = min(
+            _timed(lambda: K.verify_batch(pubs, msgs, sigs, cache=cache))
+            for _ in range(3))
+
+        rec = timeline.Recorder()
+
+        def touches():
+            for i in range(1000):
+                rec.mark(i, timeline.NEW_HEIGHT)
+                rec.vote_arrival(i, 0, 1, "p", 0)
+                rec.height_done(i)
+
+        t_marks = min(_timed(touches) for _ in range(3))
+        assert t_marks < 0.03 * t_verify, (
+            f"disabled-mode timeline cost {t_marks * 1e3:.2f}ms vs 3% of "
+            f"verify {t_verify * 1e3:.2f}ms")
+
+
+# -------------------------------------------------- log height/round stamp
+
+
+class TestLogHeightRound:
+    def test_records_stamped_inside_consensus_context(self):
+        from cometbft_tpu.libs import log as cmtlog
+
+        buf = io.StringIO()
+        logger = cmtlog.Logger(buf, cmtlog.INFO, (), "json")
+        cmtlog.set_height_round(42, 1)
+        try:
+            logger.info("entering precommit")
+        finally:
+            cmtlog.clear_height_round()
+        rec = json.loads(buf.getvalue())
+        assert rec["height"] == 42 and rec["round"] == 1
+        buf2 = io.StringIO()
+        cmtlog.Logger(buf2, cmtlog.INFO, (), "logfmt").info("outside")
+        assert "height" not in buf2.getvalue()
+
+    def test_context_is_task_local(self):
+        from cometbft_tpu.libs import log as cmtlog
+
+        out = {}
+
+        async def one(name, h):
+            cmtlog.set_height_round(h, 0)
+            await asyncio.sleep(0.001)
+            out[name] = cmtlog.current_height_round()
+
+        async def main():
+            await asyncio.gather(one("a", 10), one("b", 20))
+
+        asyncio.run(main())
+        assert out["a"][0] == 10 and out["b"][0] == 20
+        assert cmtlog.current_height_round() is None
+
+
+# ------------------------------------------------------- acceptance: net
+
+
+class TestHeightlineNet:
+    def test_four_val_net_phases_cover_95pct_of_height_wall(self):
+        """ISSUE 16 acceptance: on a live 4-validator in-proc net the
+        aggregated per-height phase durations sum to >=95% of each
+        height's measured wall time, and the aggregate names a proposer
+        and per-node propagation for every height all nodes closed."""
+        from net_harness import make_net
+
+        from cometbft_tpu.consensus.config import test_consensus_config
+        from cometbft_tpu.crypto import batch as crypto_batch
+
+        timeline.configure(enabled=True, heights=64)
+        crypto_batch.set_backend("cpu")
+
+        async def run():
+            cfg = test_consensus_config()
+            net = await make_net(4, config=cfg, chain_id="heightline-net")
+            for nd in net.nodes:
+                nd.cs.timeline.node = nd.name
+            await net.start()
+            try:
+                await net.wait_for_height(5, timeout=90.0)
+            finally:
+                await net.stop()
+            return net
+
+        try:
+            net = asyncio.run(run())
+        finally:
+            crypto_batch.set_backend("auto")
+
+        docs = [{"node_id": nd.name, "heights": nd.cs.timeline.snapshot(),
+                 "skew": {}} for nd in net.nodes]
+        checked = 0
+        for doc in docs:
+            for r in doc["heights"]:
+                if "total_ms" not in r or r["total_ms"] <= 0:
+                    continue  # height still open at net.stop()
+                phases = [v for v in r["phases"].values() if v is not None]
+                assert len(phases) == 5, (
+                    f"{doc['node_id']} h{r['height']}: missing phase "
+                    f"edges {r['phases']}")
+                cov = sum(phases) / r["total_ms"]
+                assert cov >= 0.95, (
+                    f"{doc['node_id']} h{r['height']}: phase sum covers "
+                    f"{cov:.3f} of wall {r['total_ms']}ms")
+                checked += 1
+        assert checked >= 8  # several heights on several nodes
+
+        agg = timeline.aggregate(docs)
+        assert agg["summary"]["heights"] >= 2
+        assert agg["summary"]["phase_total_ms"] > 0
+        closed = [h for h in agg["heights"] if len(h["total_ms"]) == 4]
+        assert closed, "no height closed on all 4 nodes"
+        for h in closed:
+            assert h["proposer"] is not None
+            assert len(h["proposal_propagation_ms"]) == 4
+            assert h["straggler"] in h["proposal_propagation_ms"]
+
+    def test_slow_height_postmortem_on_net(self):
+        """With height_slow_ms=0.001 every height is 'slow': each node
+        captures bounded bundles with the full local timeline."""
+        from net_harness import make_net
+
+        from cometbft_tpu.consensus.config import test_consensus_config
+        from cometbft_tpu.crypto import batch as crypto_batch
+
+        timeline.configure(enabled=True, slow_ms=0.001, postmortems=3)
+        crypto_batch.set_backend("cpu")
+
+        async def run():
+            cfg = test_consensus_config()
+            net = await make_net(4, config=cfg, chain_id="pm-net")
+            for nd in net.nodes:
+                nd.cs.timeline.node = nd.name
+                nd.cs.timeline.slow_ms = 0.001
+            await net.start()
+            try:
+                await net.wait_for_height(5, timeout=90.0)
+            finally:
+                await net.stop()
+            return net
+
+        try:
+            net = asyncio.run(run())
+        finally:
+            crypto_batch.set_backend("auto")
+
+        for nd in net.nodes:
+            pms = nd.cs.timeline.postmortems()
+            assert 1 <= len(pms) <= 3  # captured, and ring-bounded
+            heights = [p["height"] for p in pms]
+            assert len(set(heights)) == len(heights)  # one per height
+            full = nd.cs.timeline.postmortem(heights[-1])
+            assert full["timeline"]["events"]
+            assert full["total_ms"] > 0.001
+
+
+# --------------------------------------------------------------- RPC routes
+
+
+class TestTimelineRoutes:
+    def _env_with_recorder(self):
+        from cometbft_tpu.rpc.core import Environment
+
+        clk = FakeClocks()
+        _arm(clk, slow_ms=1.0)
+        rec = timeline.Recorder(node="fake")
+        _play_height(rec, clk, 3)
+
+        class _CS:
+            pass
+
+        class _NK:
+            @staticmethod
+            def id():
+                return "fakenodeid"
+
+        class _NI:
+            moniker = "fake-node"
+
+        class _N:
+            consensus_state = _CS()
+            node_key = _NK()
+            node_info = _NI()
+            config = None
+
+        _N.consensus_state.timeline = rec
+        return Environment(node=_N()), rec
+
+    def test_consensus_timeline_route(self):
+        env, _rec = self._env_with_recorder()
+        out = asyncio.run(env.consensus_timeline({}))
+        assert out["node_id"] == "fakenodeid"
+        assert out["moniker"] == "fake-node"
+        assert out["enabled"] is True
+        assert out["heights"][0]["height"] == 3
+        assert out["heights"][0]["phases"]["propose"] is not None
+        assert isinstance(out["skew"], dict)
+        out2 = asyncio.run(env.consensus_timeline(
+            {"min_height": 4, "limit": 1}))
+        assert out2["heights"] == []
+
+    def test_postmortems_route(self):
+        from cometbft_tpu.rpc.core import RPCError
+
+        env, _rec = self._env_with_recorder()
+        out = asyncio.run(env.postmortems({}))
+        assert [c["height"] for c in out["captures"]] == [3]
+        assert "postmortem" not in out
+        full = asyncio.run(env.postmortems({"height": 3}))
+        assert full["postmortem"]["timeline"]["phases"]["apply"] == 4.0
+        with pytest.raises(RPCError):
+            asyncio.run(env.postmortems({"height": 99}))
+
+    def test_routes_degrade_without_a_node(self):
+        from cometbft_tpu.rpc.core import Environment
+
+        env = Environment(node=None)
+        out = asyncio.run(env.consensus_timeline({}))
+        assert out["heights"] == [] and out["enabled"] is False
+        pm = asyncio.run(env.postmortems({}))
+        assert pm["captures"] == []
+
+    def test_routes_registered(self):
+        from cometbft_tpu.rpc.core import Environment
+
+        class _N:
+            config = None
+
+        table = Environment(node=_N()).routes()
+        assert "consensus_timeline" in table and "postmortems" in table
+
+
+# ----------------------------------------------------------- config plumb
+
+
+class TestConfigPlumbing:
+    def test_instrumentation_knobs_validate(self, tmp_path):
+        from cometbft_tpu.config import Config
+
+        cfg = Config(home=str(tmp_path))
+        cfg.instrumentation.timeline = True
+        cfg.instrumentation.timeline_heights = 16
+        cfg.instrumentation.height_slow_ms = 250.0
+        cfg.instrumentation.postmortem_captures = 2
+        cfg.validate_basic()
+        cfg.instrumentation.timeline_heights = 0
+        with pytest.raises(ValueError):
+            cfg.validate_basic()
+        cfg.instrumentation.timeline_heights = 16
+        cfg.instrumentation.postmortem_captures = 0
+        with pytest.raises(ValueError):
+            cfg.validate_basic()
+
+    def test_configure_clamps_and_reset_restores(self):
+        timeline.configure(enabled=True, heights=0, postmortems=-3)
+        assert timeline._def_heights == 1
+        assert timeline._def_postmortems == 1
+        assert timeline.enabled()
+        timeline.reset()
+        assert not timeline.enabled()
+        assert timeline._def_heights == timeline._DEF_HEIGHTS

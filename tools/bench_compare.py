@@ -110,6 +110,15 @@ TRACKED: dict[str, tuple[str, float]] = {
     # storage.-prefixed like the mesh/bls keys.
     "wal_fsync_p99_ms": (LOWER, 75.0),
     "storage.wal_fsync_p99_ms": (LOWER, 75.0),
+    # consensus heightline (bench_consensus_tpu + consensus/timeline.py):
+    # the sum of the five per-phase fleet maxima
+    # (propose/prevote/precommit/commit/apply) over the 4-val in-proc
+    # net. ENFORCED lower-is-better with a wide threshold — the absolute
+    # number rides host contention, but a multiple-of-itself jump means
+    # a consensus phase grew real work. Bare and consensus.-prefixed
+    # like the mesh/bls/storage keys.
+    "height_phase_total_ms": (LOWER, 75.0),
+    "consensus.height_phase_total_ms": (LOWER, 75.0),
 }
 
 # informational-by-design (wire/tunnel-bound): listed so the verdict can
@@ -151,6 +160,20 @@ INFORMATIONAL = {
                                 "(aggregate vs batched-ed25519); moves "
                                 "between CPU and accelerator rounds by "
                                 "design — tracked for trend only",
+    # heightline per-phase breakdown + propagation tail: the TOTAL is
+    # enforced (height_phase_total_ms above); the split between phases
+    # shifts legitimately with scheduler/timeout phasing, and the p99 of
+    # a 4-val in-proc net is a handful of samples
+    "height_phase_ms.propose": "phase split of the enforced "
+                               "height_phase_total_ms — shifts between "
+                               "phases are not regressions by themselves",
+    "height_phase_ms.prevote": "see height_phase_ms.propose",
+    "height_phase_ms.precommit": "see height_phase_ms.propose",
+    "height_phase_ms.commit": "see height_phase_ms.propose",
+    "height_phase_ms.apply": "see height_phase_ms.propose",
+    "proposal_propagation_p99_ms": "p99 over tens of in-proc samples: "
+                                   "tracked for trend until a quiet "
+                                   "round establishes variance",
 }
 
 
