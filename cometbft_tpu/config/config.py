@@ -276,12 +276,28 @@ class RPCConfig:
     # expose the operator control routes (dial_seeds/dial_peers/
     # unsafe_flush_mempool/unsafe_disconnect_peers; config.go Unsafe)
     unsafe: bool = False
+    # overload guard (libs/overload.py, no reference analog): bounded
+    # per-route-class in-flight budgets — excess requests wait out the
+    # queue deadline then shed with -32005 + a retry-after hint. 0
+    # disables a class's budget. Control routes are always exempt.
+    overload_read_inflight: int = 256
+    overload_write_inflight: int = 64
+    overload_queue_timeout: float = 0.05
+    # a client that stops draining its socket gets this long before the
+    # server abandons the response and closes the connection
+    slow_client_timeout: float = 10.0
 
     def validate_basic(self) -> None:
         if self.max_open_connections < 0:
             raise ValueError("max_open_connections cannot be negative")
         if self.timeout_broadcast_tx_commit <= 0:
             raise ValueError("timeout_broadcast_tx_commit must be positive")
+        if self.overload_read_inflight < 0 or self.overload_write_inflight < 0:
+            raise ValueError("overload in-flight budgets cannot be negative")
+        if self.overload_queue_timeout < 0:
+            raise ValueError("overload_queue_timeout cannot be negative")
+        if self.slow_client_timeout <= 0:
+            raise ValueError("slow_client_timeout must be positive")
 
 
 @dataclass

@@ -34,6 +34,11 @@ VOTE_EXT_HEIGHT_OFFSETS = (0, 2)  # 0 = disabled
 # (CBFT_CRASH_SITE); disk-fault arms a bounded diskchaos schedule at
 # runtime (unsafe_disk_chaos) and asserts the faults were counted and
 # the node degraded or halted typed — never served a differing block.
+# mempool-storm respawns the node with a small mempool and drives
+# admission waves at its RPC (the chain must keep advancing, sheds must
+# land on /metrics); rpc-flood respawns with a 1-slot write budget and
+# floods concurrent commit-wait writes (excess must shed -32005 while
+# the exempt control plane keeps serving).
 PERTURBATIONS = {"kill": 0.1, "pause": 0.1, "restart": 0.1,
                  "device-kill": 0.05, "device-flap": 0.05,
                  "chip-kill:1": 0.05, "chip-flap:1": 0.05,
@@ -41,13 +46,15 @@ PERTURBATIONS = {"kill": 0.1, "pause": 0.1, "restart": 0.1,
                  "light-fleet": 0.05,
                  "crash-storm": 0.05, "crash-storm:abci.apply": 0.03,
                  "disk-fault:bitrot": 0.04, "disk-fault:enospc": 0.03,
-                 "disk-fault:slow": 0.03}
+                 "disk-fault:slow": 0.03,
+                 "mempool-storm": 0.05, "rpc-flood": 0.04}
 # perturbations that kill + respawn the OS process (a memdb node would
 # lose its stores while its out-of-process app keeps state); compared by
 # BASE name (chip-kill:N respawns too)
 RESPAWN_PERTURBATIONS = {"kill", "restart", "device-kill", "device-flap",
                          "chip-kill", "chip-flap", "byzantine", "flood",
-                         "light-fleet", "crash-storm", "disk-fault"}
+                         "light-fleet", "crash-storm", "disk-fault",
+                         "mempool-storm", "rpc-flood"}
 
 
 def generate_manifest(rng: random.Random, index: int) -> Manifest:

@@ -51,7 +51,15 @@ class NodeManifest:
     # runtime via unsafe_disk_chaos — kind from the non-crash subset
     # below, default bitrot; every injected fault must be counted in
     # storage_health and the node must degrade or halt typed, never
-    # serve a block that differs from the fault-free run)
+    # serve a block that differs from the fault-free run);
+    # overload faults (libs/overload.py): mempool-storm (respawn with a
+    # SMALL mempool and drive fire-and-forget admission waves at the
+    # node's RPC — the chain must keep advancing, the exempt health
+    # route must answer mid-storm, and the mempool plane's sheds must
+    # land on /metrics), rpc-flood (respawn with a 1-slot write budget
+    # and flood concurrent broadcast_tx_commit calls — excess requests
+    # must shed with the unified -32005 envelope, plane "rpc", while
+    # the exempt control plane keeps serving)
     perturb: list[str] = field(default_factory=list)
     # fleet topologies: which region this node lives in (regional/hub
     # topologies wire peering and netchaos link profiles from this;
@@ -62,7 +70,8 @@ class NodeManifest:
                      "device-kill", "device-flap",
                      "chip-kill", "chip-flap",
                      "partition", "byzantine", "flood", "light-fleet",
-                     "crash-storm", "disk-fault")
+                     "crash-storm", "disk-fault",
+                     "mempool-storm", "rpc-flood")
     # perturbations that take a ":<device-index>" argument
     INDEXED_PERTURBATIONS = ("chip-kill", "chip-flap")
     # disk-fault kinds an OS process can survive to keep serving (the
@@ -152,6 +161,14 @@ class Manifest:
     #   byzantine-minority[:k]    restart k nodes (default n//3, capped to
     #                             keep a +2/3 honest quorum) equivocating;
     #                             honest nodes must commit evidence
+    #   minority-partition[:k]    cut the LAST k nodes off (default n//4,
+    #                             capped to keep a +2/3 majority quorum) —
+    #                             the topology-agnostic sibling of
+    #                             regional-partition (under the hub
+    #                             topology the last nodes are spokes, so
+    #                             the hub mesh stays intact); the majority
+    #                             must commit, the cut side stall, the
+    #                             heal catch it up + land on the metric
     net_perturb: list[str] = field(default_factory=list)
     # compact vote-set reconciliation (consensus.gossip_vote_summaries)
     # for every node: False = the full-gossip baseline, the control arm
@@ -165,7 +182,7 @@ class Manifest:
 
     TOPOLOGIES = ("full", "hub", "regional")
     NET_PERTURBATIONS = ("churn-storm", "regional-partition",
-                         "byzantine-minority")
+                         "byzantine-minority", "minority-partition")
     LINK_PROFILES = ("", "wan", "lossy-wan")
 
     def validate(self) -> None:
@@ -201,6 +218,12 @@ class Manifest:
                 if base == "churn-storm" and not 1 <= v <= 100:
                     raise ValueError(
                         f"churn-storm percent out of range in {p!r}")
+                if (base == "minority-partition"
+                        and (v < 1 or 3 * v >= len(self.nodes))):
+                    raise ValueError(
+                        f"minority-partition must cut a quorum-"
+                        f"preserving minority (1 <= k, 3*k < nodes) "
+                        f"in {p!r}")
             if (base == "regional-partition"
                     and (self.topology != "regional" or self.regions < 2)):
                 raise ValueError(

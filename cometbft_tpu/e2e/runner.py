@@ -9,6 +9,7 @@ perturbation matrix instead), and out-of-process ABCI apps are one
 
 from __future__ import annotations
 
+import concurrent.futures
 import json
 import os
 import signal
@@ -731,6 +732,51 @@ def _perturb_regional_partition(net: _Net, names: list[str], region: int,
     log(f"[{m.name}] region r{region} healed and caught up")
 
 
+def _perturb_minority_partition(net: _Net, names: list[str], k: int,
+                                log) -> None:
+    """Cut the LAST k nodes off through the runtime netchaos route — the
+    topology-agnostic sibling of regional-partition (a hub fleet has no
+    regions, and under the hub topology the last nodes are spokes, so
+    the hub mesh stays intact). The cut minority must STALL while the
+    majority commits; a heal must reconnect it, catch it up, and land
+    on the partition-heal metric."""
+    m = net.manifest
+    n = len(names)
+    k = max(1, min(k, (n - 1) // 3))  # the majority keeps a +2/3 quorum
+    ids = _node_ids(net)
+    cut = list(range(n - k, n))
+    rest = list(range(n - k))
+    spec = ("partition=" + ".".join(ids[i] for i in cut) + "|"
+            + ".".join(ids[i] for i in rest))
+    log(f"[{m.name}] minority partition: cutting "
+        f"{', '.join(names[i] for i in cut)} from the other {len(rest)}")
+    arg = urllib.parse.quote(f'"{spec}"')
+    for j in range(n):
+        _rpc(net, j, f"unsafe_net_chaos?spec={arg}", timeout=10.0)
+    time.sleep(2.0)  # in-flight commits land
+    cut_h = _max_height(net, cut)
+    rest_h = _max_height(net, rest)
+    _wait(lambda: _min_height(net, rest) >= rest_h + 2, 120 + 2 * n,
+          "the majority side committing through the minority partition")
+    if _max_height(net, cut) > cut_h + 1:
+        raise RunError(
+            f"cut minority advanced {cut_h} -> {_max_height(net, cut)} "
+            f"during its partition")
+    for j in range(n):
+        _rpc(net, j, "unsafe_net_chaos?heal=true", timeout=10.0)
+    # same redial nudge as the regional heal: reconnect backoff deepens
+    # during a long partition, the control route shortcuts it
+    _nudge_dials(net, names)
+    target = _max_height(net, rest) + 2
+    _wait(lambda: _min_height(net, range(n)) >= target, 300 + 6 * n,
+          f"the cut minority catching up to {target} after the heal")
+    if not any(_metric_value(_metrics_text(net, j),
+                             "cometbft_p2p_partition_heal_seconds") > 0
+               for j in range(n)):
+        raise RunError("minority partition heal not recorded on /metrics")
+    log(f"[{m.name}] minority healed and caught up")
+
+
 def _perturb_byzantine_minority(net: _Net, names: list[str], k: int,
                                 log) -> None:
     """Restart k nodes equivocating (capped to keep a +2/3 honest
@@ -773,6 +819,10 @@ def _run_net_perturbations(net: _Net, names: list[str], log) -> None:
         elif base == "byzantine-minority":
             _perturb_byzantine_minority(
                 net, names, int(arg) if arg else len(names) // 3, log)
+        elif base == "minority-partition":
+            _perturb_minority_partition(
+                net, names, int(arg) if arg else max(1, len(names) // 4),
+                log)
 
 
 def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
@@ -1031,6 +1081,131 @@ def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
                     # with the typed error must rejoin; a live one just
                     # restarts (the shared tail asserts fork-free)
                     _rpc(net, i, "unsafe_disk_chaos?clear=true")
+                    _kill(net.node_procs[i])
+                    net.node_procs[i] = _spawn_node(net.homes[i])
+                elif p == "mempool-storm":
+                    # respawn with a SMALL pool so saturation is reachable
+                    # without drowning the host, then drive fire-and-forget
+                    # admission waves at the node's RPC: the chain must
+                    # ADVANCE through the storm (only admission-plane work
+                    # may be shed), the exempt control plane must answer
+                    # mid-storm, and the sheds must land on /metrics with
+                    # the mempool plane label
+                    log(f"[{manifest.name}] mempool-storm {name}")
+                    from cometbft_tpu.config import Config
+
+                    cfg = Config.load(net.homes[i])
+                    orig_pool = cfg.mempool.size
+                    cfg.mempool.size = 128
+                    cfg.save()
+                    _kill(net.node_procs[i])
+                    net.node_procs[i] = _spawn_node(net.homes[i])
+                    _wait(lambda: _height(net, i) >= 1, 150,
+                          f"{name} serving with a small pool")
+                    h1 = _height(net, i)
+                    for wave in range(4):
+                        for t in range(200):
+                            tx = urllib.parse.quote(
+                                f'"storm-{name}-{wave:02d}-{t:03d}"')
+                            _rpc(net, i, f"broadcast_tx_async?tx={tx}",
+                                 timeout=10.0)
+                        doc = _rpc(net, i, "health", timeout=10.0)
+                        if "overload" not in doc.get("result", {}):
+                            raise RunError(
+                                f"mempool-storm on {name}: health lost its "
+                                f"overload section mid-storm: {doc}")
+                    _wait(lambda: _height(net, i) >= h1 + 2, 120,
+                          "the chain advancing through the mempool storm")
+                    shed = _metric_value(
+                        _metrics_text(net, i, timeout=5.0),
+                        'cometbft_overload_sheds_total{plane="mempool"}')
+                    if shed < 1:
+                        raise RunError(
+                            f"mempool-storm on {name}: 800 txs into a "
+                            f"128-tx pool shed nothing on /metrics")
+                    cfg = Config.load(net.homes[i])
+                    cfg.mempool.size = orig_pool
+                    cfg.save()
+                    _kill(net.node_procs[i])
+                    net.node_procs[i] = _spawn_node(net.homes[i])
+                elif p == "rpc-flood":
+                    # respawn with a 1-slot WRITE budget, then flood
+                    # concurrent broadcast_tx_commit calls — the route
+                    # that holds its slot across a whole commit wait, so
+                    # the budget genuinely exhausts (fast read handlers
+                    # finish within one event-loop step and never pile
+                    # up). Excess requests must shed with the unified
+                    # -32005 envelope (plane "rpc" + retry hint) while
+                    # the exempt control plane keeps answering — an
+                    # operator must always be able to ask a saturated
+                    # node how saturated it is
+                    log(f"[{manifest.name}] rpc-flood {name}")
+                    from cometbft_tpu.config import Config
+
+                    cfg = Config.load(net.homes[i])
+                    orig_guard = (cfg.rpc.overload_write_inflight,
+                                  cfg.rpc.overload_queue_timeout)
+                    cfg.rpc.overload_write_inflight = 1
+                    cfg.rpc.overload_queue_timeout = 0.01
+                    cfg.save()
+                    _kill(net.node_procs[i])
+                    net.node_procs[i] = _spawn_node(net.homes[i])
+                    _wait(lambda: _height(net, i) >= 1, 150,
+                          f"{name} serving with a 1-slot write budget")
+
+                    def _flood_write(_j, _i=i, _nm=name):
+                        tx = urllib.parse.quote(f'"flood-{_nm}-{_j:03d}"')
+                        try:
+                            return _rpc(
+                                net, _i, f"broadcast_tx_commit?tx={tx}",
+                                timeout=30.0)
+                        except Exception:  # noqa: BLE001 - counted below
+                            return {}
+
+                    health_ok = False
+                    with concurrent.futures.ThreadPoolExecutor(
+                            max_workers=24) as tp:
+                        futs = [tp.submit(_flood_write, j)
+                                for j in range(120)]
+                        while not all(f.done() for f in futs):
+                            try:
+                                doc = _rpc(net, i, "health", timeout=10.0)
+                                health_ok = health_ok or "result" in doc
+                            except Exception:  # noqa: BLE001
+                                pass
+                            time.sleep(0.02)
+                        docs = [f.result() for f in futs]
+                    sheds = 0
+                    for doc in docs:
+                        err = doc.get("error") or {}
+                        if err.get("code") != -32005:
+                            continue
+                        data = err.get("data") or {}
+                        if (data.get("plane") != "rpc"
+                                or "retry_after_ms" not in data):
+                            raise RunError(
+                                f"rpc-flood on {name}: malformed shed "
+                                f"envelope {err}")
+                        sheds += 1
+                    if sheds < 1:
+                        raise RunError(
+                            f"rpc-flood on {name}: no -32005 sheds out of "
+                            f"{len(docs)} concurrent commit-waits on a "
+                            f"1-slot budget")
+                    if not health_ok:
+                        raise RunError(
+                            f"rpc-flood on {name}: exempt health route "
+                            f"failed during the flood")
+                    if _metric_value(
+                            _metrics_text(net, i, timeout=5.0),
+                            'cometbft_overload_sheds_total{plane="rpc"}') < 1:
+                        raise RunError(
+                            f"rpc-flood on {name}: sheds not recorded on "
+                            f"/metrics with the rpc plane label")
+                    cfg = Config.load(net.homes[i])
+                    (cfg.rpc.overload_write_inflight,
+                     cfg.rpc.overload_queue_timeout) = orig_guard
+                    cfg.save()
                     _kill(net.node_procs[i])
                     net.node_procs[i] = _spawn_node(net.homes[i])
                 elif p in ("byzantine", "flood"):

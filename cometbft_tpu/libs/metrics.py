@@ -632,6 +632,31 @@ class LightFleetMetrics:
             labels=("reason",))
 
 
+class OverloadMetrics:
+    """Overload resilience plane observability (libs/overload.py — no
+    reference analog): per-plane watermark levels and shed accounting.
+    Process-global like SchedMetrics — the registry instances are
+    per-node but the series are shared, labeled by plane (in-proc test
+    nets aggregate, exactly like the scheduler's queue-depth series)."""
+
+    def __init__(self, reg: Registry):
+        self.level = reg.gauge(
+            "overload", "level",
+            "Watermark level per plane (0=normal 1=elevated 2=saturated)",
+            labels=("plane",))
+        self.sheds = reg.counter(
+            "overload", "sheds_total",
+            "Requests/txs shed by the coordinated overload policy, per "
+            "plane (rpc = in-flight budget, mempool = admission gate, "
+            "sched = verify-queue backpressure, events = subscriber "
+            "lag)", labels=("plane",))
+        self.transitions = reg.counter(
+            "overload", "level_transitions_total",
+            "Watermark level transitions per plane (a flapping signal "
+            "here means the hysteresis band is too narrow)",
+            labels=("plane",))
+
+
 _global: Optional[Registry] = None
 
 
@@ -830,3 +855,17 @@ def storage_metrics() -> StorageMetrics:
             if _storage is None:
                 _storage = StorageMetrics(global_registry())
     return _storage
+
+
+_overload: Optional[OverloadMetrics] = None
+
+
+def overload_metrics() -> OverloadMetrics:
+    """Process-global OverloadMetrics on the global registry (same
+    double-checked init discipline as crypto_metrics)."""
+    global _overload
+    if _overload is None:
+        with _crypto_lock:
+            if _overload is None:
+                _overload = OverloadMetrics(global_registry())
+    return _overload

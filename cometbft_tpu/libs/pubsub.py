@@ -237,6 +237,19 @@ class Server:
     def num_clients(self) -> int:
         return len(self._subs)
 
+    def max_lag_fraction(self) -> float:
+        """The events plane's overload signal (libs/overload.py): the
+        worst subscriber's queue fill fraction. Unbounded subscribers
+        (capacity 0, e.g. the indexer) can't lag by this definition —
+        they never drop — so they read 0."""
+        worst = 0.0
+        for by_q in self._subs.values():
+            for sub in by_q.values():
+                cap = sub.out.maxsize
+                if cap > 0:
+                    worst = max(worst, sub.out.qsize() / cap)
+        return worst
+
     def num_client_subscriptions(self, client_id: str) -> int:
         return len(self._subs.get(client_id, {}))
 

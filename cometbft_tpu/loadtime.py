@@ -130,6 +130,90 @@ async def generate_load(
     return experiment_id, res
 
 
+async def generate_saturation(
+    submit,
+    waves: int,
+    wave_size: int,
+    size: int = 256,
+    experiment_id: str = "",
+    interval: float = 0.0,
+    rate_hint: float = 0.0,
+    max_inflight: int = 0,
+) -> tuple[str, LoadResult]:
+    """Saturation-wave generator for the overload plane: where
+    generate_load paces to a target rate, each wave here fires
+    `wave_size` submissions CONCURRENTLY and waits them all out — the
+    point is to exceed the admission ceiling, not to hold a rate. The
+    `submit` callable (async, tx -> bool) abstracts the path: the
+    in-proc soak harness hands mempool.check_tx, e2e hands
+    rpc_submitter(). True = accepted, False = shed/rejected,
+    raise = transport error.
+
+    `max_inflight` bounds CONCURRENT submissions (0 = unbounded). The
+    in-proc soak must set this: it calls mempool.check_tx directly,
+    bypassing the RPC server's in-flight budget, and an unbounded wave
+    of thousands of tasks on the shared event loop starves the very
+    consensus coroutines the soak is grading — a failure mode the RPC
+    guard makes impossible over the wire. Mirror the write budget
+    (rpc config overload_write_inflight) here."""
+    experiment_id = experiment_id or secrets.token_hex(8)
+    res = LoadResult()
+    seq = 0
+    sem = asyncio.Semaphore(max_inflight) if max_inflight > 0 else None
+
+    async def one(tx: bytes) -> None:
+        try:
+            if await submit(tx):
+                res.accepted += 1
+            else:
+                res.rejected += 1
+        except Exception:  # noqa: BLE001 - transport hiccups count as errors
+            res.errors += 1
+        finally:
+            if sem is not None:
+                sem.release()
+
+    for _ in range(waves):
+        tasks = []
+        for _ in range(wave_size):
+            tx = make_tx(experiment_id, seq, size, rate_hint, 1)
+            seq += 1
+            res.sent += 1
+            if sem is not None:
+                await sem.acquire()
+            tasks.append(asyncio.create_task(one(tx)))
+        await asyncio.gather(*tasks)
+        if interval > 0:
+            await asyncio.sleep(interval)
+    return experiment_id, res
+
+
+def rpc_submitter(endpoint: str, method: str = "broadcast_tx_sync"):
+    """An HTTP `submit` callable for generate_saturation: POST one tx,
+    classify any JSON-RPC error (the unified -32005 overload shed
+    included) as a rejection, transport failures raise (counted as
+    errors by the generator)."""
+
+    def post(tx: bytes) -> bool:
+        body = json.dumps({
+            "jsonrpc": "2.0", "id": 1, "method": method,
+            "params": {"tx": base64.b64encode(tx).decode()},
+        }).encode()
+        req = urllib.request.Request(
+            endpoint + "/", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            doc = json.load(r)
+        if "error" in doc:
+            return False
+        return int(doc["result"].get("code", 0)) == 0
+
+    async def submit(tx: bytes) -> bool:
+        return await asyncio.to_thread(post, tx)
+
+    return submit
+
+
 # ---------------------------------------------------------------- report
 
 

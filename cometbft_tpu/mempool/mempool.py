@@ -28,7 +28,18 @@ class ErrTxInCache(Exception):
 
 
 class ErrMempoolIsFull(Exception):
-    pass
+    """Admission shed: the pool (or the plane behind it) cannot absorb
+    the tx right now. `plane` names WHICH pressure shed it ("mempool" =
+    pool caps / saturated watermark, "sched" = verify-scheduler
+    backpressure) so the RPC surface can serve the unified -32005 wire
+    shape; `retry_after_ms` is the overload registry's hint when one
+    was attached."""
+
+    def __init__(self, *args, plane: str = "mempool",
+                 retry_after_ms: int = 0):
+        super().__init__(*args)
+        self.plane = plane
+        self.retry_after_ms = retry_after_ms
 
 
 class ErrTxTooLarge(Exception):
@@ -97,12 +108,20 @@ class MempoolConfig:
     # mempool class BEFORE the ABCI round-trip — concurrent admissions
     # coalesce into one device batch or ride a consensus flush as filler
     tx_verify: str = ""
+    # post-commit recheck storms are bounded into windows of this many
+    # txs, yielding the event loop between windows so admission and
+    # consensus are never starved by one monolithic sweep after a big
+    # block (the overload plane's pressure ladder); 0 = the reference's
+    # single-sweep behavior
+    recheck_window: int = 512
 
     def validate_basic(self) -> None:
         if self.tx_verify not in ("", "ed25519"):
             raise ValueError(f"unknown mempool tx_verify {self.tx_verify!r}")
         if self.size < 0 or self.max_txs_bytes < 0 or self.cache_size < 0:
             raise ValueError("mempool sizes cannot be negative")
+        if self.recheck_window < 0:
+            raise ValueError("recheck_window cannot be negative")
 
 
 class CListMempool:
@@ -123,10 +142,33 @@ class CListMempool:
         self._tx_available = asyncio.Event()
         self.notify_available = True
         self.metrics = None  # libs.metrics.MempoolMetrics | None (node wires it)
+        # overload resilience plane (libs/overload.py; node wires it via
+        # attach_overload): saturated watermark sheds CheckTx BEFORE the
+        # ABCI round-trip, elevated triggers eager expiry + gossip
+        # throttling. None = the pre-overload ad-hoc behavior.
+        self.overload = None
+        # pressure-ladder accounting (assertion surface for the soak)
+        self.recheck_windows_last = 0
+        self.recheck_windows_total = 0
+        self.eager_expired = 0
         # in-flight CheckTx dedup: tx hash -> future of the FIRST
         # submission's result; concurrent duplicates await it instead of
         # paying a second ABCI round-trip (or racing the cache)
         self._inflight: dict[bytes, asyncio.Future] = {}
+
+    def attach_overload(self, registry) -> None:
+        """Wire the node's overload registry: registers this pool's
+        utilization signal and enables the pressure ladder."""
+        self.overload = registry
+        registry.register("mempool", self._overload_utilization)
+
+    def _overload_utilization(self) -> float:
+        """Pool pressure as a fraction of capacity (txs or bytes,
+        whichever is tighter)."""
+        return max(
+            len(self._txs) / max(1, self.config.size),
+            self._txs_bytes / max(1, self.config.max_txs_bytes),
+        )
 
     def _update_metrics(self) -> None:
         if self.metrics is not None:
@@ -162,9 +204,27 @@ class CListMempool:
         if len(tx) > self.config.max_tx_bytes:
             raise ErrTxTooLarge(f"tx size {len(tx)} > max {self.config.max_tx_bytes}")
         if self.is_full(len(tx)):
+            if self.overload is not None:
+                self.overload.shed("mempool")
             raise ErrMempoolIsFull(
                 f"{len(self._txs)} txs, {self._txs_bytes} bytes"
             )
+        if self.overload is not None:
+            # the pressure ladder's saturated rung: shed NEW work at the
+            # door while the pool is at its high watermark — before the
+            # tx buys a signature batch or an ABCI round-trip. Duplicates
+            # of in-flight/pooled txs still resolve below (they cost
+            # nothing and the submitter learns the first result).
+            from cometbft_tpu.libs import overload as _ovl
+
+            if (self.overload.level("mempool") >= _ovl.SATURATED
+                    and tx_hash(tx) not in self._inflight):
+                self.overload.shed("mempool")
+                raise ErrMempoolIsFull(
+                    f"mempool saturated ({len(self._txs)}/"
+                    f"{self.config.size} txs)",
+                    retry_after_ms=self.overload.retry_after_ms("mempool"),
+                )
         h = tx_hash(tx)
         first = self._inflight.get(h)
         if first is not None:
@@ -264,8 +324,13 @@ class CListMempool:
             except sched.SchedulerSaturated as e:
                 admit_sp.set(outcome="saturated")
                 self.cache.remove(tx)
+                retry = 0
+                if self.overload is not None:
+                    self.overload.shed("sched")
+                    retry = self.overload.retry_after_ms("sched")
                 raise ErrMempoolIsFull(
-                    f"verify scheduler saturated: {e}") from e
+                    f"verify scheduler saturated: {e}",
+                    plane="sched", retry_after_ms=retry) from e
             # bounded wait: the scheduler resolves within its deadline
             # plus, worst case, one device-watchdog window (hang ->
             # supervisor -> host oracle). A timeout here means something
@@ -343,6 +408,11 @@ class CListMempool:
             mtx = self._txs.pop(tx_hash(tx), None)
             if mtx is not None:
                 self._txs_bytes -= len(mtx.tx)
+        if self.overload is not None:
+            from cometbft_tpu.libs import overload as _ovl
+
+            if self.overload.level("mempool") >= _ovl.ELEVATED:
+                self._eager_expire()
         if self.config.recheck and self._txs:
             if self.metrics is not None:
                 self.metrics.recheck_times.inc()
@@ -351,18 +421,64 @@ class CListMempool:
             self._tx_available.clear()
         self._update_metrics()
 
+    def _eager_expire(self) -> None:
+        """The pressure ladder's elevated rung: TTL-style expiry of the
+        OLDEST queued txs (longest-waiting = most likely stale against
+        post-block state, and the bulk of the next recheck storm) until
+        the pool is back under the elevated watermark's hysteresis
+        floor. Expired txs leave the cache so a submitter that still
+        wants one can resubmit once pressure clears."""
+        target = max(
+            1, int(self.config.size
+                   * (self.overload.elevated - self.overload.hysteresis)))
+        expired = 0
+        while len(self._txs) > target:
+            h, mtx = next(iter(self._txs.items()))
+            self._txs.pop(h, None)
+            self._txs_bytes -= len(mtx.tx)
+            self.cache.remove(mtx.tx)
+            expired += 1
+        if expired:
+            self.eager_expired += expired
+            self.overload.shed("mempool", expired)
+
     async def _recheck_txs(self) -> None:
         """Re-validate remaining txs against post-block state
-        (clist_mempool.go recheckTxs)."""
-        for h, mtx in list(self._txs.items()):
-            res = await self.app_conn.check_tx(
-                abci.RequestCheckTx(tx=mtx.tx, type_=abci.CheckTxType.RECHECK)
-            )
-            if not res.is_ok():
-                self._txs.pop(h, None)
-                self._txs_bytes -= len(mtx.tx)
-                if not self.config.keep_invalid_txs_in_cache:
-                    self.cache.remove(mtx.tx)
+        (clist_mempool.go recheckTxs) — in bounded windows of
+        config.recheck_window txs, yielding the event loop between
+        windows so a post-big-block recheck storm never starves
+        admission or consensus (each window is roughly one scheduler
+        batch budget of app round-trips)."""
+        items = list(self._txs.items())
+        window = self.config.recheck_window or len(items) or 1
+        self.recheck_windows_last = 0
+        for start in range(0, len(items), window):
+            self.recheck_windows_last += 1
+            self.recheck_windows_total += 1
+            batch = [(h, mtx) for h, mtx in items[start:start + window]
+                     if h in self._txs]  # expired/committed mid-storm
+            # the window's re-checks fly CONCURRENTLY — the reference
+            # fires every recheck request without awaiting responses
+            # one-by-one (clist_mempool.go recheckTxs), and a sequential
+            # sweep here costs one event-loop round-trip per tx: under
+            # admission load that stretches finalize past the rest of
+            # the net's next round, which is exactly the liveness hole
+            # the overload plane exists to close
+            results = await asyncio.gather(*(
+                self.app_conn.check_tx(
+                    abci.RequestCheckTx(tx=mtx.tx,
+                                        type_=abci.CheckTxType.RECHECK))
+                for _, mtx in batch))
+            for (h, mtx), res in zip(batch, results):
+                if not res.is_ok() and h in self._txs:
+                    self._txs.pop(h, None)
+                    self._txs_bytes -= len(mtx.tx)
+                    if not self.config.keep_invalid_txs_in_cache:
+                        self.cache.remove(mtx.tx)
+            if start + window < len(items):
+                # yield: queued admissions and consensus work interleave
+                # between windows instead of waiting out the whole sweep
+                await asyncio.sleep(0)
 
     async def flush(self) -> None:
         """Drop everything (RPC unsafe_flush_mempool)."""

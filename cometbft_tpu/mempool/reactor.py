@@ -77,16 +77,38 @@ class MempoolReactor(Reactor):
             except Exception as err:  # noqa: BLE001
                 self.logger.info("checktx from peer failed", err=str(err))
 
+    def _gossip_budget(self) -> tuple[int, float]:
+        """(batch cap, idle sleep) under the overload policy: at the
+        elevated/saturated watermarks gossip is the first optional work
+        to shrink — smaller batches, longer pauses — so admission and
+        consensus keep their share of the loop."""
+        reg = getattr(self.mempool, "overload", None)
+        if reg is None:
+            return 64, 0.05
+        from cometbft_tpu.libs import overload as _ovl
+
+        lvl = reg.level("mempool")
+        if lvl >= _ovl.SATURATED:
+            return 8, 0.25
+        if lvl >= _ovl.ELEVATED:
+            return 16, 0.1
+        return 64, 0.05
+
     async def _broadcast_tx_routine(self, peer) -> None:
         """reactor.go:210: walk txs in seq order; echo suppression by
         sender; batch a few per message. last_seq only advances once the
         batch is actually delivered (the reference blocks in Send until
-        success) so a full/slow channel never drops txs for this peer."""
+        success) so a full/slow channel never drops txs for this peer.
+        A peer whose channel refuses the batch is signaling ITS
+        saturation — the retry backoff doubles per consecutive refusal
+        (capped) instead of hammering a drowning peer at a fixed 50 ms."""
         last_seq = 0
+        peer_backoff = 0.05
         try:
             while peer.is_running:
                 batch = []
                 batch_last_seq = last_seq
+                batch_cap, idle = self._gossip_budget()
                 for mtx in self.mempool.iter_txs():
                     if mtx.seq <= last_seq:
                         continue
@@ -94,16 +116,20 @@ class MempoolReactor(Reactor):
                     if mtx.sender == peer.id:
                         continue  # don't echo a tx to where it came from
                     batch.append(mtx.tx)
-                    if len(batch) >= 64:
+                    if len(batch) >= batch_cap:
                         break
                 if batch:
                     if await peer.send(MEMPOOL_CHANNEL, encode_txs(batch)):
                         last_seq = batch_last_seq
+                        peer_backoff = 0.05
                     else:
-                        await asyncio.sleep(0.05)  # retry the same batch
+                        # retry the same batch, backing off toward a
+                        # saturated peer
+                        await asyncio.sleep(peer_backoff)
+                        peer_backoff = min(peer_backoff * 2, 0.8)
                 else:
                     last_seq = batch_last_seq  # only sender-suppressed txs
-                    await asyncio.sleep(0.05)
+                    await asyncio.sleep(idle)
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001

@@ -292,6 +292,23 @@ class Node(BaseService):
         self.mempool.metrics = self.mempool_metrics
         self.evidence_pool.metrics = self.evidence_metrics
 
+        # ---- overload plane (libs/overload.py, no reference analog):
+        # one per-node pressure registry every plane grades itself
+        # against. Signals registered here read state that already
+        # exists; the RPC server adds its own on start.
+        from cometbft_tpu.libs.overload import OverloadRegistry
+
+        self.overload = OverloadRegistry()
+        self.mempool.attach_overload(self.overload)
+        from cometbft_tpu import sched as _sched_mod
+
+        self.overload.register(
+            "sched",
+            lambda: (sum(_sched_mod.get()._depth.values())
+                     / max(1, _sched_mod.get().queue_limit)))
+        self.overload.register(
+            "events", self.event_bus.server.max_lag_fraction)
+
         # background pruning honoring app/companion retain heights
         # (node.go:263-524 createPruner; state/pruner.go)
         from cometbft_tpu.state.pruner import Pruner

@@ -51,9 +51,36 @@ def _hex(b: bytes) -> str:
 
 
 class RPCError(Exception):
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str, data: dict | None = None):
         super().__init__(message)
         self.code = code
+        # machine-readable error detail (JSON-RPC 2.0 `error.data`): the
+        # overload plane rides here — every -32005 shed carries
+        # {"plane": ..., "retry_after_ms": ...} so clients back off
+        # without parsing message text
+        self.data = data
+
+
+def _int_param(value, name: str) -> int:
+    """Parse a client-supplied integer param: malformed input is the
+    CLIENT's error (-32602 invalid params), never -32603 internal."""
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise RPCError(
+            -32602, f"bad {name} param (want int): {value!r}") from None
+
+
+def _hex_param(value, name: str) -> bytes:
+    """Parse a client-supplied hex param the same way: -32602, not a
+    raw ValueError surfacing as -32603."""
+    if isinstance(value, str) and value[:2] in ("0x", "0X"):
+        value = value[2:]
+    try:
+        return bytes.fromhex(value)
+    except (TypeError, ValueError):
+        raise RPCError(
+            -32602, f"bad {name} param (want hex): {value!r}") from None
 
 
 class QuotedStr(str):
@@ -70,9 +97,11 @@ class UriStr(str):
     hex are never misdecoded."""
 
 
-def _ws_err(rid, code: int, message: str) -> dict:
-    return {"jsonrpc": "2.0", "id": rid,
-            "error": {"code": code, "message": message}}
+def _ws_err(rid, code: int, message: str, data: dict | None = None) -> dict:
+    err: dict = {"code": code, "message": message}
+    if data is not None:
+        err["data"] = data
+    return {"jsonrpc": "2.0", "id": rid, "error": err}
 
 
 class Environment:
@@ -88,6 +117,24 @@ class Environment:
         self._fleet_lock = None  # created on the serving loop
         self._fleet_head_sub = None  # NewBlock subscription feeding it
 
+    def _shed_data(self, plane: str, retry_after_ms: int | None = None,
+                   record: bool = False) -> dict:
+        """Build the unified -32005 `error.data` payload; with `record`,
+        also account the shed on the overload registry (every shed lands
+        on /metrics with its plane label). `record=False` is for errors
+        whose subsystem already counted itself (ErrMempoolIsFull)."""
+        from cometbft_tpu.libs import overload as _ovl
+
+        reg = getattr(self.node, "overload", None)
+        if reg is not None:
+            if record:
+                reg.shed(plane)
+            if not retry_after_ms:
+                retry_after_ms = reg.retry_after_ms(plane)
+        if not retry_after_ms:
+            retry_after_ms = _ovl.RETRY_AFTER_MS[_ovl.SATURATED]
+        return {"plane": plane, "retry_after_ms": retry_after_ms}
+
     # ------------------------------------------------------------- info
 
     async def health(self, _params: dict) -> dict:
@@ -97,7 +144,14 @@ class Environment:
         cs = getattr(self.node, "consensus_state", None)
         if cs is not None and getattr(cs, "failed", False):
             raise RPCError(-32603, "consensus failure: receive routine dead")
-        return {}
+        out: dict = {}
+        # overload plane snapshot (libs/overload.py): per-plane watermark
+        # level, utilization, and shed counts — saturated-but-alive is a
+        # state operators page on, so it rides the liveness probe
+        reg = getattr(self.node, "overload", None)
+        if reg is not None:
+            out["overload"] = reg.health()
+        return out
 
     async def crypto_health(self, _params: dict) -> dict:
         """The device-fault resilience snapshot (no reference analog):
@@ -260,7 +314,7 @@ class Environment:
         h = params.get("height")
         if h is None or h == "":
             return default
-        h = int(h)
+        h = _int_param(h, "height")
         base, top = self.node.block_store.base(), self.node.block_store.height()
         if h < base or h > top:
             raise RPCError(-32603, f"height {h} is not available (range {base}-{top})")
@@ -317,7 +371,7 @@ class Environment:
         }
 
     async def block_by_hash(self, params: dict) -> dict:
-        h = bytes.fromhex(params["hash"])
+        h = _hex_param(params.get("hash"), "hash")
         block = self.node.block_store.load_block_by_hash(h)
         if block is None:
             raise RPCError(-32603, "block not found")
@@ -327,8 +381,10 @@ class Environment:
         """rpc/core/blocks.go BlockchainInfo: metas for a height range."""
         top = self.node.block_store.height()
         base = self.node.block_store.base()
-        max_h = min(int(params.get("maxHeight") or top), top)
-        min_h = max(int(params.get("minHeight") or max(base, max_h - 19)), base)
+        max_h = min(_int_param(params.get("maxHeight") or top, "maxHeight"),
+                    top)
+        min_h = max(_int_param(params.get("minHeight")
+                               or max(base, max_h - 19), "minHeight"), base)
         metas = []
         for h in range(max_h, min_h - 1, -1):
             m = self.node.block_store.load_block_meta(h)
@@ -359,7 +415,7 @@ class Environment:
 
     async def header_by_hash(self, params: dict) -> dict:
         """rpc/core/blocks.go:205 HeaderByHash."""
-        h = bytes.fromhex(params["hash"])
+        h = _hex_param(params.get("hash"), "hash")
         block = self.node.block_store.load_block_by_hash(h)
         if block is None:
             raise RPCError(-32603, "header not found")
@@ -398,7 +454,7 @@ class Environment:
         if h in (None, ""):
             height = top + 1
         else:
-            height = int(h)
+            height = _int_param(h, "height")
             base = self.node.block_store.base()
             if height < base or height > top + 1:
                 raise RPCError(
@@ -473,7 +529,7 @@ class Environment:
         chunks = self._genesis_chunks()
         if not chunks:
             raise RPCError(-32603, "genesis chunks are not initialized")
-        cid = int(params.get("chunk") or 0)
+        cid = _int_param(params.get("chunk") or 0, "chunk")
         if cid < 0 or cid >= len(chunks):
             raise RPCError(
                 -32602,
@@ -688,7 +744,8 @@ class Environment:
         try:
             lb = await fleet.verify_height(height, pin_bytes)
         except FleetSaturated as e:
-            raise RPCError(-32005, str(e)) from e
+            raise RPCError(-32005, str(e),
+                           data=self._shed_data("light", record=True)) from e
         except LightClientError as e:
             raise RPCError(-32001, f"light verification failed: {e}") from e
         # counters() not health(): the response's accounting block must
@@ -716,10 +773,17 @@ class Environment:
             await send_json(_ws_err(rid, e.code, str(e)))
             return
         try:
-            sub = fleet.subscribe(
-                client_id, int(params.get("from_height") or 0))
+            from_height = _int_param(params.get("from_height") or 0,
+                                     "from_height")
+        except RPCError as e:
+            await send_json(_ws_err(rid, e.code, str(e)))
+            return
+        try:
+            sub = fleet.subscribe(client_id, from_height)
         except FleetSaturated as e:
-            await send_json(_ws_err(rid, -32005, str(e)))
+            await send_json(_ws_err(rid, -32005, str(e),
+                                    data=self._shed_data("light",
+                                                         record=True)))
             return
         tasks.spawn(self._pump_light(sub, rid, send_json),
                     name=f"light-sub-{client_id}")
@@ -781,7 +845,7 @@ class Environment:
         explicit height up to store-top+1 is valid."""
         height = None
         if params.get("height"):
-            height = int(params["height"])
+            height = _int_param(params["height"], "height")
             base, top = self.node.block_store.base(), self.node.block_store.height()
             if height < base or height > top + 1:
                 raise RPCError(
@@ -831,9 +895,9 @@ class Environment:
     async def abci_query(self, params: dict) -> dict:
         data = params.get("data", "")
         req = abci.RequestQuery(
-            data=bytes.fromhex(data) if data else b"",
+            data=_hex_param(data, "data") if data else b"",
             path=params.get("path", ""),
-            height=int(params.get("height") or 0),
+            height=_int_param(params.get("height") or 0, "height"),
             prove=bool(params.get("prove", False)),
         )
         res = await self.node.proxy_app.query.query(req)
@@ -852,8 +916,13 @@ class Environment:
         if isinstance(tx, QuotedStr):
             return tx.encode()  # URI string literal: raw bytes
         if isinstance(tx, UriStr):
-            return bytes.fromhex(tx[2:] if tx[:2] in ("0x", "0X") else tx)
-        return base64.b64decode(tx, validate=True)  # JSON body: proto3 base64
+            return _hex_param(tx, "tx")
+        try:
+            # JSON body: proto3 base64
+            return base64.b64decode(tx, validate=True)
+        except (TypeError, ValueError):
+            raise RPCError(
+                -32602, "bad tx param (want base64)") from None
 
     async def broadcast_tx_async(self, params: dict) -> dict:
         """rpc/core/mempool.go:27: fire and forget."""
@@ -875,15 +944,37 @@ class Environment:
             pass
 
     async def broadcast_tx_sync(self, params: dict) -> dict:
-        """rpc/core/mempool.go:48: wait for CheckTx."""
-        tx = self._tx_param(params)
-        from cometbft_tpu.mempool.mempool import ErrTxInCache, tx_hash
+        """rpc/core/mempool.go:48: wait for CheckTx — except under
+        mempool pressure, where holding the connection open across the
+        ABCI round-trip is exactly the work to shed: at the elevated
+        watermark the route downgrades to fire-and-forget (async
+        semantics, `"deferred": true` in the result) so admission keeps
+        flowing without a sync caller's latency tail."""
+        import asyncio
 
+        tx = self._tx_param(params)
+        from cometbft_tpu.libs import overload as _ovl
+        from cometbft_tpu.mempool.mempool import (ErrMempoolIsFull,
+                                                  ErrTxInCache, tx_hash)
+
+        reg = getattr(self.node, "overload", None)
+        if reg is not None and reg.level("mempool") >= _ovl.ELEVATED:
+            task = asyncio.get_running_loop().create_task(
+                self._checktx_quiet(tx))
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
+            return {"code": 0, "data": "",
+                    "log": "mempool pressure: sync downgraded to async",
+                    "deferred": True, "hash": _hex(tx_hash(tx))}
         try:
             res = await self.node.mempool.check_tx(tx)
         except ErrTxInCache:
             return {"code": 0, "data": "", "log": "tx already in cache",
                     "hash": _hex(tx_hash(tx))}
+        except ErrMempoolIsFull as e:
+            raise RPCError(
+                -32005, str(e),
+                data=self._shed_data(e.plane, e.retry_after_ms)) from e
         except Exception as e:  # noqa: BLE001
             raise RPCError(-32603, f"tx rejected: {e}") from e
         return {"code": res.code, "data": _b64(res.data), "log": res.log,
@@ -896,7 +987,8 @@ class Environment:
         import asyncio
 
         from cometbft_tpu.abci import codec as abci_codec
-        from cometbft_tpu.mempool.mempool import ErrTxInCache, tx_hash
+        from cometbft_tpu.mempool.mempool import (ErrMempoolIsFull,
+                                                  ErrTxInCache, tx_hash)
         from cometbft_tpu.types import event_bus as eb
 
         tx = self._tx_param(params)
@@ -910,6 +1002,10 @@ class Environment:
                 check = await self.node.mempool.check_tx(tx)
             except ErrTxInCache:
                 raise RPCError(-32603, "tx already exists in cache") from None
+            except ErrMempoolIsFull as e:
+                raise RPCError(
+                    -32005, str(e),
+                    data=self._shed_data(e.plane, e.retry_after_ms)) from e
             except Exception as e:  # noqa: BLE001
                 raise RPCError(-32603, f"error on broadcastTxCommit: {e}") from e
             check_dict = {"code": check.code, "data": _b64(check.data),
@@ -946,7 +1042,7 @@ class Environment:
         from cometbft_tpu.abci import codec as abci_codec
 
         h = params.get("hash", "")
-        raw = bytes.fromhex(h) if isinstance(h, str) else h
+        raw = _hex_param(h, "hash") if isinstance(h, str) else h
         res = self.node.tx_indexer.get(raw)
         if res is None:
             raise RPCError(-32603, f"tx ({h}) not found")
@@ -963,7 +1059,7 @@ class Environment:
         query = params.get("query", "")
         if not query:
             raise RPCError(-32602, "missing query param")
-        limit = int(params.get("per_page") or 30)
+        limit = _int_param(params.get("per_page") or 30, "per_page")
         try:
             results = self.node.tx_indexer.search(query, limit=limit)
         except Exception as e:  # noqa: BLE001
@@ -987,7 +1083,8 @@ class Environment:
             raise RPCError(-32603, "block indexing disabled")
         try:
             heights = self.node.block_indexer.search(
-                query, limit=int(params.get("per_page") or 30))
+                query, limit=_int_param(params.get("per_page") or 30,
+                                        "per_page"))
         except Exception as e:  # noqa: BLE001
             raise RPCError(-32602, f"bad query: {e}") from e
         blocks = []
@@ -999,7 +1096,7 @@ class Environment:
         return {"blocks": blocks, "total_count": str(len(blocks))}
 
     async def unconfirmed_txs(self, params: dict) -> dict:
-        limit = int(params.get("limit") or 30)
+        limit = _int_param(params.get("limit") or 30, "limit")
         txs = self.node.mempool.reap_max_txs(limit)
         return {
             "n_txs": str(len(txs)),
@@ -1020,7 +1117,8 @@ class Environment:
     async def broadcast_evidence(self, params: dict) -> dict:
         from cometbft_tpu.types.evidence import evidence_list_from_proto
 
-        evs = evidence_list_from_proto(bytes.fromhex(params["evidence"]))
+        evs = evidence_list_from_proto(
+            _hex_param(params.get("evidence"), "evidence"))
         for ev in evs:
             self.node.evidence_pool.add_evidence(ev)
         return {"hash": _hex(evs[0].hash()) if evs else ""}
@@ -1076,8 +1174,8 @@ class Environment:
         from cometbft_tpu.consensus import timeline
         from cometbft_tpu.libs import linkmodel
 
-        min_height = int(params.get("min_height", 0) or 0)
-        limit = int(params.get("limit", 0) or 0)
+        min_height = _int_param(params.get("min_height", 0) or 0, "min_height")
+        limit = _int_param(params.get("limit", 0) or 0, "limit")
         cs = getattr(self.node, "consensus_state", None)
         rec = getattr(cs, "timeline", None)
         node_key = getattr(self.node, "node_key", None)
@@ -1113,7 +1211,8 @@ class Environment:
         }
         h = params.get("height")
         if h is not None:
-            bundle = rec.postmortem(int(h)) if rec is not None else None
+            bundle = (rec.postmortem(_int_param(h, "height"))
+                      if rec is not None else None)
             if bundle is None:
                 raise RPCError(
                     -32603, f"no postmortem captured for height {h}")

@@ -28,6 +28,19 @@ class _RawText:
 
 MAX_BODY = 1_000_000
 MAX_HEADERS = 64
+
+# Overload route classes (libs/overload.py): write routes inject work
+# into the node (mempool, evidence) and get the smaller budget; reads
+# only serve existing state. Control/ops routes are exempt — an
+# operator must be able to ask a saturated node how saturated it is.
+WRITE_ROUTES = frozenset({
+    "broadcast_tx_async", "broadcast_tx_sync", "broadcast_tx_commit",
+    "broadcast_evidence", "check_tx",
+})
+EXEMPT_ROUTES = frozenset({
+    "health", "status", "crypto_health", "storage_health", "net_info",
+    "net_telemetry", "dial_seeds", "dial_peers",
+})
 WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"  # RFC 6455 §1.3
 WS_MAX_FRAME = 1 << 20
 WS_MAX_MESSAGE = 1 << 21  # aggregate cap across fragments (HTTP has MAX_BODY)
@@ -61,6 +74,16 @@ class RPCServer(BaseService):
         self.routes = self.env.routes()
         self._server: asyncio.Server | None = None
         self.bound_addr = ""
+        # overload guard: bounded per-route-class in-flight budgets with
+        # a short queue deadline, then shed (-32005 + retry hint). All
+        # single-event-loop state — no lock needed.
+        self._budgets = {
+            "read": getattr(config, "overload_read_inflight", 256),
+            "write": getattr(config, "overload_write_inflight", 64),
+        }
+        self._inflight = {"read": 0, "write": 0}
+        self._queue_timeout = getattr(config, "overload_queue_timeout", 0.05)
+        self._write_timeout = getattr(config, "slow_client_timeout", 10.0)
 
     async def on_start(self) -> None:
         addr = self.config.laddr.removeprefix("tcp://")
@@ -70,9 +93,15 @@ class RPCServer(BaseService):
         )
         sock = self._server.sockets[0].getsockname()
         self.bound_addr = f"{sock[0]}:{sock[1]}"
+        reg = getattr(self.node, "overload", None)
+        if reg is not None:
+            reg.register("rpc", self._rpc_utilization)
         self.logger.info("RPC listening", addr=self.bound_addr)
 
     async def on_stop(self) -> None:
+        reg = getattr(self.node, "overload", None)
+        if reg is not None:
+            reg.unregister("rpc")
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -163,6 +192,7 @@ class RPCServer(BaseService):
                     cmtmetrics.netchaos_metrics()  # (net-chaos plane too)
                     cmtmetrics.sched_metrics()     # (verify scheduler)
                     cmtmetrics.light_fleet_metrics()  # (serving plane)
+                    cmtmetrics.overload_metrics()  # (overload plane)
                     body += cmtmetrics.global_registry().render()
                 return 200, _RawText(body)
             if route == "openapi.yaml":
@@ -182,6 +212,56 @@ class RPCServer(BaseService):
             return 200, await self._call_one(envelope)
         return 405, {"error": "method not allowed"}
 
+    # ------------------------------------------------------ overload guard
+
+    @staticmethod
+    def _route_class(method: str) -> str | None:
+        """None = exempt from the overload guard (control plane)."""
+        if method in EXEMPT_ROUTES or method.startswith("unsafe_"):
+            return None
+        return "write" if method in WRITE_ROUTES else "read"
+
+    def _rpc_utilization(self) -> float:
+        """The rpc plane's signal for the overload registry: the most
+        loaded route class's in-flight fraction."""
+        return max(
+            (self._inflight[k] / b
+             for k, b in self._budgets.items() if b > 0),
+            default=0.0)
+
+    async def _admit(self, klass: str) -> bool:
+        """Take an in-flight slot for `klass`, waiting out at most the
+        queue deadline for one to free. False = shed the request."""
+        budget = self._budgets.get(klass, 0)
+        if budget <= 0:  # unguarded class (budget disabled)
+            self._inflight[klass] = self._inflight.get(klass, 0) + 1
+            return True
+        if self._inflight[klass] < budget:
+            self._inflight[klass] += 1
+            return True
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self._queue_timeout
+        while loop.time() < deadline:
+            await asyncio.sleep(0.005)
+            if self._inflight[klass] < budget:
+                self._inflight[klass] += 1
+                return True
+        return False
+
+    def _shed_envelope(self, rid, klass: str) -> dict:
+        from cometbft_tpu.libs import overload as _ovl
+
+        reg = getattr(self.node, "overload", None)
+        retry = _ovl.RETRY_AFTER_MS[_ovl.SATURATED]
+        if reg is not None:
+            reg.shed("rpc")
+            retry = reg.retry_after_ms("rpc") or retry
+        return _err_envelope(
+            rid, -32005,
+            f"rpc overloaded: {klass} budget exhausted "
+            f"({self._budgets[klass]} in flight)",
+            {"plane": "rpc", "retry_after_ms": retry})
+
     async def _call_one(self, req: dict) -> dict:
         rid = req.get("id", -1)
         method = req.get("method", "")
@@ -191,13 +271,20 @@ class RPCServer(BaseService):
         params = req.get("params") or {}
         if not isinstance(params, dict):
             return _err_envelope(rid, -32602, "params must be a map")
+        klass = self._route_class(method)
+        if klass is not None and not await self._admit(klass):
+            return self._shed_envelope(rid, klass)
         try:
             result = await handler(params)
         except RPCError as e:
-            return _err_envelope(rid, e.code, str(e))
+            return _err_envelope(rid, e.code, str(e),
+                                 getattr(e, "data", None))
         except Exception as e:  # noqa: BLE001
             self.logger.error("rpc handler failed", method=method, err=str(e))
             return _err_envelope(rid, -32603, f"internal error: {e}")
+        finally:
+            if klass is not None:
+                self._inflight[klass] -= 1
         return {"jsonrpc": "2.0", "id": rid, "result": result}
 
     async def _respond(self, writer: asyncio.StreamWriter, status: int,
@@ -219,7 +306,14 @@ class RPCServer(BaseService):
             f"Connection: {conn}\r\n\r\n"
         )
         writer.write(head.encode() + body)
-        await writer.drain()
+        # slow-client write timeout: a reader that stops draining must
+        # not pin this handler (and its response buffer) forever — time
+        # the flush out and let the connection-level handler close it
+        try:
+            await asyncio.wait_for(writer.drain(), self._write_timeout)
+        except asyncio.TimeoutError:
+            raise ConnectionError(
+                "slow client: response flush timed out") from None
 
 
     # ---------------------------------------------------------- websocket
@@ -453,5 +547,8 @@ async def _ws_send(writer, payload: bytes, opcode: int = 0x1) -> None:
     await writer.drain()
 
 
-def _err_envelope(rid, code: int, message: str) -> dict:
-    return {"jsonrpc": "2.0", "id": rid, "error": {"code": code, "message": message}}
+def _err_envelope(rid, code: int, message: str, data: dict | None = None) -> dict:
+    err: dict = {"code": code, "message": message}
+    if data is not None:
+        err["data"] = data
+    return {"jsonrpc": "2.0", "id": rid, "error": err}

@@ -199,6 +199,9 @@ class VerifyScheduler:
         # gains are asserted against real load, not synthetic replays
         self.frag_lanes_total = 0
         self.deadline_misses = 0
+        # per-class attribution: the overload soak asserts consensus
+        # flushes miss ZERO deadlines while mempool-class work sheds
+        self.deadline_miss_by_class = {k: 0 for k in CLASSES}
         self.rejected = 0
         self.chaos_fallbacks = 0
         self.worker_flushes = 0
@@ -542,6 +545,7 @@ class VerifyScheduler:
                     del buf[:2048]
                 if g.deadline is not None and now > g.deadline + _MISS_SLACK:
                     misses += 1
+                    self.deadline_miss_by_class[g.klass] += 1
             self.deadline_misses += misses
         m = self._metrics()
         if m is not None:
@@ -836,6 +840,7 @@ class VerifyScheduler:
             "queue_depth": depth,
             "class_rows": dict(self._class_rows),
             "deadline_misses": self.deadline_misses,
+            "deadline_miss_by_class": dict(self.deadline_miss_by_class),
             "rejected": self.rejected,
             "chaos_fallbacks": self.chaos_fallbacks,
             "worker_flushes": self.worker_flushes,

@@ -125,14 +125,16 @@ def fail_on_leaked_asyncio_tasks(request):
 
 
 def pytest_collection_modifyitems(config, items):
-    """`pairing` implies `slow`: the BLS pairing pipeline's cold XLA
-    compile takes minutes, and tier-1 is pinned to -m "not slow" — the
-    marker documents WHY a test is excluded while -m pairing still
-    selects exactly the pairing suite."""
+    """`pairing` and `soak` imply `slow`: the BLS pairing pipeline's
+    cold XLA compile takes minutes and the saturation soaks commit tens
+    of heights under load, and tier-1 is pinned to -m "not slow" — the
+    markers document WHY a test is excluded while -m pairing / -m soak
+    still select exactly those suites."""
     import pytest as _pytest
 
     for item in items:
-        if "pairing" in item.keywords and "slow" not in item.keywords:
+        if (("pairing" in item.keywords or "soak" in item.keywords)
+                and "slow" not in item.keywords):
             item.add_marker(_pytest.mark.slow)
 
 

@@ -300,6 +300,47 @@ class TestDirectionAwareCompare:
         assert bc.compare(rec, rec)["verdict"] == "pass"
         assert bc.compare(worse, rec)["verdict"] == "pass"
 
+    def test_soak_p99_is_enforced_lower_better(self):
+        """Overload-soak sentinel wiring (ISSUE 17): the p99 inter-height
+        gap under saturation regressing UP past 75% fails — both the
+        bare detail key and the soak.-prefixed section key; the same
+        delta as an improvement passes; the commit/admission rates are
+        informational with a stated why (offered-load-shape properties,
+        not code properties)."""
+        old = _record(height_p99_under_load_ms=160.0,
+                      soak_heights_per_s=8.0,
+                      admission_txs_per_s=2700.0,
+                      soak={"height_p99_under_load_ms": 160.0})
+        worse = _record(height_p99_under_load_ms=420.0,
+                        soak_heights_per_s=2.0,
+                        admission_txs_per_s=400.0,
+                        soak={"height_p99_under_load_ms": 420.0})
+        v = bc.compare(old, worse)
+        assert v["verdict"] == "fail"
+        assert "height_p99_under_load_ms" in v["regressions"]
+        assert "soak.height_p99_under_load_ms" in v["regressions"]
+        assert bc.compare(worse, old)["verdict"] == "pass"
+        for name, why in (("soak_heights_per_s", "height_p99_under_load_ms"),
+                          ("admission_txs_per_s", "trend")):
+            row = v["metrics"][name]
+            assert row["verdict"] == "info"
+            assert why in row["why_info"]
+
+    def test_soak_sentinel_self_test_case(self):
+        """--self-test contract on a soak-shaped record: an injected
+        under-load p99 regression is flagged; the identical snapshot and
+        the improvement direction are not."""
+        rec = _record(height_p99_under_load_ms=160.0)
+        worse, metric, pct = bc.inject_regression(
+            rec, metric="height_p99_under_load_ms")
+        assert metric == "height_p99_under_load_ms" and pct > 75.0
+        assert worse["detail"]["height_p99_under_load_ms"] > 160.0
+        caught = bc.compare(rec, worse)
+        assert caught["verdict"] == "fail"
+        assert metric in caught["regressions"]
+        assert bc.compare(rec, rec)["verdict"] == "pass"
+        assert bc.compare(worse, rec)["verdict"] == "pass"
+
     def test_fleet_curve_leaves_are_informational(self):
         """Nested fleet curve values (fleet.curve.<n>.*) flatten into
         dotted names that are NOT tracked — they must report as info,

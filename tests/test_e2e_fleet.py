@@ -106,6 +106,50 @@ class TestFleetManifest:
         with pytest.raises(ValueError, match="regional"):
             m.validate()
 
+    def test_minority_partition_is_topology_agnostic(self):
+        """minority-partition (the overload-plane satellite's hub
+        partition) validates on EVERY topology — that is its reason to
+        exist next to regional-partition."""
+        for topo, kw in (("hub", {"hubs": 2}), ("full", {}),
+                         ("regional", {"regions": 2})):
+            m = generate_fleet_manifest(8, topology=topo, **kw)
+            m.net_perturb = ["minority-partition:2"]
+            m.validate()
+
+    def test_minority_partition_must_preserve_quorum(self):
+        m = generate_fleet_manifest(8, topology="hub", hubs=2)
+        m.net_perturb = ["minority-partition:3"]  # 3*3 >= 8: no quorum
+        with pytest.raises(ValueError, match="minority"):
+            m.validate()
+        m.net_perturb = ["minority-partition:0"]
+        with pytest.raises(ValueError, match="minority"):
+            m.validate()
+
+    def test_overload_perturbations_validate(self):
+        m = generate_fleet_manifest(4, topology="full")
+        names = sorted(m.nodes)
+        m.nodes[names[2]].perturb = ["mempool-storm"]
+        m.nodes[names[3]].perturb = ["rpc-flood"]
+        m.validate()
+        m2 = Manifest.from_toml(m.to_toml())
+        assert m2.nodes[names[2]].perturb == ["mempool-storm"]
+        assert m2.nodes[names[3]].perturb == ["rpc-flood"]
+        # neither takes an index
+        m.nodes[names[2]].perturb = ["mempool-storm:5"]
+        with pytest.raises(ValueError, match="takes no index"):
+            m.validate()
+
+    def test_generator_rolls_overload_perturbations(self):
+        """The random matrix can roll the overload faults, and both are
+        respawn-class (they rewrite on-disk config and respawn, so a
+        memdb node must be upgraded to sqlite)."""
+        from cometbft_tpu.e2e import generator as G
+
+        assert "mempool-storm" in G.PERTURBATIONS
+        assert "rpc-flood" in G.PERTURBATIONS
+        assert "mempool-storm" in G.RESPAWN_PERTURBATIONS
+        assert "rpc-flood" in G.RESPAWN_PERTURBATIONS
+
     def test_link_profile_needs_regional(self):
         m = generate_fleet_manifest(4, topology="full")
         m.link_profile = "wan"
@@ -167,6 +211,46 @@ class TestResourceGuard:
 
 
 # ------------------------------------------------------ 50-node soak
+
+
+# ------------------------------------------------- hub overload soak
+
+
+@pytest.mark.slow
+def test_fleet_hub_overload_storm_and_partition(tmp_path):
+    """The ISSUE 17 e2e satellite: an 8-node hub fleet (2 hubs, 6
+    spokes) commits fork-free through a mempool storm and an rpc flood
+    on two spokes, a 25% churn storm, and a 2-spoke minority partition
+    + heal — with the gossip accounting asserted from net_report.json.
+    Fork-freedom is run_manifest's own final agreement check; a shed
+    that leaked into consensus would stall the net and fail the run."""
+    n = 8
+    m = generate_fleet_manifest(
+        n, topology="hub", hubs=2,
+        net_perturb=("churn-storm:25", "minority-partition:2"),
+        target_height_delta=6, name="fleet-hub-overload")
+    names = sorted(m.nodes)
+    # overload faults ride on spokes: the hub mesh must stay clean so
+    # the storm's blast radius is one admission plane, not the topology
+    m.nodes[names[3]].perturb = ["mempool-storm"]
+    m.nodes[names[5]].perturb = ["rpc-flood"]
+    m.validate()
+    out = str(tmp_path / "net")
+    R.run_manifest(m, out, base_port=26000)
+
+    with open(os.path.join(out, "net_report.json")) as f:
+        report = json.load(f)
+    fleet = report["fleet"]
+    assert fleet["nodes_reporting"] == n
+    # the minority partition healed and was measured
+    assert fleet["partition_heal_seconds_max"] is not None
+    # gossip accounting: reconciliation ran and amplification is sane
+    assert fleet["gossip_totals"]["summaries_applied"] > 0
+    amp = fleet["gossip_votes_per_vote_needed"]
+    assert amp is not None and amp >= 1.0
+    print(f"[fleet-hub-overload] amplification {amp}; "
+          f"heal {fleet['partition_heal_seconds_max']:.2f}s; "
+          f"wire B/height/node {fleet['wire_bytes_per_height_per_node']}")
 
 
 @pytest.mark.slow

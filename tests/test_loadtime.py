@@ -44,6 +44,126 @@ def test_report_math():
     assert blocks  # silence unused warning
 
 
+def test_tx_uniqueness_across_sequences():
+    """Every generated tx is unique (seq + time_ns stamp) even at equal
+    parameters — duplicate payloads would collapse in the mempool cache
+    and silently deflate the offered load."""
+    txs = [loadtime.make_tx("exp", i, 192, 10.0, 1) for i in range(500)]
+    assert len(set(txs)) == 500
+    seqs = [loadtime.parse_tx(t)["seq"] for t in txs]
+    assert seqs == list(range(500))
+
+
+def test_generate_load_rate_shaping():
+    """generate_load paces to the requested rate: the sent count tracks
+    rate*duration (with scheduling slack), never bursts far past it, and
+    the result tallies are consistent with the transport's verdicts."""
+    import unittest.mock as mock
+
+    sent_txs = []
+    calls = {"n": 0}
+
+    def fake_post(url, tx):
+        sent_txs.append(tx)
+        calls["n"] += 1
+        return calls["n"] % 5 != 0  # every 5th rejected
+
+    async def fake_to_thread(fn, *args):
+        # `fn` is generate_load's internal post(url, tx) closure — the
+        # stub replaces the HTTP transport, keeping the pacing loop real
+        return fake_post(*args)
+
+    async def drive():
+        with mock.patch("cometbft_tpu.loadtime.asyncio.to_thread",
+                        side_effect=fake_to_thread):
+            return await loadtime.generate_load(
+                ["http://x"], rate=100.0, duration=1.0, size=64)
+
+    exp_id, res = asyncio.run(drive())
+    # 100 tx/s for 1s: within scheduling slack, and never over-driven
+    assert 80 <= res.sent <= 110, res
+    assert res.sent == res.accepted + res.rejected + res.errors
+    assert res.rejected == res.sent // 5
+    assert len(set(sent_txs)) == len(sent_txs)  # uniqueness on the wire
+    assert all(loadtime.parse_tx(t)["id"] == exp_id for t in sent_txs)
+
+
+def test_generate_saturation_counts_and_waves():
+    """The saturation-wave generator: accept/reject/error tallies per
+    outcome, sent = waves * wave_size, unique txs throughout."""
+    seen = []
+
+    async def submit(tx: bytes) -> bool:
+        seen.append(tx)
+        if len(seen) % 7 == 0:
+            raise ConnectionError("transport hiccup")
+        return len(seen) % 2 == 0
+
+    exp_id, res = asyncio.run(loadtime.generate_saturation(
+        submit, waves=3, wave_size=20, size=96))
+    assert res.sent == 60
+    assert res.sent == res.accepted + res.rejected + res.errors
+    assert res.errors == 60 // 7
+    assert len(set(seen)) == 60
+    assert all(loadtime.parse_tx(t)["id"] == exp_id for t in seen)
+
+
+def test_generate_saturation_bounds_inflight():
+    """max_inflight caps CONCURRENT submissions — the in-proc soak's
+    guard against starving the event loop it shares with consensus."""
+    state = {"now": 0, "peak": 0}
+
+    async def submit(tx: bytes) -> bool:
+        state["now"] += 1
+        state["peak"] = max(state["peak"], state["now"])
+        await asyncio.sleep(0.001)
+        state["now"] -= 1
+        return True
+
+    _, res = asyncio.run(loadtime.generate_saturation(
+        submit, waves=2, wave_size=50, size=96, max_inflight=8))
+    assert res.sent == 100 and res.accepted == 100
+    assert state["peak"] <= 8, state
+
+
+def test_rpc_submitter_classifies_shed_as_rejection():
+    """rpc_submitter maps the unified -32005 shed (any JSON-RPC error)
+    to False — the generator counts it as a rejection, not an error."""
+    import io
+    import unittest.mock as mock
+
+    bodies = [
+        json.dumps({"jsonrpc": "2.0", "id": 1, "error": {
+            "code": -32005, "message": "mempool saturated",
+            "data": {"plane": "mempool", "retry_after_ms": 1000}}}),
+        json.dumps({"jsonrpc": "2.0", "id": 1,
+                    "result": {"code": 0, "hash": "AB"}}),
+        json.dumps({"jsonrpc": "2.0", "id": 1,
+                    "result": {"code": 7, "log": "app rejected"}}),
+    ]
+
+    def fake_urlopen(req, timeout=10):
+        class R(io.StringIO):
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        return R(bodies.pop(0))
+
+    async def drive():
+        submit = loadtime.rpc_submitter("http://127.0.0.1:1")
+        with mock.patch("urllib.request.urlopen", fake_urlopen):
+            shed = await submit(b"tx1")
+            ok = await submit(b"tx2")
+            appfail = await submit(b"tx3")
+        return shed, ok, appfail
+
+    shed, ok, appfail = asyncio.run(drive())
+    assert shed is False and ok is True and appfail is False
+
+
 @pytest.mark.slow
 def test_sustained_load_on_four_node_net(tmp_path):
     """QA-table analog on a real 4-process net: sustained stamped load

@@ -1,0 +1,498 @@
+"""Overload resilience plane (ISSUE 17): the pressure registry's
+hysteresis state machine, the mempool pressure ladder (saturated
+admission shed, elevated eager expiry, windowed recheck storms), the RPC
+in-flight guard's route classes, and the unified -32005 wire shape —
+all tier-1-safe (the sustained soak lives in test_overload_soak.py,
+marked soak/slow)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.libs import overload as ovl
+from cometbft_tpu.libs.overload import OverloadRegistry
+from cometbft_tpu.mempool.mempool import (
+    CListMempool,
+    ErrMempoolIsFull,
+    MempoolConfig,
+)
+
+
+class StubApp:
+    """Programmable async ABCI mempool connection (same shape as
+    test_mempool.StubApp): verdicts, call log, optional in-flight gate."""
+
+    def __init__(self):
+        self.calls: list[tuple[bytes, abci.CheckTxType]] = []
+        self.reject: set[bytes] = set()
+        self.gate: asyncio.Event | None = None
+
+    async def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        self.calls.append((req.tx, req.type_))
+        if self.gate is not None:
+            await self.gate.wait()
+        code = 1 if req.tx in self.reject else abci.CODE_TYPE_OK
+        return abci.ResponseCheckTx(code=code, gas_wanted=1)
+
+
+class Signal:
+    """A settable utilization source."""
+
+    def __init__(self, v: float = 0.0):
+        self.v = v
+
+    def __call__(self) -> float:
+        return self.v
+
+
+# ----------------------------------------------------------- registry
+
+
+class TestRegistryLevels:
+    def test_rises_eagerly_at_watermarks(self):
+        reg = OverloadRegistry()
+        sig = Signal(0.0)
+        reg.register("mempool", sig)
+        assert reg.level("mempool") == ovl.NORMAL
+        sig.v = 0.60
+        assert reg.level("mempool") == ovl.ELEVATED
+        sig.v = 0.90
+        assert reg.level("mempool") == ovl.SATURATED
+
+    def test_hysteresis_no_flap_at_elevated_boundary(self):
+        """A signal oscillating exactly around the elevated watermark
+        must hold ELEVATED, not flap per sample: the fall edge needs
+        utilization below watermark - hysteresis (0.50)."""
+        reg = OverloadRegistry()
+        sig = Signal(0.60)
+        reg.register("mempool", sig)
+        assert reg.level("mempool") == ovl.ELEVATED
+        transitions_after_rise = reg.health()["planes"]["mempool"]["transitions"]
+        for v in (0.59, 0.61, 0.55, 0.60, 0.51, 0.58):
+            sig.v = v
+            assert reg.level("mempool") == ovl.ELEVATED
+        assert (reg.health()["planes"]["mempool"]["transitions"]
+                == transitions_after_rise)
+        sig.v = 0.49  # below 0.60 - 0.10: now it falls
+        assert reg.level("mempool") == ovl.NORMAL
+
+    def test_hysteresis_no_flap_at_saturated_boundary(self):
+        reg = OverloadRegistry()
+        sig = Signal(0.90)
+        reg.register("mempool", sig)
+        assert reg.level("mempool") == ovl.SATURATED
+        for v in (0.89, 0.91, 0.85, 0.80):
+            sig.v = v
+            assert reg.level("mempool") == ovl.SATURATED
+        sig.v = 0.79  # below 0.90 - 0.10: falls ONE level, to elevated
+        assert reg.level("mempool") == ovl.ELEVATED
+        sig.v = 0.49
+        assert reg.level("mempool") == ovl.NORMAL
+
+    def test_broken_signal_reads_normal(self):
+        """The overload plane must never take a node down on its own: a
+        raising signal reads utilization 0.0 / NORMAL."""
+        reg = OverloadRegistry()
+        reg.register("events", lambda: 1 / 0)
+        assert reg.utilization("events") == 0.0
+        assert reg.level("events") == ovl.NORMAL
+
+    def test_unregistered_plane_is_normal_but_counts_sheds(self):
+        """Ad-hoc planes ("light") shed through the registry without a
+        utilization signal."""
+        reg = OverloadRegistry()
+        assert reg.level("light") == ovl.NORMAL
+        reg.shed("light", 3)
+        assert reg.sheds("light") == 3
+        assert reg.total_sheds() == 3
+
+    def test_overall_is_worst_plane(self):
+        reg = OverloadRegistry()
+        reg.register("rpc", Signal(0.1))
+        reg.register("mempool", Signal(0.95))
+        assert reg.overall() == ovl.SATURATED
+
+    def test_retry_after_tracks_level(self):
+        reg = OverloadRegistry()
+        sig = Signal(0.0)
+        reg.register("mempool", sig)
+        assert reg.retry_after_ms("mempool") == 0
+        sig.v = 0.7
+        assert reg.retry_after_ms("mempool") == ovl.RETRY_AFTER_MS[ovl.ELEVATED]
+        sig.v = 0.95
+        assert reg.retry_after_ms("mempool") == ovl.RETRY_AFTER_MS[ovl.SATURATED]
+
+    def test_constructor_validates_watermarks(self):
+        with pytest.raises(ValueError):
+            OverloadRegistry(elevated=0.9, saturated=0.6)
+        with pytest.raises(ValueError):
+            OverloadRegistry(hysteresis=0.7)  # >= elevated
+
+    def test_health_shape(self):
+        reg = OverloadRegistry()
+        reg.register("mempool", Signal(0.95))
+        reg.shed("mempool", 2)
+        h = reg.health()
+        assert h["level"] == "saturated"
+        mp = h["planes"]["mempool"]
+        assert mp["level"] == "saturated"
+        assert mp["utilization"] == 0.95
+        assert mp["sheds"] == 2
+        assert mp["transitions"] == 1
+        assert h["watermarks"] == {
+            "elevated": 0.60, "saturated": 0.90, "hysteresis": 0.10}
+
+    def test_sheds_land_on_metrics_with_plane_label(self):
+        """Every shed is visible on /metrics as
+        cometbft_overload_sheds_total{plane=...}."""
+        from cometbft_tpu.libs import metrics as m
+
+        series = 'cometbft_overload_sheds_total{plane="mempool"}'
+
+        def scrape() -> float:
+            for line in m.global_registry().render().splitlines():
+                if line.startswith(series):
+                    return float(line.split()[-1])
+            return 0.0
+
+        reg = OverloadRegistry()
+        reg.shed("mempool")  # ensure the labeled series exists
+        before = scrape()
+        reg.shed("mempool", 5)
+        assert scrape() == before + 5
+
+
+# ----------------------------------------------------- mempool ladder
+
+
+def _pool(size: int = 10, window: int = 0) -> tuple[CListMempool, StubApp]:
+    app = StubApp()
+    cfg = MempoolConfig(size=size)
+    if window:
+        cfg.recheck_window = window
+    mp = CListMempool(cfg, app)
+    return mp, app
+
+
+class TestMempoolPressureLadder:
+    def test_saturated_sheds_before_abci(self):
+        """At the saturated watermark a NEW tx is shed at the door — no
+        ABCI round-trip is bought — with the plane + retry hint on the
+        error, and the shed counted."""
+
+        async def main():
+            mp, app = _pool(size=10)
+            reg = OverloadRegistry()
+            mp.attach_overload(reg)
+            for i in range(9):  # 9/10 = 0.9 utilization
+                await mp.check_tx(b"tx-%d" % i)
+            calls_before = len(app.calls)
+            with pytest.raises(ErrMempoolIsFull) as ei:
+                await mp.check_tx(b"tx-shed")
+            assert ei.value.plane == "mempool"
+            assert ei.value.retry_after_ms == ovl.RETRY_AFTER_MS[ovl.SATURATED]
+            assert len(app.calls) == calls_before  # shed pre-ABCI
+            assert reg.sheds("mempool") == 1
+            assert mp.size() == 9
+
+        asyncio.run(main())
+
+    def test_full_pool_shed_is_counted(self):
+        async def main():
+            mp, app = _pool(size=2)
+            reg = OverloadRegistry()
+            mp.attach_overload(reg)
+            mp.config.size = 10  # admit 2 under a bigger cap...
+            await mp.check_tx(b"a")
+            await mp.check_tx(b"b")
+            mp.config.size = 2  # ...then clamp: pool is now hard-full
+            with pytest.raises(ErrMempoolIsFull):
+                await mp.check_tx(b"c")
+            assert reg.sheds("mempool") == 1
+
+        asyncio.run(main())
+
+    def test_inflight_duplicate_resolves_through_saturation(self):
+        """A duplicate of an in-flight tx still resolves at saturated —
+        it costs nothing and the submitter learns the first result."""
+
+        async def main():
+            mp, app = _pool(size=10)
+            reg = OverloadRegistry()
+            mp.attach_overload(reg)
+            for i in range(8):
+                await mp.check_tx(b"tx-%d" % i)
+            app.gate = asyncio.Event()
+            first = asyncio.create_task(mp.check_tx(b"dup"))
+            await asyncio.sleep(0.01)  # first copy now in flight (9/10)
+            second = asyncio.create_task(mp.check_tx(b"dup"))
+            await asyncio.sleep(0.01)
+            app.gate.set()
+            r1, r2 = await asyncio.gather(first, second)
+            assert r1 is r2  # same response object, one ABCI round-trip
+            assert reg.sheds("mempool") == 0
+
+        asyncio.run(main())
+
+    def test_eager_expiry_at_elevated(self):
+        """update() at elevated TTL-expires the OLDEST txs down to the
+        elevated hysteresis floor, removes them from the cache (they can
+        be resubmitted), and counts them as sheds."""
+
+        async def main():
+            mp, app = _pool(size=10)
+            reg = OverloadRegistry()
+            mp.attach_overload(reg)
+            for i in range(8):  # 0.8: elevated, below saturated
+                await mp.check_tx(b"etx-%d" % i)
+            await mp.update(1, [], [])
+            # target = size * (elevated - hysteresis) = 10 * 0.5 = 5
+            assert mp.size() == 5
+            assert mp.eager_expired == 3
+            assert reg.sheds("mempool") == 3
+            # oldest went first, and left the cache for resubmission
+            assert not mp.cache.has(b"etx-0")
+            res = await mp.check_tx(b"etx-0")
+            assert res.is_ok()
+
+        asyncio.run(main())
+
+    def test_no_eager_expiry_below_elevated(self):
+        async def main():
+            mp, app = _pool(size=10)
+            reg = OverloadRegistry()
+            mp.attach_overload(reg)
+            for i in range(4):
+                await mp.check_tx(b"tx-%d" % i)
+            await mp.update(1, [], [])
+            assert mp.size() == 4
+            assert mp.eager_expired == 0
+
+        asyncio.run(main())
+
+    def test_recheck_storm_is_windowed(self):
+        """A post-commit recheck over a big pool runs in >= 2 bounded
+        windows (recheck_window) instead of one monolithic sweep."""
+
+        async def main():
+            mp, app = _pool(size=100, window=2)
+            for i in range(5):
+                await mp.check_tx(b"w-%d" % i)
+            app.calls.clear()
+            await mp.update(1, [], [])
+            assert mp.recheck_windows_last == 3  # ceil(5/2)
+            assert mp.recheck_windows_total == 3
+            rechecks = [c for c in app.calls
+                        if c[1] == abci.CheckTxType.RECHECK]
+            assert len(rechecks) == 5
+
+        asyncio.run(main())
+
+    def test_recheck_storm_does_not_starve_admission(self):
+        """An admission submitted while the recheck sweep is mid-storm
+        completes: the windows yield the event loop between batches."""
+
+        async def main():
+            mp, app = _pool(size=100, window=2)
+            for i in range(6):
+                await mp.check_tx(b"r-%d" % i)
+
+            admitted = asyncio.Event()
+
+            async def admit_mid_storm():
+                res = await mp.check_tx(b"mid-storm-tx")
+                assert res.is_ok()
+                admitted.set()
+
+            task = asyncio.create_task(admit_mid_storm())
+            await mp.update(1, [], [])
+            await asyncio.wait_for(admitted.wait(), 2.0)
+            await task
+            assert mp.recheck_windows_last >= 2
+            assert mp.cache.has(b"mid-storm-tx")
+
+        asyncio.run(main())
+
+    def test_recheck_drops_rejected_survivors(self):
+        """Concurrent window rechecks still drop txs the app now
+        rejects (post-block state invalidation)."""
+
+        async def main():
+            mp, app = _pool(size=100, window=3)
+            for i in range(5):
+                await mp.check_tx(b"d-%d" % i)
+            app.reject = {b"d-1", b"d-3"}
+            await mp.update(1, [], [])
+            assert mp.size() == 3
+            assert not mp.cache.has(b"d-1")  # resubmittable
+
+        asyncio.run(main())
+
+
+# ------------------------------------------------------ rpc guard
+
+
+class TestRouteClasses:
+    def test_classification(self):
+        from cometbft_tpu.rpc.server import RPCServer
+
+        rc = RPCServer._route_class
+        assert rc("broadcast_tx_sync") == "write"
+        assert rc("broadcast_evidence") == "write"
+        assert rc("check_tx") == "write"
+        assert rc("block") == "read"
+        assert rc("abci_query") == "read"
+        # control plane is exempt: an operator must be able to ask a
+        # saturated node how saturated it is
+        assert rc("health") is None
+        assert rc("status") is None
+        assert rc("net_info") is None
+        assert rc("unsafe_flush_mempool") is None
+
+
+class TestAdmissionGuard:
+    def _server(self, read=2, write=1, queue_timeout=0.02):
+        import io
+        from types import SimpleNamespace
+
+        from cometbft_tpu.libs import log as cmtlog
+        from cometbft_tpu.rpc.server import RPCServer
+
+        cfg = SimpleNamespace(
+            laddr="tcp://127.0.0.1:0",
+            overload_read_inflight=read,
+            overload_write_inflight=write,
+            overload_queue_timeout=queue_timeout,
+            slow_client_timeout=1.0,
+        )
+        env = SimpleNamespace(routes=lambda: {})
+        logger = cmtlog.Logger(stream=io.StringIO())
+        return RPCServer(None, cfg, logger=logger, env=env)
+
+    def test_admit_within_budget_and_shed_past_it(self):
+        async def main():
+            srv = self._server(read=2)
+            assert await srv._admit("read")
+            assert await srv._admit("read")
+            assert srv._rpc_utilization() == 1.0
+            assert not await srv._admit("read")  # queue deadline expires
+            srv._inflight["read"] -= 1
+            assert await srv._admit("read")
+
+        asyncio.run(main())
+
+    def test_queued_request_admits_when_slot_frees(self):
+        async def main():
+            srv = self._server(read=1, queue_timeout=0.5)
+            assert await srv._admit("read")
+
+            async def free_soon():
+                await asyncio.sleep(0.02)
+                srv._inflight["read"] -= 1
+
+            asyncio.create_task(free_soon())
+            assert await srv._admit("read")  # waited out the queue
+
+        asyncio.run(main())
+
+    def test_shed_envelope_wire_shape(self):
+        """The unified saturation wire shape: -32005 with plane +
+        retry_after_ms in error.data."""
+        srv = self._server(write=1)
+        env = srv._shed_envelope(7, "write")
+        assert env["id"] == 7
+        err = env["error"]
+        assert err["code"] == -32005
+        assert "budget exhausted" in err["message"]
+        assert err["data"]["plane"] == "rpc"
+        assert err["data"]["retry_after_ms"] == ovl.RETRY_AFTER_MS[ovl.SATURATED]
+
+    def test_zero_budget_disables_guard(self):
+        async def main():
+            srv = self._server(read=0)
+            for _ in range(5):
+                assert await srv._admit("read")
+
+        asyncio.run(main())
+
+
+# --------------------------------------------------- live-node wiring
+
+
+def test_overload_surfaces_on_live_node(tmp_path):
+    """One node boot covers the overload plane's RPC surfaces: the
+    `overload` health section, -32602 on malformed params (the validation
+    sweep), the unified -32005 wire shape with plane + retry_after_ms in
+    error.data, broadcast_tx_sync's elevated-pressure downgrade to async
+    semantics, and the /metrics overload series."""
+    import base64
+
+    from cometbft_tpu.node import Node, init_files
+
+    from tests.test_node import _http_get, _node_config, _rpc_call
+
+    home = str(tmp_path / "home")
+    init_files(home, chain_id="overload-chain", moniker="ovl0")
+
+    async def main():
+        node = Node(_node_config(home))
+        await node.start()
+        try:
+            addr = node.rpc_server.bound_addr
+
+            # health: per-plane levels + watermarks ride the liveness probe
+            h = (await _rpc_call(addr, "health"))["result"]
+            assert h["overload"]["level"] in ("normal", "elevated",
+                                              "saturated")
+            assert {"rpc", "mempool", "sched", "events"} <= set(
+                h["overload"]["planes"])
+            assert h["overload"]["watermarks"]["saturated"] == 0.90
+
+            # param validation sweep: malformed params are -32602, not a
+            # raw -32603 internal error
+            for method, params in (
+                ("block", {"height": "xyz"}),
+                ("validators", {"height": "1x"}),
+                ("block_by_hash", {"hash": "zz-not-hex"}),
+                ("tx", {"hash": "nope"}),
+                ("abci_query", {"data": "zz-not-hex"}),
+                ("genesis_chunked", {"chunk": "first"}),
+                ("broadcast_tx_sync", {"tx": "!!! not base64 !!!"}),
+            ):
+                resp = await _rpc_call(addr, method, params)
+                assert resp["error"]["code"] == -32602, (method, resp)
+
+            # drive the mempool to its cap: every later admission sheds
+            node.mempool.config.size = 1
+            ok = await _rpc_call(addr, "broadcast_tx_sync", {
+                "tx": base64.b64encode(b"seed=1").decode()})
+            assert ok["result"]["code"] == 0
+            assert "deferred" not in ok["result"]
+
+            # elevated/saturated mempool: sync downgrades to async
+            # semantics instead of holding the connection open
+            deferred = await _rpc_call(addr, "broadcast_tx_sync", {
+                "tx": base64.b64encode(b"seed=2").decode()})
+            assert deferred["result"]["code"] == 0
+            assert deferred["result"]["deferred"] is True
+
+            # the unified shed shape: -32005 + plane + retry hint
+            shed = await _rpc_call(addr, "broadcast_tx_commit", {
+                "tx": base64.b64encode(b"seed=3").decode()})
+            err = shed["error"]
+            assert err["code"] == -32005
+            assert err["data"]["plane"] == "mempool"
+            assert err["data"]["retry_after_ms"] > 0
+            assert node.overload.sheds("mempool") >= 1
+
+            # every shed lands on /metrics with its plane label
+            text = await _http_get(addr, "/metrics")
+            assert 'cometbft_overload_sheds_total{plane="mempool"}' in text
+            assert "cometbft_overload_level" in text
+        finally:
+            await node.stop()
+
+    asyncio.run(main())

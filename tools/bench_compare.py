@@ -119,6 +119,15 @@ TRACKED: dict[str, tuple[str, float]] = {
     # like the mesh/bls/storage keys.
     "height_phase_total_ms": (LOWER, 75.0),
     "consensus.height_phase_total_ms": (LOWER, 75.0),
+    # overload soak (bench_soak): p99 inter-height gap while the
+    # saturation generator sheds against the admission ceiling — the
+    # graded liveness headline of the overload plane. ENFORCED
+    # lower-is-better with a wide threshold: the absolute gap rides
+    # host contention, but a multiple-of-itself jump means consensus
+    # stopped being insulated from mempool/RPC pressure. Bare and
+    # soak.-prefixed like the mesh/bls/storage/consensus keys.
+    "height_p99_under_load_ms": (LOWER, 75.0),
+    "soak.height_p99_under_load_ms": (LOWER, 75.0),
 }
 
 # informational-by-design (wire/tunnel-bound): listed so the verdict can
@@ -174,6 +183,15 @@ INFORMATIONAL = {
     "proposal_propagation_p99_ms": "p99 over tens of in-proc samples: "
                                    "tracked for trend until a quiet "
                                    "round establishes variance",
+    # overload-soak companions to the enforced height_p99_under_load_ms:
+    # both are offered-load-shape properties (how hard the generator
+    # pushes on this host), not code properties
+    "soak_heights_per_s": "commit rate under saturation: rides host "
+                          "contention and generator pacing — the "
+                          "enforced contract is height_p99_under_load_ms",
+    "admission_txs_per_s": "admitted-tx rate under saturation: a "
+                           "property of pool size vs drain rate on this "
+                           "host, tracked for trend only",
 }
 
 
