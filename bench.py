@@ -1352,6 +1352,78 @@ def bench_fleet(detail: dict) -> None:
         detail["fleet_heights_per_s_50node"] = curve["50"]["heights_per_s"]
 
 
+def bench_discovery(detail: dict) -> None:
+    """Discovery-plane scenario (peer-discovery resilience PR):
+
+      bootstrap_convergence_s     wall seconds for an ORGANIC fleet
+                                  (BENCH_DISCOVERY_NODES nodes, one seed,
+                                  empty address books, NO persistent
+                                  wiring) to go from process spawn to
+                                  every node committing — discovery IS
+                                  the critical path, so this clocks the
+                                  PEX plane end to end
+      eclipse_book_occupancy_pct  worst per-/16-source-group share of the
+                                  NEW set after a 32-identity sybil flood
+                                  through the real book-intake path;
+                                  the hashed-bucket geometry bounds it at
+                                  stats()["src_group_occupancy_bound_pct"]
+
+    Env knobs: BENCH_DISCOVERY=0 skips, BENCH_DISCOVERY_NODES,
+    BENCH_DISCOVERY_BASE_PORT."""
+    if os.environ.get("BENCH_DISCOVERY", "1") == "0":
+        detail["discovery"] = "skipped: BENCH_DISCOVERY=0"
+        return
+    import tempfile
+
+    from cometbft_tpu.e2e import runner as R
+    from cometbft_tpu.e2e.generator import generate_fleet_manifest
+    from cometbft_tpu.p2p.pex import AddrBook
+    from cometbft_tpu.p2p.pex.byzantine import ByzantinePexHarness
+
+    n = int(os.environ.get("BENCH_DISCOVERY_NODES", "6"))
+    base_port = int(os.environ.get("BENCH_DISCOVERY_BASE_PORT", "8000"))
+    R._resource_guard(n, base_port)
+    m = generate_fleet_manifest(n, topology="organic", regions=1,
+                                name=f"bench-discovery-{n}")
+    d = tempfile.mkdtemp(prefix=f"bench-discovery-{n}-")
+    net = R.setup(m, d, base_port)
+    _progress(f"discovery: booting {n}-node organic fleet (one seed)")
+    books: dict = {}
+    try:
+        net.app_procs = [None] * n
+        t0 = time.perf_counter()
+        R._boot_staggered(net)
+        R._wait(lambda: all(R._height(net, i) >= m.initial_height + 2
+                            for i in range(n)),
+                150 + 4 * n, f"{n}-node organic fleet converging via PEX")
+        boot_s = time.perf_counter() - t0
+        for i in range(n):
+            doc = R._rpc(net, i, "net_telemetry", timeout=10.0)
+            disc = doc.get("result", {}).get("discovery") or {}
+            books[f"node{i:03d}"] = disc.get("size", 0)
+    finally:
+        for p in net.node_procs:
+            R._kill(p)
+
+    # eclipse occupancy: the socket-free flood through the SAME intake
+    # path the wire uses (32 identities, one /16, diverse forged claims)
+    book = AddrBook(our_id="bench")
+    ledger = ByzantinePexHarness.flood_book(book, n_identities=32,
+                                            claims_per_identity=128)
+    s = book.stats()
+    detail["discovery"] = {
+        "organic_nodes": n,
+        "bootstrap_convergence_s": round(boot_s, 2),
+        "addrbook_sizes": books,
+        "eclipse_flood": ledger,
+        "eclipse_book_occupancy_pct": s["max_src_group_occupancy_pct"],
+        "eclipse_occupancy_bound_pct": s["src_group_occupancy_bound_pct"],
+    }
+    # sentinel names (tools/bench_compare.py)
+    detail["bootstrap_convergence_s"] = round(boot_s, 2)
+    detail["eclipse_book_occupancy_pct"] = s["max_src_group_occupancy_pct"]
+
+
 def bench_storage(detail: dict) -> None:
     """Storage-plane scenario: consensus-WAL fsync latency (the disk
     floor under every committed height — the write_sync path EndHeight
@@ -1967,6 +2039,12 @@ def _cli() -> int:
                         "4-val in-proc net under 2x-ceiling admission "
                         "waves; emits soak_heights_per_s, "
                         "admission_txs_per_s, height_p99_under_load_ms")
+    p.add_argument("--discovery", action="store_true",
+                   help="run ONLY the discovery-plane scenario: an organic "
+                        "fleet bootstrapping from one seed via PEX "
+                        "(bootstrap_convergence_s) + a sybil flood against "
+                        "the hashed-bucket address book "
+                        "(eclipse_book_occupancy_pct)")
     p.add_argument("--mesh-child", action="store_true",
                    help="internal: the in-process mesh scenario (must run "
                         "under JAX_PLATFORMS=cpu with forced host devices)")
@@ -1991,6 +2069,21 @@ def _cli() -> int:
                   "value": None,
                   "unit": "see detail.height_p99_under_load_ms (lower is "
                           "better) + soak_heights_per_s/admission_txs_per_s",
+                  "detail": detail}
+        print(json.dumps(record))
+        if args.out:
+            _write_out(record, args.out)
+        return 0
+    if args.discovery:
+        detail: dict = {}
+        bench_discovery(detail)
+        # no top-level "value": the headline, bootstrap_convergence_s,
+        # is LOWER-better and lives under its own TRACKED name;
+        # eclipse occupancy is a bound check, informational
+        record = {"metric": "discovery_plane",
+                  "value": None,
+                  "unit": "see detail.bootstrap_convergence_s (lower is "
+                          "better) + eclipse_book_occupancy_pct",
                   "detail": detail}
         print(json.dumps(record))
         if args.out:

@@ -346,6 +346,11 @@ class P2PConfig:
     ban_duration: float = 60.0
     ban_max_duration: float = 3600.0
     ban_score_half_life: float = 120.0
+    # discovery-plane diversity (p2p/pex/reactor.py): outbound slots one
+    # /16 netblock may hold (0 = auto: half the outbound budget, min 2)
+    # and how often ensure-peers wakes to fill the outbound set
+    max_outbound_per_group: int = 0
+    pex_ensure_interval: float = 30.0
 
     def validate_basic(self) -> None:
         if self.max_num_inbound_peers < 0 or self.max_num_outbound_peers < 0:
@@ -369,6 +374,10 @@ class P2PConfig:
             raise ValueError("ban durations cannot be negative")
         if self.ban_score_half_life <= 0:
             raise ValueError("ban_score_half_life must be positive")
+        if self.max_outbound_per_group < 0:
+            raise ValueError("max_outbound_per_group cannot be negative")
+        if self.pex_ensure_interval <= 0:
+            raise ValueError("pex_ensure_interval must be positive")
         if self.chaos:
             from cometbft_tpu.p2p import netchaos as _netchaos
 

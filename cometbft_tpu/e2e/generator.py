@@ -130,7 +130,7 @@ def generate_manifests(seed: int, count: int) -> list[Manifest]:
 # it). Hub/spoke and regional topologies with the intra-region-fast /
 # cross-region-slow link shape (runner.LINK_PROFILES).
 
-FLEET_TOPOLOGIES = ("full", "hub", "regional")
+FLEET_TOPOLOGIES = ("full", "hub", "regional", "organic")
 
 
 def generate_fleet_manifest(

@@ -143,7 +143,10 @@ class Manifest:
     # every other (the classic 4-val net); "hub" = the first `hubs` nodes
     # form a hub mesh, spokes peer only with hubs; "regional" = full mesh
     # within a region, region gateways (first node of each region) mesh
-    # across regions — the shape production gossip pathologies need
+    # across regions — the shape production gossip pathologies need;
+    # "organic" = NO persistent wiring at all: node 0 is the lone seed,
+    # every other node boots with an empty address book knowing only the
+    # seed and must GROW its peer set through PEX discovery
     topology: str = "full"
     regions: int = 1    # regional topology: how many regions
     hubs: int = 2       # hub topology: how many hub nodes
@@ -180,7 +183,7 @@ class Manifest:
     height_slow_ms: float = 0.0
     nodes: dict[str, NodeManifest] = field(default_factory=dict)
 
-    TOPOLOGIES = ("full", "hub", "regional")
+    TOPOLOGIES = ("full", "hub", "regional", "organic")
     NET_PERTURBATIONS = ("churn-storm", "regional-partition",
                          "byzantine-minority", "minority-partition")
     LINK_PROFILES = ("", "wan", "lossy-wan")

@@ -118,9 +118,13 @@ def _topology_peers(manifest: Manifest, names: list[str], i: int) -> list[int]:
     and hangs every spoke off ALL hubs; "regional" meshes each region
     internally and meshes the region GATEWAYS (first node per region)
     across regions — cross-region traffic concentrates on the gateway
-    links the netchaos profiles degrade."""
+    links the netchaos profiles degrade. "organic" wires NOTHING: every
+    node except the lone seed (node 0) boots with an empty address book
+    and grows its peer set through PEX discovery alone."""
     n = len(names)
     others = [j for j in range(n) if j != i]
+    if manifest.topology == "organic":
+        return []
     if manifest.topology == "hub":
         hubs = list(range(min(manifest.hubs, n)))
         if i in hubs:
@@ -229,6 +233,14 @@ def setup(manifest: Manifest, out_dir: str, base_port: int) -> _Net:
         cfg.rpc.laddr = f"tcp://127.0.0.1:{net.rpc_port(i)}"
         cfg.p2p.persistent_peers = ",".join(
             peer_addrs[j] for j in _topology_peers(manifest, names, i))
+        if manifest.topology == "organic" and i > 0:
+            # bootstrap = the seed's address and nothing else; the rest
+            # of the peer set must be LEARNED over PEX
+            cfg.p2p.seeds = peer_addrs[0]
+        if manifest.topology == "organic":
+            # boot-time convergence rides ensure-peers; the 30 s
+            # production cadence would dominate the bootstrap clock
+            cfg.p2p.pex_ensure_interval = 2.0
         # a fleet hub/gateway takes far more inbound conns than the
         # 40-peer default allows
         cfg.p2p.max_num_inbound_peers = max(40, len(names) + 8)
@@ -492,6 +504,7 @@ def _fleet_rollup(report: dict, net: _Net, names: list[str]) -> dict:
     g_tot: dict[str, int] = {}
     heal = []
     reporting = 0
+    book_sizes: dict[str, int] = {}
     for i, name in enumerate(names):
         doc = report["nodes"].get(name) or {}
         if "error" in doc:
@@ -504,6 +517,9 @@ def _fleet_rollup(report: dict, net: _Net, names: list[str]) -> dict:
         gossip = doc.get("gossip") or {}
         for k, v in (gossip.get("totals") or {}).items():
             g_tot[k] = g_tot.get(k, 0) + v
+        disc = doc.get("discovery") or {}
+        if disc:
+            book_sizes[name] = disc.get("size", 0)
         hs = (doc.get("net_chaos") or {}).get("last_heal_seconds")
         if hs:
             heal.append(hs)
@@ -526,6 +542,10 @@ def _fleet_rollup(report: dict, net: _Net, names: list[str]) -> dict:
             round(g_tot.get("votes_recv", 0) / needed, 3)
             if needed else None),
         "partition_heal_seconds_max": max(heal) if heal else None,
+        # discovery plane: how big each node's PEX book grew — under the
+        # organic topology this IS the convergence evidence (every entry
+        # was learned over the wire, none were wired by the runner)
+        "addrbook_sizes": book_sizes or None,
     }
 
 
@@ -665,9 +685,15 @@ def _nudge_dials(net: _Net, names: list[str]) -> None:
     a node that ignores the nudge just rides its own backoff."""
     ids = _node_ids(net)
     for i in range(len(names)):
+        if net.manifest.topology == "organic":
+            # no persistent wiring to re-dial; point everyone back at the
+            # seed so a restarted node re-enters discovery immediately
+            peer_idx = [0] if i != 0 else []
+        else:
+            peer_idx = _topology_peers(net.manifest, names, i)
         peers = ",".join(
             f"{ids[j]}@127.0.0.1:{net.base_port + j}"
-            for j in _topology_peers(net.manifest, names, i))
+            for j in peer_idx)
         if not peers:
             continue
         try:

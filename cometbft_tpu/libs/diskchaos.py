@@ -14,6 +14,8 @@ rests on. Sites:
   db.read            one SQLiteDB get (value returned to the caller)
   privval.save       the sign-state durable_replace (privval/file_pv.py)
   blockstore.save    the block-store save batch (store/blockstore.py)
+  addrbook.save      the PEX address-book durable write
+                     (p2p/pex/addrbook.py AddrBook.save)
 
 Kinds (not every kind applies at every seam; an armed kind waits,
 un-consumed, at seams it does not apply to):
@@ -59,6 +61,7 @@ SITES = (
     "db.read",
     "privval.save",
     "blockstore.save",
+    "addrbook.save",
 )
 
 KINDS = ("torn_write", "fsync_error", "fsync_lie", "enospc", "eio",

@@ -331,6 +331,18 @@ class P2PMetrics:
         self.peer_bans = reg.counter(
             "p2p", "peer_bans",
             "Peers banned after repeated misbehavior")
+        # discovery plane (p2p/pex/addrbook.py hashed-bucket book)
+        self.addrbook_size = reg.gauge(
+            "p2p", "addrbook_size",
+            "Address-book entries by set (hashed-bucket geometry)",
+            labels=("set",))
+        self.addrbook_overwrite_rejected = reg.counter(
+            "p2p", "addrbook_overwrite_rejected_total",
+            "Gossip records rejected because they would overwrite the "
+            "host:port of a successfully-tried (OLD) address")
+        self.addrbook_quarantined = reg.counter(
+            "p2p", "addrbook_quarantined_total",
+            "Corrupt address-book files quarantined to .corrupt at load")
         self.peer_cap = peer_cap
         # label-slot ledger (bounded under churn storms — ISSUE 12):
         #   _peer_labels  ids currently OWNING a label (<= peer_cap live

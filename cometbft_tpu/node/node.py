@@ -500,20 +500,39 @@ class Node(BaseService):
         self.addr_book = None
         self.pex_reactor = None
         if config.p2p.pex:
-            from cometbft_tpu.p2p.pex import AddrBook, PEXReactor
+            import random as _random
+
+            from cometbft_tpu.p2p.pex import AddrBook, NetAddress, PEXReactor
 
             self.addr_book = AddrBook(
                 os.path.join(config.home, config.p2p.addr_book_file),
                 our_id=self.node_key.id(),
             )
+            self.addr_book.metrics = self.p2p_metrics
+            if self.addr_book.load_error:
+                self.logger.error(
+                    "address book corrupt; quarantined and booting empty",
+                    err=self.addr_book.load_error,
+                    quarantined=self.addr_book.quarantined_path,
+                )
             for seed in config.p2p.seed_list():
-                from cometbft_tpu.p2p.pex.addrbook import NetAddress
-
                 self.addr_book.add_address(NetAddress.parse(seed))
+            # persistent peers are operator intent: pinned in the book,
+            # exempt from eviction and the per-group outbound cap
+            for pp in config.p2p.persistent_peer_list():
+                try:
+                    ppa = NetAddress.parse(pp)
+                except (ValueError, TypeError):
+                    continue
+                self.addr_book.add_address(ppa)
+                self.addr_book.mark_protected(ppa.node_id)
             self.pex_reactor = PEXReactor(
                 self.addr_book,
                 max_outbound=config.p2p.max_num_outbound_peers,
                 seed_mode=config.p2p.seed_mode,
+                ensure_interval=config.p2p.pex_ensure_interval,
+                max_group_outbound=config.p2p.max_outbound_per_group,
+                rng=_random.Random(self.node_key.id()),
                 logger=self.logger.with_fields(module="pex"),
             )
             self.switch.add_reactor("PEX", self.pex_reactor)

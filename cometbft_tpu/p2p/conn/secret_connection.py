@@ -134,6 +134,17 @@ class SecretConnection:
         self._recv_buf = b""
         self._send_lock = asyncio.Lock()
 
+    @property
+    def remote_host(self) -> str:
+        """The remote SOCKET host — unforgeable, unlike any address the
+        peer self-reports; the PEX address book keys its hashed-bucket
+        source attribution on this."""
+        try:
+            peername = self._writer.get_extra_info("peername")
+            return peername[0] if peername else ""
+        except Exception:  # noqa: BLE001 - telemetry, never raises
+            return ""
+
     # -------------------------------------------------------- handshake
 
     @classmethod

@@ -59,6 +59,12 @@ class Peer(BaseService):
     def id(self) -> str:
         return self.node_info.node_id
 
+    @property
+    def remote_host(self) -> str:
+        """The remote socket host (through the netchaos wrapper's
+        attribute forwarding) — the PEX book's source-group key."""
+        return getattr(self._conn, "remote_host", "") or ""
+
     def is_persistent(self) -> bool:
         return self.persistent
 

@@ -207,6 +207,29 @@ class Switch(BaseService):
             self._tasks.spawn(self._dial_with_retries(addr, persistent),
                               name=f"dial-{addr}")
 
+    async def dial_peer(self, addr: str) -> bool:
+        """One AWAITED dial attempt with the outcome returned to the
+        caller — the PEX ensure-peers seam: failures must land back on
+        the address book's attempt/backoff bookkeeping instead of being
+        dropped by a fire-and-forget task (dial_peers_async stays the
+        fire-and-forget path for operator/topology dials)."""
+        node_id, _, _ = parse_addr(addr)
+        if node_id and (node_id in self.peers
+                        or self.scorer.is_banned(node_id)):
+            return False
+        try:
+            up = await self.transport.dial(addr)
+            await self._add_peer(up)
+            return True
+        except asyncio.CancelledError:
+            raise
+        except ErrDuplicatePeer:
+            # lost a simultaneous-dial tie-break: the peer IS connected
+            return True
+        except Exception as e:  # noqa: BLE001
+            self.logger.info("dial failed", addr=addr, err=str(e))
+            return False
+
     async def _dial_with_retries(self, addr: str, persistent: bool) -> None:
         node_id, _, _ = parse_addr(addr)
         attempts = RECONNECT_ATTEMPTS if persistent else 1

@@ -291,6 +291,9 @@ class Environment:
         # inspect mode, where there is no live reactor
         cons = getattr(self.node, "consensus_reactor", None)
         acct = getattr(cons, "gossip_accounting", None)
+        # discovery plane: the address book's hashed-bucket occupancy view
+        # (per-source-group spread vs the geometric eclipse bound)
+        book = getattr(self.node, "addr_book", None)
         return {
             "node_id": node_key.id() if node_key is not None else "",
             "moniker": node_info.moniker if node_info is not None else "",
@@ -298,6 +301,7 @@ class Environment:
                             if node_info is not None else ""),
             **wire,
             "gossip": acct() if acct is not None else None,
+            "discovery": book.stats() if book is not None else None,
             "tunnel": linkmodel.tunnel().snapshot(),
             "p2p_link": linkmodel.p2p().snapshot(),
             "net_chaos": netchaos.snapshot(),

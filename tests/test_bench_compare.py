@@ -341,6 +341,42 @@ class TestDirectionAwareCompare:
         assert bc.compare(rec, rec)["verdict"] == "pass"
         assert bc.compare(worse, rec)["verdict"] == "pass"
 
+    def test_bootstrap_convergence_is_enforced_lower_better(self):
+        """Discovery-plane sentinel wiring: organic bootstrap convergence
+        regressing UP past 75% fails — both the bare detail key and the
+        discovery.-prefixed section key; the same delta as an improvement
+        passes; the eclipse occupancy is informational with a stated why
+        (the contract is the geometric bound asserted in tests)."""
+        old = _record(bootstrap_convergence_s=18.0,
+                      eclipse_book_occupancy_pct=9.4,
+                      discovery={"bootstrap_convergence_s": 18.0})
+        worse = _record(bootstrap_convergence_s=48.0,
+                        eclipse_book_occupancy_pct=12.5,
+                        discovery={"bootstrap_convergence_s": 48.0})
+        v = bc.compare(old, worse)
+        assert v["verdict"] == "fail"
+        assert "bootstrap_convergence_s" in v["regressions"]
+        assert "discovery.bootstrap_convergence_s" in v["regressions"]
+        assert bc.compare(worse, old)["verdict"] == "pass"
+        row = v["metrics"]["eclipse_book_occupancy_pct"]
+        assert row["verdict"] == "info"
+        assert "geometric bound" in row["why_info"]
+
+    def test_discovery_sentinel_self_test_case(self):
+        """--self-test contract on a discovery-shaped record: an injected
+        bootstrap-convergence regression is flagged; the identical
+        snapshot and the improvement direction are not."""
+        rec = _record(bootstrap_convergence_s=18.0)
+        worse, metric, pct = bc.inject_regression(
+            rec, metric="bootstrap_convergence_s")
+        assert metric == "bootstrap_convergence_s" and pct > 75.0
+        assert worse["detail"]["bootstrap_convergence_s"] > 18.0
+        caught = bc.compare(rec, worse)
+        assert caught["verdict"] == "fail"
+        assert metric in caught["regressions"]
+        assert bc.compare(rec, rec)["verdict"] == "pass"
+        assert bc.compare(worse, rec)["verdict"] == "pass"
+
     def test_fleet_curve_leaves_are_informational(self):
         """Nested fleet curve values (fleet.curve.<n>.*) flatten into
         dotted names that are NOT tracked — they must report as info,

@@ -51,6 +51,17 @@ class TestTopologyWiring:
                       for j in R._topology_peers(m, names, i))]
         assert len(gw0) == 4  # 2 gateways x 2 regions
 
+    def test_organic_is_pex_only(self):
+        """organic has NO static wiring: every node's persistent peer
+        list is empty — the topology is grown by discovery (node 0 is
+        the lone seed, wired by the runner via p2p.seeds, not here)."""
+        m = generate_fleet_manifest(8, topology="organic")
+        names = sorted(m.nodes)
+        for i in range(8):
+            assert R._topology_peers(m, names, i) == []
+        m2 = Manifest.from_toml(m.to_toml())
+        assert m2.topology == "organic"
+
     def test_netchaos_spec_round_trips(self):
         m = generate_fleet_manifest(6, topology="regional", regions=3,
                                     link_profile="lossy-wan")
@@ -251,6 +262,53 @@ def test_fleet_hub_overload_storm_and_partition(tmp_path):
     print(f"[fleet-hub-overload] amplification {amp}; "
           f"heal {fleet['partition_heal_seconds_max']:.2f}s; "
           f"wire B/height/node {fleet['wire_bytes_per_height_per_node']}")
+
+
+@pytest.mark.slow
+def test_fleet_organic_pex_bootstrap_churn_and_partition(tmp_path):
+    """The ISSUE 18 e2e acceptance: an 8-node ORGANIC fleet — no static
+    wiring at all, every node boots with an empty address book and only
+    node 0's address as a seed — must converge to a connected topology
+    via PEX alone and commit fork-free through a 25% churn storm and a
+    2-node minority partition + heal. Churned nodes respawn with
+    whatever their durable address book persisted, so recovery exercises
+    the book's save/load path under real process death. The same fleet
+    rerun with strict full wiring gives the amplification baseline the
+    PEX-grown mesh is measured against."""
+    n = 8
+    perturb = ("churn-storm:25", "minority-partition:2")
+
+    def run(tag, topology, base_port):
+        m = generate_fleet_manifest(
+            n, topology=topology, net_perturb=perturb,
+            target_height_delta=6, name=f"fleet-{tag}")
+        out = str(tmp_path / tag)
+        R.run_manifest(m, out, base_port=base_port)
+        with open(os.path.join(out, "net_report.json")) as f:
+            return json.load(f)["fleet"]
+
+    organic = run("organic", "organic", 16000)
+    assert organic["nodes_reporting"] == n
+    # the minority partition healed on a PEX-grown mesh
+    assert organic["partition_heal_seconds_max"] is not None
+    # discovery actually grew the topology: every reporting node's book
+    # reaches beyond its seed, and somewhere in the fleet a node holds a
+    # near-complete view (churn respawns legitimately reboot with young
+    # books, so the floor is per-node modest + fleet-wide strong)
+    books = organic["addrbook_sizes"]
+    assert books, "organic run reported no address books"
+    assert all(size >= 2 for size in books.values()), books
+    assert max(books.values()) >= n - 2, books
+    amp_organic = organic["gossip_votes_per_vote_needed"]
+    assert amp_organic is not None and amp_organic >= 1.0
+
+    strict = run("strict", "full", 19000)
+    amp_strict = strict["gossip_votes_per_vote_needed"]
+    assert amp_strict is not None and amp_strict >= 1.0
+    print(f"[fleet-organic] amplification PEX-grown {amp_organic} "
+          f"vs strict wiring {amp_strict}; "
+          f"heal {organic['partition_heal_seconds_max']:.2f}s; "
+          f"books {sorted(books.values())}")
 
 
 @pytest.mark.slow

@@ -137,8 +137,9 @@ class TestAddrBookBanChurn:
         class _StubSwitch:
             peers: dict = {}
 
-            async def dial_peers_async(self, addrs, persistent=False):
-                dialed.extend(addrs)
+            async def dial_peer(self, addr):
+                dialed.append(addr)
+                return True
 
         pex = PEXReactor(book, max_outbound=2)
         pex.set_switch(_StubSwitch())

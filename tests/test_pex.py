@@ -1,16 +1,34 @@
 """Peer exchange: address book semantics (new/old graduation, selection,
 bans, persistence) and peer discovery over real sockets — a node that only
 knows one peer learns and dials a third through PEX (reference:
-p2p/pex/addrbook_test.go, pex_reactor_test.go)."""
+p2p/pex/addrbook_test.go, pex_reactor_test.go).
+
+Discovery-plane hardening coverage: hashed-bucket geometry invariants
+under randomized churn, the per-source-group occupancy bound under a
+sybil flood, address-hijack rejection, durable save/load (nonce + bucket
+placement survive a restart), corrupt-file quarantine, torn-write
+atomicity through the addrbook.save disk-chaos site, and ensure-peers
+outbound diversity + dial-failure feedback."""
 
 import asyncio
+import random
 import time
 
+import pytest
+
 from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.libs import diskchaos
 from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.libs import metrics as cmtmetrics
 from cometbft_tpu.p2p.key import NodeKey
 from cometbft_tpu.p2p.node_info import NodeInfo
-from cometbft_tpu.p2p.pex import AddrBook, NetAddress, PEXReactor
+from cometbft_tpu.p2p.pex import AddrBook, NetAddress, PEXReactor, group16
+from cometbft_tpu.p2p.pex.addrbook import (
+    BUCKET_SIZE,
+    MAX_NEW_FAILURES,
+    NEW_BUCKETS_PER_GROUP,
+)
+from cometbft_tpu.p2p.pex.byzantine import ByzantinePexHarness, forged_claims
 from cometbft_tpu.p2p.switch import Switch
 from cometbft_tpu.p2p.transport import Transport
 
@@ -49,6 +67,278 @@ class TestAddrBook:
         book2 = AddrBook(path, our_id="me")
         assert book2.has("n1") and book2._addrs["n1"].is_old
         assert book2._addrs["n1"].addr == "n1@10.0.0.1:26656"
+
+
+class TestAddrBookGeometry:
+    """The hashed-bucket eclipse defenses (addrbook.go:70-140)."""
+
+    def test_group16(self):
+        assert group16("10.66.3.4") == "10.66"
+        assert group16("seed.example.COM") == "seed.example.com"
+        assert group16("") == "local"
+
+    def test_source_group_occupancy_bounded(self):
+        """A 32-identity sybil swarm behind ONE /16 flooding thousands of
+        forged claims occupies at most the geometric bound of the NEW
+        set, confined to the source group's reachable buckets."""
+        book = AddrBook(our_id="me", rng=random.Random(11))
+        ledger = ByzantinePexHarness.flood_book(
+            book, n_identities=32, claims_per_identity=128)
+        assert ledger["claimed"] >= 4000
+        s = book.stats()
+        assert s["max_src_group_occupancy_pct"] <= \
+            s["src_group_occupancy_bound_pct"]
+        # every flooded entry landed inside the source group's
+        # NEW_BUCKETS_PER_GROUP-bucket allowance
+        allowed = book.new_buckets_for_group("203.0")
+        assert len(allowed) <= NEW_BUCKETS_PER_GROUP
+        used = {b for b, bucket in enumerate(book._new) if bucket}
+        assert used <= allowed
+
+    def test_hijack_rejected_and_counted(self):
+        """NEW-source gossip must not move the host:port of an address we
+        successfully dialed — and the rejection is counted."""
+        book = AddrBook(our_id="me")
+        book.metrics = cmtmetrics.P2PMetrics(cmtmetrics.Registry())
+        book.add_address(NetAddress(node_id="n1", host="1.2.3.4", port=1))
+        book.mark_good("n1")
+        assert not book.add_address(
+            NetAddress(node_id="n1", host="6.6.6.6", port=666,
+                       src_id="attacker"))
+        assert book._addrs["n1"].host == "1.2.3.4"
+        assert book._addrs["n1"].port == 1
+        assert book.metrics.addrbook_overwrite_rejected.value() == 1
+        # a NEW (never-dialed) address may still be refreshed by gossip
+        book.add_address(NetAddress(node_id="n2", host="2.2.2.2", port=2))
+        book.add_address(NetAddress(node_id="n2", host="3.3.3.3", port=3))
+        assert book._addrs["n2"].host == "3.3.3.3"
+        assert book.metrics.addrbook_overwrite_rejected.value() == 1
+
+    def test_protected_survives_bucket_pressure(self):
+        """All claims sharing (claimed /16, source /16) collapse into ONE
+        bucket; flooding hundreds into it churns the bucket at
+        BUCKET_SIZE but never evicts the protected entry."""
+        book = AddrBook(our_id="me")
+        book.mark_protected("keeper")
+        book.add_address(NetAddress(node_id="keeper", host="10.66.0.200",
+                                    port=1, src_host="203.0.0.1"))
+        for k in range(300):
+            book.add_address(NetAddress(node_id=f"s{k}",
+                                        host=f"10.66.0.{k % 200}",
+                                        port=26656, src_host="203.0.0.1"))
+        assert book.has("keeper")
+        assert all(len(b) <= BUCKET_SIZE for b in book._new)
+        assert book.size() <= BUCKET_SIZE
+
+    def test_dial_failure_backoff_and_expiry(self):
+        """A failed address backs off exponentially and expires from the
+        NEW set after MAX_NEW_FAILURES; a protected one never does."""
+        book = AddrBook(our_id="me", rng=random.Random(3))
+        book.add_address(NetAddress(node_id="flaky", host="8.8.8.8", port=1))
+        book.mark_attempt("flaky")
+        # freshly failed: suppressed by backoff, not picked
+        assert book.pick_address() is None
+        # rewind the clock past the backoff window: picked again
+        book._addrs["flaky"].last_attempt -= 11.0
+        assert book.pick_address().node_id == "flaky"
+        for _ in range(MAX_NEW_FAILURES + 1):
+            book.mark_attempt("flaky")
+        assert not book.has("flaky")
+        book.mark_protected("pinned")
+        book.add_address(NetAddress(node_id="pinned", host="8.8.4.4", port=2))
+        for _ in range(MAX_NEW_FAILURES * 2):
+            book.mark_attempt("pinned")
+        assert book.has("pinned")
+
+    def test_bucket_invariants_under_randomized_churn(self):
+        """Randomized add/attempt/good/bad/remove churn: the index, the
+        bucket arrays, and the geometry stay mutually consistent."""
+        rng = random.Random(1234)
+        book = AddrBook(our_id="me", rng=random.Random(5))
+        ids = []
+        for step in range(2000):
+            op = rng.randrange(10)
+            if op < 5 or not ids:
+                nid = f"n{step}"
+                book.add_address(NetAddress(
+                    node_id=nid,
+                    host=f"{rng.randrange(1, 200)}.{rng.randrange(256)}"
+                         f".0.{rng.randrange(1, 255)}",
+                    port=26656,
+                    src_host=f"{rng.randrange(1, 50)}.0.0.1"))
+                ids.append(nid)
+            elif op < 7:
+                book.mark_attempt(rng.choice(ids))
+            elif op < 8:
+                book.mark_good(rng.choice(ids))
+            elif op < 9:
+                book.mark_bad(rng.choice(ids), ban_seconds=60)
+            else:
+                book.remove(rng.choice(ids))
+        # invariants
+        seen = set()
+        for b, bucket in enumerate(book._new):
+            assert len(bucket) <= BUCKET_SIZE
+            for nid, a in bucket.items():
+                assert not a.is_old
+                assert book._bucket_of[nid] == b == book.new_bucket_index(a)
+                assert b in book.new_buckets_for_group(a.src_group)
+                seen.add(nid)
+        for b, bucket in enumerate(book._old):
+            assert len(bucket) <= BUCKET_SIZE
+            for nid, a in bucket.items():
+                assert a.is_old
+                assert book._bucket_of[nid] == b == book.old_bucket_index(a)
+                seen.add(nid)
+        assert seen == set(book._addrs)
+
+
+class TestAddrBookDurability:
+    def test_roundtrip_nonce_and_bucket_placement(self, tmp_path):
+        """The persisted nonce pins the geometry: every entry reloads
+        into the SAME bucket, OLD stays OLD, bans and attempt counts
+        survive."""
+        path = str(tmp_path / "addrbook.json")
+        book = AddrBook(path, our_id="me")
+        for a in forged_claims(40, group="20.1", tag="rt"):
+            a.src_host = "7.7.7.7"
+            book.add_address(a)
+        good = sorted(book._addrs)[:5]
+        for nid in good:
+            book.mark_good(nid)
+        book.mark_bad(good[0], ban_seconds=3600)
+        book.mark_attempt(sorted(book._addrs)[10])
+        book.save()
+        book2 = AddrBook(path, our_id="me")
+        assert book2._nonce == book._nonce
+        assert set(book2._addrs) == set(book._addrs)
+        for nid, a in book._addrs.items():
+            b2 = book2._addrs[nid]
+            assert book2._bucket_of[nid] == book._bucket_of[nid]
+            assert b2.is_old == a.is_old
+            assert b2.src_host == a.src_host
+        assert book2._addrs[good[0]].banned_until > time.time()
+
+    def test_corrupt_book_quarantined(self, tmp_path):
+        """A torn/garbage book file must not brick the boot: it moves to
+        .corrupt, the node starts with an empty book, the error is kept
+        for the boot log."""
+        path = str(tmp_path / "addrbook.json")
+        with open(path, "w") as f:
+            f.write('{"nonce": "abc", "addrs": [{"id": TORN')
+        book = AddrBook(path, our_id="me")
+        assert book.size() == 0
+        assert book.load_error
+        assert book.quarantined_path == path + ".corrupt"
+        import os
+        assert os.path.exists(path + ".corrupt")
+        assert not os.path.exists(path)
+        assert book.stats()["quarantined"]
+        # the quarantined book keeps working (and can save over the slot)
+        book.add_address(NetAddress(node_id="n1", host="1.1.1.1", port=1))
+        book.save()
+        assert AddrBook(path, our_id="me").has("n1")
+
+    def test_torn_save_leaves_previous_book_intact(self, tmp_path):
+        """diskchaos addrbook.save=torn_write: power dies mid-rename —
+        the previous good book survives byte-for-byte and reloads."""
+        path = str(tmp_path / "addrbook.json")
+        book = AddrBook(path, our_id="me")
+        book.add_address(NetAddress(node_id="n1", host="1.1.1.1", port=1))
+        book.add_address(NetAddress(node_id="n2", host="2.2.2.2", port=2))
+        book.save()
+        with open(path, "rb") as f:
+            good = f.read()
+
+        def hook(site):
+            raise diskchaos.SimulatedCrash(site)
+
+        diskchaos.set_crash_hook(hook)
+        try:
+            diskchaos.arm("addrbook.save", "torn_write", count=1)
+            book.add_address(NetAddress(node_id="n3", host="3.3.3.3", port=3))
+            with pytest.raises(diskchaos.SimulatedCrash):
+                book.save()
+        finally:
+            diskchaos.set_crash_hook(None)
+            diskchaos.reset()
+        with open(path, "rb") as f:
+            assert f.read() == good
+        book2 = AddrBook(path, our_id="me")
+        assert book2.has("n1") and book2.has("n2") and not book2.has("n3")
+        # with the fault cleared the same save lands
+        book.save()
+        assert AddrBook(path, our_id="me").has("n3")
+
+
+class _DialRecorder:
+    """Stub switch capturing PEXReactor dial outcomes."""
+
+    def __init__(self, succeed: bool = True):
+        self.peers: dict = {}
+        self.dialed: list[str] = []
+        self.succeed = succeed
+
+    async def dial_peer(self, addr: str) -> bool:
+        self.dialed.append(addr)
+        return self.succeed
+
+
+class TestEnsurePeersDiversity:
+    def test_group_cap_limits_one_netblock(self):
+        """One /16 cannot own the outbound slot budget: ensure-peers
+        stops dialing a group at max_group_outbound, while protected
+        (persistent) addresses bypass the cap."""
+        book = AddrBook(our_id="me", rng=random.Random(7))
+        for k in range(12):
+            book.add_address(NetAddress(node_id=f"a{k}", host=f"10.1.0.{k+1}",
+                                        port=26656))
+        book.add_address(NetAddress(node_id="other", host="10.2.0.1",
+                                    port=26656))
+        sw = _DialRecorder()
+        pex = PEXReactor(book, max_outbound=8, max_group_outbound=2,
+                         rng=random.Random(9), logger=cmtlog.nop())
+        pex.set_switch(sw)
+        asyncio.run(pex._ensure_peers())
+        by_group: dict = {}
+        for d in sw.dialed:
+            g = group16(d.partition("@")[2].rpartition(":")[0])
+            by_group[g] = by_group.get(g, 0) + 1
+        assert sw.dialed
+        assert all(c <= 2 for c in by_group.values())
+        # protected bypasses the cap: a third 10.1 dial becomes possible
+        book2 = AddrBook(our_id="me", rng=random.Random(7))
+        for k in range(3):
+            book2.add_address(NetAddress(node_id=f"p{k}",
+                                         host=f"10.1.0.{k+1}", port=26656))
+            book2.mark_protected(f"p{k}")
+        sw2 = _DialRecorder()
+        pex2 = PEXReactor(book2, max_outbound=8, max_group_outbound=2,
+                          rng=random.Random(9), logger=cmtlog.nop())
+        pex2.set_switch(sw2)
+        asyncio.run(pex2._ensure_peers())
+        assert len(sw2.dialed) == 3
+
+    def test_failed_dials_feed_backoff(self):
+        """A dial failure is RECORDED (attempts + backoff): the next
+        ensure round does not re-dial the dead address."""
+        book = AddrBook(our_id="me", rng=random.Random(2))
+        book.add_address(NetAddress(node_id="dead", host="9.9.9.9",
+                                    port=26656))
+        sw = _DialRecorder(succeed=False)
+        pex = PEXReactor(book, max_outbound=4, logger=cmtlog.nop())
+        pex.set_switch(sw)
+        asyncio.run(pex._ensure_peers())
+        assert len(sw.dialed) == 1
+        assert book._addrs["dead"].attempts == 1
+        # immediately after the failure: backoff suppresses the re-dial
+        asyncio.run(pex._ensure_peers())
+        assert len(sw.dialed) == 1
+        # past the backoff window the address is retried
+        book._addrs["dead"].last_attempt -= 11.0
+        asyncio.run(pex._ensure_peers())
+        assert len(sw.dialed) == 2
+        assert book._addrs["dead"].attempts == 2
 
 
 def _make_node(moniker: str, max_outbound=10, ensure_interval=0.2):

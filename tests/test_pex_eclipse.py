@@ -1,0 +1,117 @@
+"""Eclipse-resistance acceptance: a REAL sybil swarm vs the hashed book.
+
+One adversary mints 32 node identities behind one /16 (in-process that is
+loopback — the single-hosting-provider shape), connects every identity to
+a victim validator inside a live 4-validator TCP consensus net, and
+answers the victim's PEX requests with floods of forged addresses. The
+defense wins when:
+
+  - the victim's NEW set never grants the swarm's source group more than
+    the hashed-bucket geometric bound, and every flooded entry is
+    confined to that group's reachable buckets;
+  - the victim keeps its honest outbound peers (protected persistent
+    entries are never evicted, never group-capped away);
+  - consensus keeps committing through the flood.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from cometbft_tpu.libs import log as cmtlog
+from cometbft_tpu.p2p.pex import AddrBook, PEXReactor
+from cometbft_tpu.p2p.pex.byzantine import ByzantinePexHarness
+from tests.tcp_net_harness import make_tcp_net
+
+N_SYBILS = 32
+
+
+@pytest.mark.chaos
+class TestPexEclipse:
+    def test_sybil_flood_bounded_and_victim_commits(self):
+        async def main():
+            net = await make_tcp_net(4, chain_id="eclipse-chain")
+            victim = net.nodes[0]
+            honest_ids = {n.node_key.id() for n in net.nodes[1:]}
+
+            # every node runs PEX (as in production — a peer with no PEX
+            # reactor drops the connection on the first PexRequest); the
+            # VICTIM gets the full discovery stack: a hashed book with
+            # its honest peers protected (they are persistent) and an
+            # aggressive ensure cadence so it actively dials INTO the
+            # swarm during the test window — the worst case for a victim
+            book = AddrBook(our_id=victim.node_key.id())
+            book.metrics = victim.p2p_metrics
+            for hid in honest_ids:
+                book.mark_protected(hid)
+            pex = PEXReactor(book, max_outbound=8, ensure_interval=0.25,
+                             max_group_outbound=6, rng=random.Random(42),
+                             logger=cmtlog.nop())
+            victim.switch.add_reactor("PEX", pex)
+            for n in net.nodes[1:]:
+                n.switch.add_reactor("PEX", PEXReactor(
+                    AddrBook(our_id=n.node_key.id()),
+                    rng=random.Random(7), logger=cmtlog.nop()))
+
+            harness = ByzantinePexHarness(
+                "eclipse-chain", n_identities=N_SYBILS,
+                claims_per_reply=200, total_claims=2048,
+                # camouflage: advertise and black-hole the victim's
+                # channels so consensus traffic does not out the sybils
+                mimic_channels=victim.transport.node_info.channels)
+            try:
+                await net.start()
+                await net.wait_for_height(2)
+
+                await harness.start()
+                connected = await harness.dial_victim(victim.p2p_addr)
+                assert connected >= N_SYBILS - 2, \
+                    f"swarm only landed {connected} of {N_SYBILS} connects"
+
+                # soak: the victim's ensure loop dials into the swarm,
+                # requests addresses, and eats floods — while consensus
+                # must keep committing underneath
+                h0 = victim.block_store.height()
+                deadline = asyncio.get_running_loop().time() + 20.0
+                while (harness.floods_sent < 3
+                       and asyncio.get_running_loop().time() < deadline):
+                    await asyncio.sleep(0.1)
+                assert harness.floods_sent >= 1, "no flood was ever served"
+                await net.wait_for_height(h0 + 3, timeout=60.0)
+
+                # 1) occupancy bound: the swarm's source group (loopback,
+                # shared with the honest net — strictly WORSE for the
+                # defender) holds no more than the geometric ceiling, and
+                # every flooded claim sits inside its bucket allowance
+                s = book.stats()
+                assert s["max_src_group_occupancy_pct"] <= \
+                    s["src_group_occupancy_bound_pct"], s
+                allowed = book.new_buckets_for_group("127.0")
+                used = {b for b, bucket in enumerate(book._new)
+                        for a in bucket.values() if a.src_group == "127.0"}
+                assert used <= allowed, \
+                    f"flood escaped its bucket allowance: {used - allowed}"
+                # the flood genuinely landed forged claims in the book
+                assert any(a.host.startswith("10.66.")
+                           for a in book._addrs.values()), \
+                    "no forged claim ever reached the book"
+
+                # 2) the victim kept every honest outbound peer
+                honest_out = [p for p in victim.switch.peers.values()
+                              if p.outbound and p.id in honest_ids]
+                assert len(honest_out) >= 1, \
+                    "the swarm displaced every honest outbound peer"
+                assert all(book.has(hid) or book.is_protected(hid)
+                           for hid in honest_ids)
+
+                # 3) still committing after the flood (asserted above via
+                # wait_for_height) — and one more height for good measure
+                await net.wait_for_height(victim.block_store.height() + 1,
+                                          timeout=30.0)
+            finally:
+                await harness.stop()
+                await net.stop()
+            assert harness.addrs_claimed >= harness.floods_sent * 200
+
+        asyncio.run(main())
