@@ -965,6 +965,167 @@ def bench_bls(detail: dict) -> None:
     detail["bls"] = d
 
 
+def bench_cert(detail: dict) -> None:
+    """Commit-certificate scenario (cometbft_tpu/cert/): the FULL
+    consumer path — decode-shaped CommitCertificate -> bitmap tally ->
+    sign-bytes reconstruction -> signer-pubkey aggregation -> ONE
+    pairing-product check (verify_certificate) — graded against the raw
+    aggregate path (sig-sum + pairing, what bench_bls measures) and
+    batched per-lane ed25519, at BENCH_CERT_SIZES validators.
+
+    Like bench_bls, sizes above BENCH_CERT_MEASURE_CAP are extrapolated
+    from the measured linear model on CPU hosts (every O(n) term is
+    point adds / row reconstruction; the pairing is O(1)). Serve bytes
+    are EXACT at every size — encoding needs no crypto — and make the
+    transport headline: certificate bytes per commit grow one BIT per
+    validator (the bitmap) vs ~sig+timestamp per validator classic."""
+    from cometbft_tpu.cert import build_certificate, verify_certificate
+    from cometbft_tpu.crypto import bls12381
+    from cometbft_tpu.crypto import fallback as O
+    from cometbft_tpu.libs.bits import BitArray
+    from cometbft_tpu.types.basic import BlockID, BlockIDFlag, PartSetHeader
+    from cometbft_tpu.types.commit import Commit, CommitSig
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+    from cometbft_tpu.utils import cmttime as _ct
+
+    sizes = [int(s) for s in os.environ.get(
+        "BENCH_CERT_SIZES", "1000,10000,100000").split(",")]
+    import jax as _jax
+
+    on_accel = any(d.platform != "cpu" for d in _jax.devices())
+    cap = int(os.environ.get(
+        "BENCH_CERT_MEASURE_CAP", "0" if on_accel else "2048"))
+    chain_id = "bench-cert"
+    height, round_ = 12345, 0
+    block_id = BlockID(hash=b"\x11" * 32,
+                       part_set_header=PartSetHeader(1, b"\x22" * 32))
+    ts = _ct.Timestamp(1_700_000_000, 0)
+    d: dict = {"sizes": sizes, "cert_verify_ms": {}, "cert_build_ms": {},
+               "aggregate_ms": {}, "batched_ed25519_ms": {},
+               "serve_bytes": {}, "classic_commit_bytes": {}}
+    n_max = max(sizes)
+    n_meas = min(n_max, cap) if cap else n_max
+    # canonical precommit sign-bytes for this (chain, height, block):
+    # identical for every signer (one shared timestamp), so sig_i =
+    # sk_i * H(m) chains by one G2 add per lane — same incremental
+    # material trick as bench_bls, but the pubkeys land in a REAL
+    # ValidatorSet and the commit is a REAL Commit
+    probe = Commit(height=height, round_=round_, block_id=block_id,
+                   signatures=[CommitSig(block_id_flag=BlockIDFlag.COMMIT,
+                                         timestamp=ts)])
+    from cometbft_tpu.libs.prefixrows import as_bytes as _as_bytes
+    msg = _as_bytes(probe.vote_sign_bytes_all(chain_id).rows_for([0])[0])
+    h = O.bls_hash_to_g2(msg, bls12381.DST)
+    _progress("cert: building incremental keys/sigs")
+    pubs_all, sigs_all = [], []
+    pk_j = O._ec_from_affine(O.BLS_G1)
+    sg_j = O._ec_from_affine(h)
+    g1_j = O._ec_from_affine(O.BLS_G1)
+    h_j = O._ec_from_affine(h)
+    for _ in range(n_meas):
+        pubs_all.append(O.bls_g1_compress(O._ec_affine(O._FpOps, pk_j)))
+        sigs_all.append(O.bls_g2_compress(O._ec_affine(O._Fp2Ops, sg_j)))
+        pk_j = O._ec_add(O._FpOps, pk_j, g1_j)
+        sg_j = O._ec_add(O._Fp2Ops, sg_j, h_j)
+    meas = sorted({min(s, n_meas) for s in sizes})
+    fit_v, fit_b, fit_a = [], [], []
+    for n in meas:
+        _progress(f"cert: build+verify n={n}")
+        vals = ValidatorSet([
+            Validator(address=i.to_bytes(20, "big"),
+                      pub_key=bls12381.PubKey(pubs_all[i]), voting_power=10)
+            for i in range(n)])
+        commit = Commit(height=height, round_=round_, block_id=block_id,
+                        signatures=[
+                            CommitSig(block_id_flag=BlockIDFlag.COMMIT,
+                                      timestamp=ts, signature=sigs_all[i])
+                            for i in range(n)])
+        t0 = time.perf_counter()
+        cert = build_certificate(chain_id, vals, commit)
+        tb = (time.perf_counter() - t0) * 1e3
+        assert cert is not None
+        t0 = time.perf_counter()
+        verify_certificate(cert, chain_id, vals)  # raises on failure
+        tv = (time.perf_counter() - t0) * 1e3
+        # raw aggregate comparison on the same material: sig-sum +
+        # summed-pubkey pairing, no certificate object in the loop
+        t0 = time.perf_counter()
+        agg = O.bls_aggregate(sigs_all[:n])
+        acc = None
+        for p in pubs_all[:n]:
+            acc = O._ec_add(O._FpOps, acc,
+                            O._ec_from_affine(O.bls_g1_decompress(p)))
+        assert O.bls_pairing_product_is_one(
+            [(O._NEG_G1, O.bls_g2_decompress(agg)),
+             (O._ec_affine(O._FpOps, acc), h)])
+        ta = (time.perf_counter() - t0) * 1e3
+        fit_v.append((n, tv))
+        fit_b.append((n, tb))
+        fit_a.append((n, ta))
+
+    def _fit(pts):
+        if len(pts) >= 2:
+            (n1, t1), (n2, t2) = pts[0], pts[-1]
+            slope = (t2 - t1) / max(1, (n2 - n1))
+            return t1 - slope * n1, slope
+        return pts[0][1], 0.0
+
+    for key, pts in (("cert_verify_ms", fit_v), ("cert_build_ms", fit_b),
+                     ("aggregate_ms", fit_a)):
+        base, slope = _fit(pts)
+        got = dict(pts)
+        for n in sizes:
+            d[key][str(n)] = round(got[n] if n in got else base + slope * n, 1)
+    d["mode"] = ("measured" if n_meas >= n_max else
+                 f"measured to {n_meas}, extrapolated beyond (linear in n; "
+                 f"BENCH_CERT_MEASURE_CAP)")
+    # exact transport bytes at every size (no crypto needed to encode)
+    from cometbft_tpu.cert import CommitCertificate
+    for n in sizes:
+        k = n - n // 3  # >2/3 signer bitmap
+        ba = BitArray(n)
+        for i in range(k):
+            ba.set_index(i, True)
+        c = CommitCertificate(
+            chain_id=chain_id, height=height, round_=round_,
+            block_id=block_id, valset_hash=b"\x33" * 32, n_vals=n,
+            signers=ba, ts_base=ts, ts_deltas=[0] * k, agg_sig=b"\x44" * 96)
+        d["serve_bytes"][str(n)] = len(c.encode())
+        # classic transport: k real sigs + timestamps + flags
+        classic = Commit(height=height, round_=round_, block_id=block_id,
+                         signatures=[
+                             CommitSig(block_id_flag=BlockIDFlag.COMMIT,
+                                       timestamp=ts,
+                                       validator_address=b"\x55" * 20,
+                                       signature=b"\x66" * 96)
+                             if i < k else CommitSig.absent()
+                             for i in range(n)])
+        d["classic_commit_bytes"][str(n)] = len(classic.to_proto())
+    # batched-ed25519 per-lane comparison (same method as bench_bls)
+    _progress("cert: batched ed25519 comparison")
+    from cometbft_tpu.ops import ed25519_kernel as EK
+    edn = min(2048, n_meas)
+    _, epubs, emsgs, esigs = _mk_sigs(edn, min(edn, 256))
+    EK.verify_batch(epubs, emsgs, esigs)  # warm the shape
+    t0 = time.perf_counter()
+    ok, _m = EK.verify_batch(epubs, emsgs, esigs)
+    ed_per_sig = (time.perf_counter() - t0) * 1e3 / edn
+    assert ok
+    for n in sizes:
+        d["batched_ed25519_ms"][str(n)] = round(ed_per_sig * n, 1)
+    ten_k = d["cert_verify_ms"].get("10000")
+    if ten_k is not None:
+        d["cert_verify_ms_10k"] = ten_k
+        detail["cert_verify_ms_10k"] = ten_k
+    sb = d["serve_bytes"].get("10000")
+    if sb is not None:
+        d["serve_bytes_per_commit"] = sb
+    d["note"] = ("cert verify = bitmap tally + signer-pubkey aggregation "
+                 "+ ONE pairing; serve bytes grow 1 bit/validator vs "
+                 "~100 B/validator classic")
+    detail["cert"] = d
+
+
 def bench_consensus_tpu(detail: dict) -> None:
     """VERDICT r2 item 8: the N=4 in-process net with batch_vote_verification
     flushing through the REAL device backend — per-height commit latency."""
@@ -1967,7 +2128,7 @@ def main() -> dict:
     # -- subsystem benches (each guarded: a failure reports, not aborts)
     for fn in (bench_blocksync, bench_mixed_megacommit, bench_attribution,
                bench_light_client, bench_light_fleet, bench_bls,
-               bench_consensus_tpu, bench_scheduler, bench_storage,
+               bench_cert, bench_consensus_tpu, bench_scheduler, bench_storage,
                bench_soak, bench_mesh, bench_fleet):
         try:
             _progress(fn.__name__)

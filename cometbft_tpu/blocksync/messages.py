@@ -8,6 +8,15 @@ One Message envelope, oneof by field number:
   3 BlockResponse{1:block, 2:ext_commit}
   4 StatusRequest{}
   5 StatusResponse{1:height, 2:base}
+
+Framework extension (no reference analog) — commit-certificate exchange,
+negotiated via its own channel (0x25, reactor.py CERT_CHANNEL) exactly
+like the consensus VoteSummary idiom, so non-supporting peers never see
+these frames:
+
+  6 CertRequest{1:height}
+  7 CertResponse{1:height, 2:cert}   (cert = encoded CommitCertificate)
+  8 NoCertResponse{1:height}
 """
 
 from __future__ import annotations
@@ -41,6 +50,22 @@ class StatusRequest:
 
 
 @dataclass
+class CertRequest:
+    height: int
+
+
+@dataclass
+class CertResponse:
+    height: int
+    cert: bytes  # encoded CommitCertificate (opaque at this layer)
+
+
+@dataclass
+class NoCertResponse:
+    height: int
+
+
+@dataclass
 class StatusResponse:
     height: int
     base: int
@@ -67,6 +92,13 @@ def encode(msg) -> bytes:
             Writer().varint_i64(1, msg.height).varint_i64(2, msg.base).output(),
             always=True,
         )
+    elif isinstance(msg, CertRequest):
+        w.message(6, Writer().varint_i64(1, msg.height).output(), always=True)
+    elif isinstance(msg, CertResponse):
+        inner = Writer().varint_i64(1, msg.height).bytes(2, msg.cert)
+        w.message(7, inner.output(), always=True)
+    elif isinstance(msg, NoCertResponse):
+        w.message(8, Writer().varint_i64(1, msg.height).output(), always=True)
     else:
         raise TypeError(f"cannot encode blocksync message {type(msg)}")
     return w.output()
@@ -114,4 +146,24 @@ def decode(data: bytes):
             else:
                 br.skip(w2)
         return StatusResponse(height, base)
+    if f == 6 or f == 8:
+        height = 0
+        while not br.at_end():
+            g, w2 = br.read_tag()
+            if g == 1:
+                height = br.read_varint_i64()
+            else:
+                br.skip(w2)
+        return CertRequest(height) if f == 6 else NoCertResponse(height)
+    if f == 7:
+        height, cert = 0, b""
+        while not br.at_end():
+            g, w2 = br.read_tag()
+            if g == 1:
+                height = br.read_varint_i64()
+            elif g == 2:
+                cert = br.read_bytes()
+            else:
+                br.skip(w2)
+        return CertResponse(height, cert)
     raise ValueError(f"unknown blocksync message field {f}")

@@ -1,0 +1,30 @@
+"""Commit-certificate plane: succinct finality certificates.
+
+A CommitCertificate condenses an all-BLS commit into one aggregated G2
+signature plus a signer bitmap — produced once at commit finalize,
+verified anywhere with ONE pairing-product check, and served to every
+consumer (RPC, light fleet, blocksync) so commit transport and
+re-verification cost stays ~independent of committee size.
+"""
+
+from cometbft_tpu.cert.certificate import (
+    CommitCertificate,
+    ErrCertInvalid,
+    attests_commit,
+    build_certificate,
+    matches_commit,
+    verify_certificate,
+)
+from cometbft_tpu.cert.plane import CertPlane
+from cometbft_tpu.cert.store import CertStore
+
+__all__ = [
+    "CommitCertificate",
+    "ErrCertInvalid",
+    "CertPlane",
+    "CertStore",
+    "attests_commit",
+    "build_certificate",
+    "matches_commit",
+    "verify_certificate",
+]

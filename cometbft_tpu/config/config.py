@@ -467,6 +467,33 @@ class StorageConfig:
 
 
 @dataclass
+class CertConfig:
+    """Commit-certificate plane (cert/ — no reference analog): succinct
+    finality certificates produced once at commit finalize, verified
+    with ONE pairing-product check, served over RPC and a negotiated
+    blocksync channel. Only all-BLS validator sets certify; on any
+    other set the plane stays idle and every consumer keeps the classic
+    per-vote path."""
+
+    enabled: bool = True
+    # certify historical heights [store base, head] in the background
+    backfill: bool = True
+    # heights per backfill planning batch (bounds the per-pass work)
+    backfill_batch: int = 32
+    # store-poll cadence (seconds) for nodes WITHOUT an event bus, and
+    # the backfill worker's idle sleep
+    poll_interval: float = 1.0
+    # serve certificates to peers on the negotiated 0x25 channel
+    serve: bool = True
+
+    def validate_basic(self) -> None:
+        if self.backfill_batch < 1:
+            raise ValueError("cert.backfill_batch must be >= 1")
+        if self.poll_interval <= 0:
+            raise ValueError("cert.poll_interval must be positive")
+
+
+@dataclass
 class GRPCConfig:
     """config.go:520-543 GRPCConfig: the gRPC service surface. Empty
     addresses disable the listeners. The pruning (data-companion) service
@@ -564,6 +591,7 @@ class Config:
     state_sync: StateSyncConfig = field(default_factory=StateSyncConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    cert: CertConfig = field(default_factory=CertConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
     home: str = "."  # set at load time, not serialized
 
@@ -572,7 +600,7 @@ class Config:
         for section in (self.base, self.crypto, self.light, self.rpc,
                         self.p2p, self.mempool, self.block_sync,
                         self.state_sync, self.storage, self.tx_index,
-                        self.instrumentation):
+                        self.cert, self.instrumentation):
             section.validate_basic()
 
     # ------------------------------------------------------------ paths
@@ -614,6 +642,7 @@ class Config:
         ("state_sync", "statesync"),
         ("storage", "storage"),
         ("tx_index", "tx_index"),
+        ("cert", "cert"),
         ("instrumentation", "instrumentation"),
     )
 

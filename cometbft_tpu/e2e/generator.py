@@ -38,7 +38,10 @@ VOTE_EXT_HEIGHT_OFFSETS = (0, 2)  # 0 = disabled
 # admission waves at its RPC (the chain must keep advancing, sheds must
 # land on /metrics); rpc-flood respawns with a 1-slot write budget and
 # floods concurrent commit-wait writes (excess must shed -32005 while
-# the exempt control plane keeps serving).
+# the exempt control plane keeps serving). cert-backfill kills a node,
+# wipes its commit-certificate store, and respawns it mid-fleet — the
+# backfill worker must re-certify the retained range (needs an all-BLS
+# net: drawing it flips the manifest to key_type bls12381).
 PERTURBATIONS = {"kill": 0.1, "pause": 0.1, "restart": 0.1,
                  "device-kill": 0.05, "device-flap": 0.05,
                  "chip-kill:1": 0.05, "chip-flap:1": 0.05,
@@ -47,14 +50,15 @@ PERTURBATIONS = {"kill": 0.1, "pause": 0.1, "restart": 0.1,
                  "crash-storm": 0.05, "crash-storm:abci.apply": 0.03,
                  "disk-fault:bitrot": 0.04, "disk-fault:enospc": 0.03,
                  "disk-fault:slow": 0.03,
-                 "mempool-storm": 0.05, "rpc-flood": 0.04}
+                 "mempool-storm": 0.05, "rpc-flood": 0.04,
+                 "cert-backfill": 0.05}
 # perturbations that kill + respawn the OS process (a memdb node would
 # lose its stores while its out-of-process app keeps state); compared by
 # BASE name (chip-kill:N respawns too)
 RESPAWN_PERTURBATIONS = {"kill", "restart", "device-kill", "device-flap",
                          "chip-kill", "chip-flap", "byzantine", "flood",
                          "light-fleet", "crash-storm", "disk-fault",
-                         "mempool-storm", "rpc-flood"}
+                         "mempool-storm", "rpc-flood", "cert-backfill"}
 
 
 def generate_manifest(rng: random.Random, index: int) -> Manifest:
@@ -114,6 +118,10 @@ def generate_manifest(rng: random.Random, index: int) -> Manifest:
                 p.partition(":")[0] for p in nd.perturb
         } & RESPAWN_PERTURBATIONS:
             nd.database = "sqlite"
+        # certificates only exist on all-BLS validator sets, so drawing
+        # cert-backfill flips the whole net's key scheme
+        if any(p.partition(":")[0] == "cert-backfill" for p in nd.perturb):
+            m.key_type = "bls12381"
     m.validate()
     return m
 

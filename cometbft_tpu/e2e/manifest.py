@@ -52,6 +52,12 @@ class NodeManifest:
     # below, default bitrot; every injected fault must be counted in
     # storage_health and the node must degrade or halt typed, never
     # serve a block that differs from the fault-free run);
+    # certificate faults (cert/plane.py): cert-backfill (kill the node,
+    # wipe its commit-certificate store, respawn mid-fleet while the
+    # chain keeps advancing — the backfill worker must re-certify the
+    # retained range from stored commits, observable via the
+    # cometbft_cert_* /metrics counters and the commit_certificate RPC
+    # route; requires an all-BLS net, i.e. manifest key_type bls12381);
     # overload faults (libs/overload.py): mempool-storm (respawn with a
     # SMALL mempool and drive fire-and-forget admission waves at the
     # node's RPC — the chain must keep advancing, the exempt health
@@ -70,7 +76,7 @@ class NodeManifest:
                      "device-kill", "device-flap",
                      "chip-kill", "chip-flap",
                      "partition", "byzantine", "flood", "light-fleet",
-                     "crash-storm", "disk-fault",
+                     "crash-storm", "disk-fault", "cert-backfill",
                      "mempool-storm", "rpc-flood")
     # perturbations that take a ":<device-index>" argument
     INDEXED_PERTURBATIONS = ("chip-kill", "chip-flap")
@@ -181,9 +187,14 @@ class Manifest:
     # time exceeds this captures a postmortem bundle (consensus/
     # timeline.py) served by the `postmortems` route; <= 0 disables
     height_slow_ms: float = 0.0
+    # validator key scheme for the whole net: "ed25519" (default) or
+    # "bls12381" (all-BLS — what the commit-certificate plane needs to
+    # produce certs; cert-backfill perturbations require it)
+    key_type: str = "ed25519"
     nodes: dict[str, NodeManifest] = field(default_factory=dict)
 
     TOPOLOGIES = ("full", "hub", "regional", "organic")
+    KEY_TYPES = ("ed25519", "bls12381")
     NET_PERTURBATIONS = ("churn-storm", "regional-partition",
                          "byzantine-minority", "minority-partition")
     LINK_PROFILES = ("", "wan", "lossy-wan")
@@ -204,6 +215,15 @@ class Manifest:
         if self.link_profile not in self.LINK_PROFILES:
             raise ValueError(f"unknown link_profile {self.link_profile!r} "
                              f"(expected one of {self.LINK_PROFILES})")
+        if self.key_type not in self.KEY_TYPES:
+            raise ValueError(f"unknown key_type {self.key_type!r} "
+                             f"(expected one of {self.KEY_TYPES})")
+        if self.key_type != "bls12381" and any(
+                NodeManifest.split_perturb(p)[0] == "cert-backfill"
+                for n in self.nodes.values() for p in n.perturb):
+            raise ValueError(
+                "cert-backfill perturbation requires key_type = bls12381 "
+                "(certificates only exist on all-BLS validator sets)")
         if self.link_profile and self.topology != "regional":
             raise ValueError("link_profile requires the regional topology")
         for p in self.net_perturb:
@@ -263,6 +283,7 @@ class Manifest:
             + ", ".join(q(p) for p in self.net_perturb) + "]",
             f"vote_summaries = {'true' if self.vote_summaries else 'false'}",
             f"height_slow_ms = {float(self.height_slow_ms)}",
+            f"key_type = {q(self.key_type)}",
         ]
         if self.initial_state:
             out.append("")
@@ -302,6 +323,7 @@ class Manifest:
             net_perturb=list(doc.get("net_perturb", [])),
             vote_summaries=bool(doc.get("vote_summaries", True)),
             height_slow_ms=float(doc.get("height_slow_ms", 0.0)),
+            key_type=str(doc.get("key_type", "ed25519")),
         )
         for name, nd in doc.get("node", {}).items():
             m.nodes[name] = NodeManifest(

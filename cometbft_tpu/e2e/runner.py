@@ -10,6 +10,7 @@ perturbation matrix instead), and out-of-process ABCI apps are one
 from __future__ import annotations
 
 import concurrent.futures
+import glob
 import json
 import os
 import signal
@@ -201,7 +202,8 @@ def setup(manifest: Manifest, out_dir: str, base_port: int) -> _Net:
         os.makedirs(os.path.join(home, "config"), exist_ok=True)
         os.makedirs(os.path.join(home, "data"), exist_ok=True)
         pvs.append(FilePV.load_or_generate(
-            cfg.priv_validator_key_path(), cfg.priv_validator_state_path()))
+            cfg.priv_validator_key_path(), cfg.priv_validator_state_path(),
+            key_type=manifest.key_type))
         node_keys.append(NodeKey.load_or_gen(cfg.node_key_path()))
 
     gdoc = GenesisDoc(
@@ -218,6 +220,8 @@ def setup(manifest: Manifest, out_dir: str, base_port: int) -> _Net:
     if manifest.vote_extensions_enable_height:
         gdoc.consensus_params.abci.vote_extensions_enable_height = (
             manifest.vote_extensions_enable_height)
+    if manifest.key_type != "ed25519":
+        gdoc.consensus_params.validator.pub_key_types = [manifest.key_type]
     gdoc.validate_and_complete()
 
     peer_addrs = [f"{node_keys[i].id()}@127.0.0.1:{base_port + i}"
@@ -1109,6 +1113,51 @@ def run_manifest(manifest: Manifest, out_dir: str, base_port: int = 29000,
                     _rpc(net, i, "unsafe_disk_chaos?clear=true")
                     _kill(net.node_procs[i])
                     net.node_procs[i] = _spawn_node(net.homes[i])
+                elif p == "cert-backfill":
+                    # kill the node, wipe its commit-certificate store,
+                    # respawn it mid-fleet while the chain keeps
+                    # advancing: the backfill worker must re-certify the
+                    # retained range from stored commits, observable on
+                    # /metrics and over the commit_certificate route
+                    log(f"[{manifest.name}] cert-backfill {name}")
+                    _wait(lambda: _metric_value(
+                        _metrics_text(net, i),
+                        "cometbft_cert_produced_total") >= 1, 150,
+                        f"{name} producing certificates before the wipe")
+                    _kill(net.node_procs[i])
+                    from cometbft_tpu.config import Config
+
+                    cfg = Config.load(net.homes[i])
+                    cert_files = glob.glob(cfg.db_path("certs") + "*")
+                    if not cert_files:
+                        raise RunError(
+                            f"cert-backfill on {name}: no certificate "
+                            f"store files under {cfg.db_path('certs')}*")
+                    for path in cert_files:
+                        os.remove(path)
+                    net.node_procs[i] = _spawn_node(net.homes[i])
+                    _wait(lambda: _metric_value(
+                        _metrics_text(net, i),
+                        "cometbft_cert_backfilled_total") >= 1, 180,
+                        f"{name} backfilling certificates after the wipe")
+                    # churn: the fleet must have kept committing while the
+                    # node re-certified (backfill under a moving head)
+                    if others:
+                        _wait(lambda: min(_height(net, j) for j in others)
+                              >= h0 + 2, 120,
+                              "survivors advancing through the backfill")
+                    # a height committed BEFORE the wipe must answer on
+                    # the RPC route again — re-certified, not replayed
+                    def _recertified(_i=i, _h=max(h0, start_h + 2)):
+                        try:
+                            doc = _rpc(
+                                net, _i, f"commit_certificate?height={_h}")
+                        except Exception:  # noqa: BLE001 - retried
+                            return False
+                        return "certificate" in doc.get("result", {})
+
+                    _wait(_recertified, 120,
+                          f"{name} serving a re-certified early height")
                 elif p == "mempool-storm":
                     # respawn with a SMALL pool so saturation is reachable
                     # without drowning the host, then drive fire-and-forget

@@ -644,6 +644,36 @@ class LightFleetMetrics:
             labels=("reason",))
 
 
+class CertMetrics:
+    """Commit-certificate plane observability (cert/plane.py — no
+    reference analog): the produce/serve/verify/fallback lifecycle of
+    succinct finality certificates. Per-node (the plane rides each
+    node's stores), registered on the node's registry so the e2e runner
+    reads backfill progress off /metrics."""
+
+    def __init__(self, reg: Registry):
+        self.cert_produced = reg.counter(
+            "cert", "produced_total",
+            "Commit certificates produced (event-driven at finalize plus "
+            "backfill)")
+        self.cert_backfilled = reg.counter(
+            "cert", "backfilled_total",
+            "Certificates produced by the historical backfill worker "
+            "(subset of produced_total)")
+        self.cert_served = reg.counter(
+            "cert", "served_total",
+            "Certificates served to consumers (RPC + blocksync)")
+        self.cert_verified = reg.counter(
+            "cert", "verified_total",
+            "Certificates that decided a commit via the one-pairing "
+            "check (light + blocksync consumers)")
+        self.cert_fallbacks = reg.counter(
+            "cert", "fallbacks_total",
+            "Held-certificate verifications that degraded to the classic "
+            "per-vote path (invalid/mismatched/corrupt certificate — "
+            "counted, never a wrong verdict)")
+
+
 class OverloadMetrics:
     """Overload resilience plane observability (libs/overload.py — no
     reference analog): per-plane watermark levels and shed accounting.

@@ -115,6 +115,15 @@ class Client:
         # verified instead of re-verifying hops above them.
         self.checkpoint_source: Callable[[int], Optional[LightBlock]] = (
             lambda h: self.store.light_block_before(h + 1))
+        # certificate short-circuit (cert/): a primary that can serve
+        # commit certificates lets a hop decide on a >2/3 bitmap tally
+        # plus ONE pairing instead of per-vote commit verification —
+        # accept-only, so any unusable certificate (absent, mismatched,
+        # forged) falls through to the classic path bit-identically
+        self.cert_source = getattr(primary, "commit_certificate", None)
+        self.cert_hits = 0       # hops decided by a certificate
+        self.cert_misses = 0     # no certificate at the hop height
+        self.cert_fallbacks = 0  # held a certificate, ran classic anyway
 
     # ----------------------------------------------------------- bootstrap
 
@@ -224,6 +233,35 @@ class Client:
             raise LightClientError("no trusted state to verify against; initialize first")
         await self._backwards(first, new_lb, now)
 
+    async def _try_certificate(
+        self, trusted: LightBlock, target: LightBlock, now: cmttime.Timestamp
+    ) -> bool:
+        """Try to decide the hop trusted→target with a commit certificate.
+
+        True means the hop is verified (one pairing); False means run the
+        classic per-vote path. Certificates are accept-only: any miss,
+        mismatch, forged signature, or sub-trust-level tally returns False
+        and costs nothing but the attempt. Header-shape/expiry errors raise
+        exactly as the classic verifiers would, so callers' except clauses
+        behave identically either way."""
+        if self.cert_source is None:
+            return False
+        cert = await self.cert_source(target.height)
+        if cert is None:
+            self.cert_misses += 1
+            return False
+        ok = verifier.verify_with_certificate(
+            trusted.signed_header, trusted.validator_set,
+            target.signed_header, target.validator_set,
+            self.trusting_period_ns, now, self.max_clock_drift_ns,
+            self.trust_level, cert,
+        )
+        if ok:
+            self.cert_hits += 1
+        else:
+            self.cert_fallbacks += 1
+        return ok
+
     async def _verify_sequential(
         self, trusted: LightBlock, new_lb: LightBlock, now: cmttime.Timestamp
     ) -> None:
@@ -237,11 +275,12 @@ class Client:
                 else await self._light_block_from_primary(height)
             )
             try:
-                verifier.verify_adjacent(
-                    verified.signed_header, interim.signed_header,
-                    interim.validator_set, self.trusting_period_ns, now,
-                    self.max_clock_drift_ns,
-                )
+                if not await self._try_certificate(verified, interim, now):
+                    verifier.verify_adjacent(
+                        verified.signed_header, interim.signed_header,
+                        interim.validator_set, self.trusting_period_ns, now,
+                        self.max_clock_drift_ns,
+                    )
             except LightClientError as e:
                 raise ErrVerificationFailed(verified.height, interim.height, e) from e
             verified = interim
@@ -274,12 +313,17 @@ class Client:
         while True:
             target = block_cache[depth]
             try:
-                verifier.verify(
-                    verified.signed_header, verified.validator_set,
-                    target.signed_header, target.validator_set,
-                    self.trusting_period_ns, now, self.max_clock_drift_ns,
-                    self.trust_level,
-                )
+                # certificate first: a usable certificate decides the hop
+                # with one pairing; anything else (miss, mismatch, forged,
+                # sub-trust-level) runs the unchanged classic path — the
+                # canonical verdicts and errors below come from it
+                if not await self._try_certificate(verified, target, now):
+                    verifier.verify(
+                        verified.signed_header, verified.validator_set,
+                        target.signed_header, target.validator_set,
+                        self.trusting_period_ns, now, self.max_clock_drift_ns,
+                        self.trust_level,
+                    )
             except ErrNewValSetCantBeTrusted:
                 # jump too far: bisect [verified, target]
                 if depth == len(block_cache) - 1:

@@ -874,4 +874,11 @@ class LightFleet:
             if n_verifs and hasattr(self.client.primary, "calls")
             else None,
             "cache": self.cache.stats(),
+            # certificate short-circuit: hops decided by a commit
+            # certificate (one pairing) vs classic per-vote fallbacks
+            "cert": {
+                "hits": self.client.cert_hits,
+                "misses": self.client.cert_misses,
+                "fallbacks": self.client.cert_fallbacks,
+            },
         }

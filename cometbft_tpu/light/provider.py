@@ -69,6 +69,23 @@ class NodeBackedProvider(Provider):
         if pool is not None:
             pool.add_evidence(ev)
 
+    async def commit_certificate(self, height: int):
+        """The node's commit certificate at height, decoded, or None —
+        the light client's short-circuit source (never raises; a missing
+        certificate just means the per-vote path runs)."""
+        plane = getattr(self.node, "cert_plane", None)
+        if plane is None:
+            return None
+        try:
+            raw = plane.serve(height)
+            if raw is None:
+                return None
+            from cometbft_tpu.cert import CommitCertificate
+
+            return CommitCertificate.decode(raw)
+        except Exception:  # noqa: BLE001 - absent/corrupt = no certificate
+            return None
+
     def id_(self) -> str:
         return f"node:{getattr(getattr(self.node, 'node_info', None), 'moniker', '?')}"
 
@@ -83,6 +100,14 @@ class MemProvider(Provider):
         self.name = name
         self.evidence: list = []
         self.fail_after: Optional[int] = None  # simulate a stalled provider
+        # height -> CommitCertificate; tests populate to exercise the
+        # light client's certificate short-circuit
+        self.certs: dict[int, object] = {}
+        self.cert_requests = 0
+
+    async def commit_certificate(self, height: int):
+        self.cert_requests += 1
+        return self.certs.get(height)
 
     async def light_block(self, height: int) -> LightBlock:
         if self.fail_after is not None and height > self.fail_after:

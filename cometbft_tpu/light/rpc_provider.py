@@ -117,6 +117,23 @@ class RPCProvider(Provider):
         except Exception as e:  # noqa: BLE001 - malformed proto is malicious
             raise ErrBadLightBlock(f"{self.base_url}: {e}") from e
 
+    async def commit_certificate(self, height: int):
+        """Fetch the node's commit certificate at height via the
+        `commit_certificate` route, decoded, or None on ANY failure —
+        certificates are an accept-only shortcut, so a missing/disabled
+        route or malformed payload just means the classic path runs."""
+        from cometbft_tpu.cert import CommitCertificate
+
+        try:
+            doc = await self._get_retrying(
+                f"commit_certificate?height={height}")
+            if "error" in doc:
+                return None
+            return CommitCertificate.decode(
+                base64.b64decode(doc["result"]["certificate"]))
+        except Exception:  # noqa: BLE001 - no cert = classic verification
+            return None
+
     async def report_evidence(self, ev) -> None:
         from cometbft_tpu.types.evidence import evidence_list_to_proto
 

@@ -230,6 +230,82 @@ def verify(
         )
 
 
+def verify_with_certificate(
+    trusted_header: SignedHeader,
+    trusted_vals: ValidatorSet,
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period_ns: int,
+    now: cmttime.Timestamp,
+    max_clock_drift_ns: int,
+    trust_level: Fraction,
+    cert,
+) -> bool:
+    """A bisection hop decided by a commit certificate (cert/): the
+    non-crypto header checks run EXACTLY as the classic path runs them
+    (and raise identically), then the certificate stands in for the
+    per-vote commit checks — a >2/3 bitmap tally plus ONE pairing —
+    when it attests this header's served commit byte-for-byte
+    (attests_commit pins the signer set, timestamps AND the signature
+    sum, making cert-accept equivalent to the aggregate-first per-vote
+    path on this exact commit).
+
+    Returns True when the hop is decided (accepted). Returns False when
+    the certificate is unusable here — mismatched, forged, failing its
+    pairing, or (non-adjacent) not carrying trust-level power of the
+    OLD set — and the caller MUST run the classic path, which then
+    produces the canonical verdict or error. Accept-only: a certificate
+    can decide a hop positively or get out of the way; it can never
+    reject one. ErrInvalidKey (BLS set with the backend off) propagates
+    — misconfiguration stays loud on this path too."""
+    from cometbft_tpu.cert.certificate import (
+        ErrCertInvalid,
+        attests_commit,
+        verify_certificate,
+    )
+
+    adjacent = untrusted_header.height == trusted_header.height + 1
+    if header_expired(trusted_header, trusting_period_ns, now):
+        raise ErrOldHeaderExpired(
+            trusted_header.time.add_ns(trusting_period_ns), now
+        )
+    _verify_new_header_and_vals(
+        untrusted_header, untrusted_vals, trusted_header, now, max_clock_drift_ns
+    )
+    if adjacent and (untrusted_header.header.validators_hash
+                     != trusted_header.header.next_validators_hash):
+        raise ErrInvalidHeader(
+            f"expected old header next validators "
+            f"({trusted_header.header.next_validators_hash.hex()}) to match "
+            f"those from new header ({untrusted_header.header.validators_hash.hex()})"
+        )
+    commit = untrusted_header.commit
+    if not attests_commit(cert, commit):
+        return False
+    if not adjacent:
+        # trust-level tally of the OLD set over the certified signers —
+        # the same address-keyed sum the classic trusting check runs
+        # (signature validity is covered by the certificate's aggregate)
+        tallied = 0
+        from cometbft_tpu.types.basic import BlockIDFlag as _Flag
+
+        for cs in commit.signatures:
+            if cs.block_id_flag != _Flag.COMMIT:
+                continue
+            _, val = trusted_vals.get_by_address(cs.validator_address)
+            if val is not None:
+                tallied += val.voting_power
+        needed = (trusted_vals.total_voting_power()
+                  * trust_level.numerator // trust_level.denominator)
+        if tallied <= needed:
+            return False
+    try:
+        verify_certificate(cert, trusted_header.chain_id, untrusted_vals)
+    except ErrCertInvalid:
+        return False
+    return True
+
+
 def verify_backwards(untrusted_header, trusted_header) -> None:
     """light/verifier.go:214-245 — headers, not signed headers: walk the
     LastBlockID hash chain one step down."""

@@ -309,6 +309,30 @@ class Node(BaseService):
         self.overload.register(
             "events", self.event_bus.server.max_lag_fraction)
 
+        # ---- commit-certificate plane (cert/, no reference analog):
+        # succinct finality certificates — produced at commit finalize
+        # off the event bus, stored CRC-guarded beside the block store,
+        # served over RPC and the negotiated blocksync channel
+        self.cert_plane = None
+        self.cert_metrics = None
+        self._cert_db = None
+        if config.cert.enabled:
+            from cometbft_tpu.cert import CertPlane, CertStore
+
+            self._cert_db = open_db(
+                backend, config.db_path("certs"),
+                synchronous=sync_mode, checksum=config.storage.checksum)
+            self.cert_metrics = cmtmetrics.CertMetrics(self.metrics_registry)
+            self.cert_plane = CertPlane(
+                CertStore(self._cert_db), self.block_store, self.state_store,
+                genesis_doc.chain_id, event_bus=self.event_bus,
+                backfill=config.cert.backfill,
+                backfill_batch=config.cert.backfill_batch,
+                poll_interval=config.cert.poll_interval,
+                metrics=self.cert_metrics,
+                logger=self.logger.with_fields(module="cert"),
+            )
+
         # background pruning honoring app/companion retain heights
         # (node.go:263-524 createPruner; state/pruner.go)
         from cometbft_tpu.state.pruner import Pruner
@@ -316,6 +340,8 @@ class Node(BaseService):
         self.pruner = Pruner(
             self.state_store, self.block_store,
             tx_indexer=self.tx_indexer, block_indexer=self.block_indexer,
+            # retain-height advances drop certificates with their blocks
+            cert_store=self.cert_plane.store if self.cert_plane else None,
             # a configured privileged gRPC listener means a data companion
             # may set retain heights — the pruner must then honor them
             companion_enabled=bool(config.grpc.privileged_laddr),
@@ -368,6 +394,8 @@ class Node(BaseService):
             # blocksync activates in the statesync handoff instead of boot
             active=self.blocksync_active and not self.statesync_active,
             consensus_reactor=self.consensus_reactor,
+            cert_plane=self.cert_plane,
+            cert_serve=config.cert.serve if self.cert_plane else False,
             logger=self.logger.with_fields(module="blocksync"),
         )
         # Every node SERVES snapshots on the statesync channels (reference:
@@ -628,6 +656,8 @@ class Node(BaseService):
         if self.indexer_service is not None:
             await self.indexer_service.start()
         await self.pruner.start()
+        if self.cert_plane is not None:
+            await self.cert_plane.start()
 
         # bridge the consensus fast-path EventSwitch into the async EventBus
         # so RPC subscribers see round transitions (state.go:129-131 dual
@@ -766,6 +796,8 @@ class Node(BaseService):
             await self._byzantine.stop()
         await self.switch.stop()
         await self.proxy_app.stop()
+        if self.cert_plane is not None and self.cert_plane.is_running:
+            await self.cert_plane.stop()
         if self.pruner.is_running:
             await self.pruner.stop()
         if self.indexer_service is not None and self.indexer_service.is_running:
@@ -776,7 +808,7 @@ class Node(BaseService):
             except Exception:  # noqa: BLE001
                 pass
         for db in (self.block_store.db, self.state_store.db, self._evidence_db,
-                   self._indexer_db):
+                   self._indexer_db, self._cert_db):
             try:
                 db.close()
             except Exception:  # noqa: BLE001

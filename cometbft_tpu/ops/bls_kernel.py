@@ -408,6 +408,48 @@ def aggregate_verify(pubs, msgs, sigs) -> bool:
         return _oracle_aggregate(pubs, msgs, sigs)
 
 
+def aggregate_signatures(sigs) -> bytes:
+    """Sum per-vote G2 signature points into the one 96 B aggregate a
+    CommitCertificate carries. Host-side point adds (production runs
+    once per commit; the pairing work all lives on the verify side).
+    Raises ValueError on undecodable/infinity inputs."""
+    return _oracle.bls_aggregate([bytes(s) for s in sigs])
+
+
+def aggregate_verify_agg(pubs, msgs, agg_sig) -> bool:
+    """The certificate-verify entry: the same one-pairing-product check
+    as aggregate_verify, but the G2 side arrives ALREADY aggregated (a
+    CommitCertificate's signature) so the per-vote summing stage is
+    skipped. Device path when the ladder allows it; exact oracle
+    otherwise — bit-consistent semantics either way."""
+    n = len(pubs)
+    if n == 0 or len(msgs) != n or len(agg_sig) != SIGNATURE_SIZE:
+        return False
+    from cometbft_tpu.crypto import batch as crypto_batch
+
+    if (crypto_batch.resolve_backend() != "tpu"
+            or not _dispatch.device_allowed()):
+        return _oracle_aggregate_agg(pubs, msgs, agg_sig)
+    sup = _dispatch.supervisor("device")
+    try:
+        # every staged lane carries the same aggregate so structural and
+        # decompress checks run unchanged; the device path slices lane 0
+        # instead of summing
+        return sup.run(lambda: _aggregate_device(
+            pubs, msgs, [bytes(agg_sig)] * n, presummed_sig=True))
+    except Exception:  # noqa: BLE001 - device fault: exact host oracle
+        EK._count_fallback(SCHEME, n)
+        return _oracle_aggregate_agg(pubs, msgs, agg_sig)
+
+
+def _oracle_aggregate_agg(pubs, msgs, agg_sig) -> bool:
+    from cometbft_tpu.libs.prefixrows import as_bytes
+
+    return _oracle.bls_aggregate_verify(
+        [bytes(p) for p in pubs], [as_bytes(m) for m in msgs],
+        bytes(agg_sig), _dst())
+
+
 # validator-set subgroup-check cache: sha256(pk bytes) -> (N,) bool.
 # A validator set re-verifies every height; its KeyValidate subgroup
 # scans run once per set, not once per commit (the BLS analog of the
@@ -441,7 +483,7 @@ def _oracle_aggregate(pubs, msgs, sigs) -> bool:
         [bytes(p) for p in pubs], [as_bytes(m) for m in msgs], agg, _dst())
 
 
-def _aggregate_device(pubs, msgs, sigs) -> bool:
+def _aggregate_device(pubs, msgs, sigs, presummed_sig: bool = False) -> bool:
     from cometbft_tpu.libs.prefixrows import as_bytes
     from cometbft_tpu.ops.bls12381 import pairing
     from cometbft_tpu.ops.bls12381 import points as pts
@@ -504,8 +546,16 @@ def _aggregate_device(pubs, msgs, sigs) -> bool:
             # signature sum (padding lanes hold the generator — slice
             # the live lanes and pad with identity instead)
             sig_pts = pts.Point(*sig)
-            live = jax.tree_util.tree_map(lambda a: a[..., :n], sig_pts)
-            sig_sum = pts.sum_tree(pts.G2Field, live, n)
+            if presummed_sig:
+                # certificate path: every lane holds the SAME
+                # pre-aggregated signature — lane 0 IS the sum (summing
+                # would scale the point by n)
+                sig_sum = jax.tree_util.tree_map(
+                    lambda a: a[..., :1], sig_pts)
+            else:
+                live = jax.tree_util.tree_map(
+                    lambda a: a[..., :n], sig_pts)
+                sig_sum = pts.sum_tree(pts.G2Field, live, n)
             # per-group pubkey sums (group masks padded to the bucket)
             pk_pts = pts.Point(*pk)
             pk_sums = []

@@ -21,7 +21,7 @@ import os
 from dataclasses import dataclass
 
 from cometbft_tpu import crypto
-from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.crypto import bls12381, ed25519
 from cometbft_tpu.libs import diskio, fail
 from cometbft_tpu.types.basic import SignedMsgType
 from cometbft_tpu.types.proposal import Proposal
@@ -104,9 +104,33 @@ class FilePV(PrivValidator):
 
     # --------------------------------------------------------- file I/O
 
+    # Amino-style JSON tags per key scheme. Ed25519 persists only the
+    # 32-byte seed (reference file format); BLS persists the scalar.
+    _KEY_CODECS = {
+        ed25519.KEY_TYPE: (
+            "tendermint/PubKeyEd25519", "tendermint/PrivKeyEd25519",
+            lambda priv: priv.bytes_()[:32],
+        ),
+        bls12381.KEY_TYPE: (
+            "cometbft/PubKeyBls12_381", "cometbft/PrivKeyBls12_381",
+            lambda priv: priv.bytes_(),
+        ),
+    }
+    _PRIV_DECODERS = {
+        "tendermint/PrivKeyEd25519": ed25519.PrivKey,
+        "cometbft/PrivKeyBls12_381": bls12381.PrivKey,
+    }
+
     @classmethod
-    def generate(cls, key_file: str = "", state_file: str = "") -> "FilePV":
-        pv = cls(ed25519.gen_priv_key(), key_file, state_file)
+    def generate(cls, key_file: str = "", state_file: str = "",
+                 key_type: str = ed25519.KEY_TYPE) -> "FilePV":
+        if key_type == bls12381.KEY_TYPE:
+            priv: crypto.PrivKey = bls12381.gen_priv_key()
+        elif key_type == ed25519.KEY_TYPE:
+            priv = ed25519.gen_priv_key()
+        else:
+            raise ValueError(f"FilePV.generate: unsupported key type {key_type!r}")
+        pv = cls(priv, key_file, state_file)
         if key_file:
             pv.save_key()
         return pv
@@ -115,24 +139,30 @@ class FilePV(PrivValidator):
     def load(cls, key_file: str, state_file: str) -> "FilePV":
         with open(key_file) as f:
             doc = json.load(f)
-        priv = ed25519.PrivKey(base64.b64decode(doc["priv_key"]["value"]))
+        ctor = cls._PRIV_DECODERS.get(
+            doc["priv_key"].get("type", "tendermint/PrivKeyEd25519"),
+            ed25519.PrivKey,
+        )
+        priv = ctor(base64.b64decode(doc["priv_key"]["value"]))
         return cls(priv, key_file, state_file)
 
     @classmethod
-    def load_or_generate(cls, key_file: str, state_file: str) -> "FilePV":
+    def load_or_generate(cls, key_file: str, state_file: str,
+                         key_type: str = ed25519.KEY_TYPE) -> "FilePV":
         if os.path.exists(key_file):
             return cls.load(key_file, state_file)
-        pv = cls.generate(key_file, state_file)
+        pv = cls.generate(key_file, state_file, key_type=key_type)
         return pv
 
     def save_key(self) -> None:
         pub = self.priv_key.pub_key()
+        pub_tag, priv_tag, priv_enc = self._KEY_CODECS[self.priv_key.type_()]
         doc = {
             "address": pub.address().hex().upper(),
-            "pub_key": {"type": "tendermint/PubKeyEd25519",
+            "pub_key": {"type": pub_tag,
                         "value": base64.b64encode(pub.bytes_()).decode()},
-            "priv_key": {"type": "tendermint/PrivKeyEd25519",
-                         "value": base64.b64encode(self.priv_key.bytes_()[:32]).decode()},
+            "priv_key": {"type": priv_tag,
+                         "value": base64.b64encode(priv_enc(self.priv_key)).decode()},
         }
         _atomic_write(self.key_file, json.dumps(doc, indent=2).encode())
 

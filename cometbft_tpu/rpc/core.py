@@ -165,7 +165,14 @@ class Environment:
         disk plus the process-global device state remain examinable."""
         from cometbft_tpu.ops import dispatch
 
-        return dispatch.health_snapshot()
+        snap = dispatch.health_snapshot()
+        # certificate-plane section (cert/plane.py): per-NODE production
+        # and consumption counters, merged here because the rest of the
+        # snapshot is process-global device state
+        plane = getattr(self.node, "cert_plane", None)
+        if plane is not None:
+            snap["cert"] = plane.health()
+        return snap
 
     async def storage_health(self, _params: dict) -> dict:
         """The storage-fault resilience snapshot (crypto_health's disk
@@ -615,6 +622,36 @@ class Environment:
             validator_set=vals,
         )
         return {"height": str(height), "light_block": _b64(lb.to_proto())}
+
+    async def commit_certificate(self, params: dict) -> dict:
+        """Framework extension (cert/): the succinct finality certificate
+        at a height — one aggregated BLS signature + signer bitmap,
+        verified anywhere with ONE pairing check. -32001 when the height
+        has no certificate (uncertifiable set, not yet produced, or
+        quarantined): consumers fall back to per-vote verification over
+        `light_block`, the same material-missing convention that route
+        uses."""
+        plane = getattr(self.node, "cert_plane", None)
+        if plane is None:
+            raise RPCError(
+                -32601, "certificate plane disabled (set cert.enabled)")
+        top = self.node.block_store.height()
+        try:
+            height = self._height_param(params, top)
+        except RPCError as e:
+            raise RPCError(-32001, str(e)) from e  # out of range = no material
+        raw = plane.serve(height)
+        if raw is None:
+            raise RPCError(
+                -32001, f"no commit certificate at height {height}")
+        from cometbft_tpu.cert import CommitCertificate
+
+        out = {"height": str(height), "certificate": _b64(raw)}
+        try:
+            out["summary"] = CommitCertificate.decode(raw).summary()
+        except ValueError:
+            pass  # raw bytes still served; consumers verify anyway
+        return out
 
     # ------------------------------------------------------- light fleet
     # The serving plane (light/fleet.py): coalesced skipping
@@ -1350,6 +1387,7 @@ class Environment:
             "genesis_chunked": self.genesis_chunked,
             "light_block": self.light_block,
             "light_verify": self.light_verify,
+            "commit_certificate": self.commit_certificate,
             "validators": self.validators,
             "consensus_state": self.consensus_state,
             "abci_info": self.abci_info,

@@ -36,6 +36,7 @@ class Pruner(BaseService):
         block_store,
         tx_indexer=None,
         block_indexer=None,
+        cert_store=None,
         interval: float = DEFAULT_INTERVAL,
         companion_enabled: bool = False,
         logger: cmtlog.Logger | None = None,
@@ -46,6 +47,7 @@ class Pruner(BaseService):
         self.block_store = block_store
         self.tx_indexer = tx_indexer
         self.block_indexer = block_indexer
+        self.cert_store = cert_store
         self.interval = interval
         self.companion_enabled = companion_enabled
         self.metrics = metrics
@@ -53,6 +55,7 @@ class Pruner(BaseService):
         self._kick = asyncio.Event()
         self.blocks_pruned = 0
         self.abci_responses_pruned = 0
+        self.certs_pruned = 0
 
     # ------------------------------------------------------ retain heights
 
@@ -143,6 +146,17 @@ class Pruner(BaseService):
             self.state_store.prune_states(retain)
             if blocks:
                 self.logger.info("pruned blocks", to_height=retain, n=blocks)
+        # commit certificates follow the BLOCK retain height exactly (a
+        # cert without its block is undecodable context; a block without
+        # its cert just re-certifies) — and, like the index rows below,
+        # prune independently of whether block pruning fired this pass,
+        # so a crash between block- and cert-pruning converges on the
+        # next pass after restart instead of orphaning rows
+        if self.cert_store is not None and retain > 0:
+            try:
+                self.certs_pruned += self.cert_store.prune(retain)
+            except Exception as e:  # noqa: BLE001 - cert loss is re-derivable
+                self.logger.error("cert pruning failed", err=str(e))
         # index rows follow their own retain heights when the pruning
         # service set them, else the block retain height — and prune
         # INDEPENDENTLY of whether block pruning fired this pass

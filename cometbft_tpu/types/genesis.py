@@ -8,12 +8,23 @@ import json
 from dataclasses import dataclass, field
 
 from cometbft_tpu import crypto
-from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.crypto import bls12381, ed25519
 from cometbft_tpu.types.params import ConsensusParams, default_consensus_params
 from cometbft_tpu.types.validator import Validator, ValidatorSet
 from cometbft_tpu.utils import cmttime
 
 MAX_CHAIN_ID_LEN = 50
+
+# JSON amino-style type tags per key scheme (genesis + priv_validator_key
+# share the same registry; see privval/file_pv.py).
+PUB_KEY_JSON_TYPES = {
+    ed25519.KEY_TYPE: "tendermint/PubKeyEd25519",
+    bls12381.KEY_TYPE: "cometbft/PubKeyBls12_381",
+}
+_PUB_KEY_DECODERS = {
+    "tendermint/PubKeyEd25519": ed25519.PubKey,
+    "cometbft/PubKeyBls12_381": bls12381.PubKey,
+}
 
 
 @dataclass
@@ -93,7 +104,9 @@ class GenesisDoc:
                     {
                         "address": v.address.hex().upper(),
                         "pub_key": {
-                            "type": "tendermint/PubKeyEd25519",
+                            "type": PUB_KEY_JSON_TYPES.get(
+                                v.pub_key.type_(), "tendermint/PubKeyEd25519"
+                            ),
                             "value": base64.b64encode(v.pub_key.bytes_()).decode(),
                         },
                         "power": str(v.power),
@@ -135,7 +148,10 @@ class GenesisDoc:
                 )
         validators = []
         for vd in d.get("validators", []):
-            pub = ed25519.PubKey(base64.b64decode(vd["pub_key"]["value"]))
+            ctor = _PUB_KEY_DECODERS.get(
+                vd["pub_key"].get("type", "tendermint/PubKeyEd25519"), ed25519.PubKey
+            )
+            pub = ctor(base64.b64decode(vd["pub_key"]["value"]))
             validators.append(
                 GenesisValidator(
                     address=bytes.fromhex(vd["address"]) if vd.get("address") else pub.address(),

@@ -17,6 +17,7 @@ MAX_BLOCK_SIZE_BYTES = 104857600  # types/params.go MaxBlockSizeBytes
 ABCI_PUB_KEY_TYPE_ED25519 = "ed25519"
 ABCI_PUB_KEY_TYPE_SECP256K1 = "secp256k1"
 ABCI_PUB_KEY_TYPE_SR25519 = "sr25519"
+ABCI_PUB_KEY_TYPE_BLS12381 = "bls12381"
 
 
 @dataclass
@@ -62,6 +63,7 @@ class ValidatorParams:
                 ABCI_PUB_KEY_TYPE_ED25519,
                 ABCI_PUB_KEY_TYPE_SECP256K1,
                 ABCI_PUB_KEY_TYPE_SR25519,
+                ABCI_PUB_KEY_TYPE_BLS12381,
             ):
                 raise ValueError(f"unknown pubkey type {t}")
 

@@ -162,6 +162,30 @@ def _bls_aggregate_ok(pubs, msgs, sigs) -> bool | None:
         [bytes(s) for s in sigs])
 
 
+def _bls_aggregate_agg_ok(pubs, msgs, agg_sig) -> bool | None:
+    """Certificate-path sibling of _bls_aggregate_ok: the G2 side
+    arrives ALREADY aggregated (a CommitCertificate's 96 B signature)
+    so the one-pairing check runs without a summing stage. Same
+    contract: None when the set is not BLS-shaped, ErrInvalidKey loud
+    when the set is BLS but the backend is off, True/False for the
+    pairing verdict. Never raises on verification trouble — device
+    faults degrade to the exact CPU oracle inside the kernel."""
+    if not pubs or any(p.type_() != "bls12381" for p in pubs):
+        return None
+    from cometbft_tpu.crypto import bls12381
+
+    if not bls12381.enabled():
+        # loud misconfiguration, same rule as crypto/batch
+        raise crypto_batch.crypto.ErrInvalidKey(
+            "bls12381 validator set but crypto.bls_enabled is off")
+    from cometbft_tpu.libs.prefixrows import as_bytes
+    from cometbft_tpu.ops import bls_kernel
+
+    return bls_kernel.aggregate_verify_agg(
+        [p.bytes_() for p in pubs], [as_bytes(m) for m in msgs],
+        bytes(agg_sig))
+
+
 def _raise_first_bad(commit: Commit, idxs: list[int], mask) -> None:
     for i, sig_ok in enumerate(mask):
         if not sig_ok:

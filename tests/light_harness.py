@@ -16,8 +16,17 @@ from cometbft_tpu.types.vote_set import VoteSet
 from cometbft_tpu.utils import cmttime
 
 
-def make_valset(n, power=10):
-    privs = [ed25519.gen_priv_key() for _ in range(n)]
+def _gen_priv(key_scheme: str, i: int):
+    if key_scheme == "bls12381":
+        from cometbft_tpu.crypto import bls12381
+
+        # deterministic: BLS keygen pays a G1 scalar mul per key
+        return bls12381.gen_priv_key_from_secret(b"light-harness-%d" % i)
+    return ed25519.gen_priv_key()
+
+
+def make_valset(n, power=10, key_scheme="ed25519"):
+    privs = [_gen_priv(key_scheme, i) for i in range(n)]
     vals = [Validator.new(p.pub_key(), power) for p in privs]
     vs = ValidatorSet(vals)
     by_addr = {p.pub_key().address(): p for p in privs}
@@ -33,20 +42,22 @@ class LightChain:
     commit in block h is signed by valset h over header h's real hash."""
 
     def __init__(self, chain_id: str, num_heights: int, n_vals: int = 4,
-                 churn_every: int = 0, base_time_s: int | None = None):
+                 churn_every: int = 0, base_time_s: int | None = None,
+                 key_scheme: str = "ed25519"):
         self.chain_id = chain_id
+        self.key_scheme = key_scheme
         self.valsets: dict[int, ValidatorSet] = {}
         self.privs: dict[int, list] = {}
         self.blocks: dict[int, LightBlock] = {}
         base = base_time_s if base_time_s is not None else cmttime.now().seconds - num_heights - 100
 
-        vs, privs = make_valset(n_vals)
+        vs, privs = make_valset(n_vals, key_scheme=key_scheme)
         for h in range(1, num_heights + 2):
             self.valsets[h] = vs
             self.privs[h] = privs
             if churn_every and h % churn_every == 0:
                 # replace one validator: remove lowest-address, add a fresh key
-                new_priv = ed25519.gen_priv_key()
+                new_priv = _gen_priv(key_scheme, 1000 + h)
                 gone = vs.validators[0]
                 vs2 = vs.copy()
                 vs2.update_with_change_set([

@@ -195,6 +195,42 @@ class TestDirectionAwareCompare:
         assert row["verdict"] == "info"
         assert "backend-dependent" in row["why_info"]
 
+    def test_cert_verify_is_enforced_lower_better(self):
+        """Cert-plane sentinel wiring (ISSUE 19): the 10k-validator
+        certificate verify time regressing UP past 50% fails — both the
+        bare detail key and the cert.-prefixed section key; the same
+        delta as an improvement passes; the exact serve-bytes figure is
+        informational with a stated why (a change there is a wire-format
+        change, reviewed as a codec change)."""
+        old = _record(cert_verify_ms_10k=140.0,
+                      cert={"cert_verify_ms_10k": 140.0,
+                            "serve_bytes_per_commit": 1450.0})
+        worse = _record(cert_verify_ms_10k=300.0,
+                        cert={"cert_verify_ms_10k": 300.0,
+                              "serve_bytes_per_commit": 9000.0})
+        v = bc.compare(old, worse)
+        assert v["verdict"] == "fail"
+        assert "cert_verify_ms_10k" in v["regressions"]
+        assert "cert.cert_verify_ms_10k" in v["regressions"]
+        assert bc.compare(worse, old)["verdict"] == "pass"
+        row = v["metrics"]["cert.serve_bytes_per_commit"]
+        assert row["verdict"] == "info"
+        assert "wire format" in row["why_info"]
+
+    def test_cert_sentinel_self_test_case(self):
+        """--self-test contract on a cert-shaped record: the injected
+        cert_verify_ms_10k regression is flagged; identical and improved
+        snapshots are not."""
+        rec = _record(cert_verify_ms_10k=140.0)
+        worse, metric, pct = bc.inject_regression(
+            rec, metric="cert_verify_ms_10k")
+        assert metric == "cert_verify_ms_10k" and pct > 50.0
+        caught = bc.compare(rec, worse)
+        assert caught["verdict"] == "fail"
+        assert metric in caught["regressions"]
+        assert bc.compare(rec, rec)["verdict"] == "pass"
+        assert bc.compare(worse, rec)["verdict"] == "pass"
+
     def test_wal_fsync_is_enforced_lower_better(self):
         """Storage sentinel wiring (ISSUE 14): the consensus-WAL fsync
         p99 regressing UP past 75% fails — both the bare detail key and

@@ -1,0 +1,626 @@
+"""Commit-certificate plane (cometbft_tpu/cert/): codec + bitmap edge
+cases, CRC-guarded store quarantine, pruner coupling, event-driven
+production (no polling while the bus is live), bounded backfill, and the
+consumers (blocksync 0x25 proving, light-client short-circuit) — every
+negative path asserting the fallback invariant: a certificate can only
+ACCEPT; anything wrong falls through to the classic per-vote verdict."""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import dataclasses
+import hashlib
+import os
+from types import SimpleNamespace
+
+import pytest
+
+from cometbft_tpu.cert import (
+    CommitCertificate,
+    ErrCertInvalid,
+    attests_commit,
+    build_certificate,
+    matches_commit,
+    verify_certificate,
+)
+from cometbft_tpu.cert.store import CertStore, _key
+from cometbft_tpu.crypto import bls12381 as bls
+from cometbft_tpu.libs.prefixrows import as_bytes
+from cometbft_tpu.store.db import MemDB, open_db
+from cometbft_tpu.types.basic import BlockID, BlockIDFlag, PartSetHeader
+from cometbft_tpu.types.commit import Commit, CommitSig
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+from cometbft_tpu.utils import cmttime
+
+CHAIN_ID = "cert-chain"
+
+
+# --------------------------------------------------------------- fixture
+# One module-cached all-BLS valset + three signed commits. BLS signing
+# costs real pairings, so every test shares the material and deepcopies
+# before mutating.
+
+def _signed_commit(chain_id, vals, privs, height, flags=None):
+    n = len(privs)
+    block_id = BlockID(hash=hashlib.sha256(b"blk%d" % height).digest(),
+                       part_set_header=PartSetHeader(1, b"\x22" * 32))
+    flags = flags or [BlockIDFlag.COMMIT] * n
+    sigs = []
+    for i in range(n):
+        if flags[i] == BlockIDFlag.ABSENT:
+            sigs.append(CommitSig.absent())
+            continue
+        # distinct per-signer timestamps exercise the ts_deltas codec
+        sigs.append(CommitSig(
+            block_id_flag=flags[i],
+            validator_address=vals.validators[i].address,
+            timestamp=cmttime.Timestamp(1_700_000_000 + height, i * 1000)))
+    commit = Commit(height=height, round_=0, block_id=block_id,
+                    signatures=sigs)
+    rows = commit.vote_sign_bytes_all(chain_id)
+    for i in range(n):
+        if sigs[i].block_id_flag != BlockIDFlag.ABSENT:
+            sigs[i].signature = privs[i].sign(as_bytes(rows.rows_for([i])[0]))
+    return commit
+
+
+def _bls_valset(n, secret_tag, power=10):
+    privs = [bls.gen_priv_key_from_secret(
+        b"cert-test-%s-%d" % (secret_tag, i)) for i in range(n)]
+    vals = ValidatorSet([Validator.new(p.pub_key(), power) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    return vals, [by_addr[v.address] for v in vals.validators]
+
+
+_CACHE: dict = {}
+
+
+def _fixture():
+    """(vals, privs, {1: commit, 2: commit, 3: commit}) over 4 BLS vals."""
+    if "fix" not in _CACHE:
+        vals, privs = _bls_valset(4, b"quad")
+        commits = {h: _signed_commit(CHAIN_ID, vals, privs, h)
+                   for h in (1, 2, 3)}
+        _CACHE["fix"] = (vals, privs, commits)
+    return _CACHE["fix"]
+
+
+def _cert(height=1):
+    vals, _, commits = _fixture()
+    key = ("cert", height)
+    if key not in _CACHE:
+        _CACHE[key] = build_certificate(CHAIN_ID, vals, commits[height])
+    return copy.deepcopy(_CACHE[key])
+
+
+# ----------------------------------------------------------------- codec
+
+def test_certificate_roundtrip_and_summary():
+    vals, _, commits = _fixture()
+    cert = _cert(1)
+    raw = cert.encode()
+    # the headline: a full finality proof in ~200 bytes, constant-ish in
+    # the signer count (one bit per validator)
+    assert len(raw) < 300
+    rt = CommitCertificate.decode(raw)
+    assert rt == cert
+    verify_certificate(rt, CHAIN_ID, vals)  # decoded form still verifies
+    s = cert.summary()
+    assert s["height"] == 1 and s["n_vals"] == 4 and s["n_signers"] == 4
+    assert s["chain_id"] == CHAIN_ID
+    assert "agg_sig" not in s  # JSON-safe view carries no key material
+
+
+def test_decode_rejects_malformed():
+    cert = _cert(1)
+    # truncated wire bytes never produce an object
+    with pytest.raises(ValueError):
+        CommitCertificate.decode(cert.encode()[:-5])
+    # aggregate signature must be exactly one compressed G2 point
+    bad = dataclasses.replace(cert, agg_sig=cert.agg_sig[:-1])
+    with pytest.raises(ValueError, match="aggregate signature"):
+        CommitCertificate.decode(bad.encode())
+    # bitmap length must agree with n_vals
+    bad = dataclasses.replace(cert, n_vals=100)
+    with pytest.raises(ValueError, match="bitmap length"):
+        CommitCertificate.decode(bad.encode())
+    # a delta per set bit, no more, no fewer
+    bad = dataclasses.replace(cert, ts_deltas=cert.ts_deltas[:-1])
+    with pytest.raises(ValueError, match="deltas"):
+        CommitCertificate.decode(bad.encode())
+    bad = dataclasses.replace(cert, height=-3)
+    with pytest.raises(ValueError, match="height"):
+        CommitCertificate.decode(bad.encode())
+    bad = dataclasses.replace(cert, chain_id="x" * 65)
+    with pytest.raises(ValueError, match="chain_id"):
+        CommitCertificate.decode(bad.encode())
+
+
+# ---------------------------------------------------- bitmap edge cases
+
+def test_exactly_two_thirds_is_not_enough():
+    """The quorum rule is strictly GREATER than 2/3 — a commit landing
+    exactly on the boundary is uncertifiable at build time and invalid
+    at verify time (3 vals x power 10: two signers tally 20 == 30*2//3)."""
+    vals, privs = _CACHE.setdefault("tri", _bls_valset(3, b"tri"))
+    commit = _signed_commit(CHAIN_ID, vals, privs, 7, flags=[
+        BlockIDFlag.COMMIT, BlockIDFlag.COMMIT, BlockIDFlag.ABSENT])
+    assert build_certificate(CHAIN_ID, vals, commit) is None
+    # three signers clear the bar...
+    full = _signed_commit(CHAIN_ID, vals, privs, 7)
+    cert = build_certificate(CHAIN_ID, vals, full)
+    assert cert is not None
+    verify_certificate(cert, CHAIN_ID, vals)
+    # ...and a crafted certificate claiming only the boundary tally is
+    # rejected before any pairing work
+    trimmed = copy.deepcopy(cert)
+    trimmed.signers.set_index(2, False)
+    trimmed = dataclasses.replace(trimmed, ts_deltas=cert.ts_deltas[:2])
+    with pytest.raises(ErrCertInvalid, match="insufficient"):
+        verify_certificate(trimmed, CHAIN_ID, vals)
+
+
+def test_nil_votes_are_excluded_from_the_bitmap():
+    vals, privs, _ = _fixture()
+    commit = _signed_commit(CHAIN_ID, vals, privs, 9, flags=[
+        BlockIDFlag.COMMIT, BlockIDFlag.NIL,
+        BlockIDFlag.COMMIT, BlockIDFlag.COMMIT])
+    cert = build_certificate(CHAIN_ID, vals, commit)
+    assert cert is not None
+    assert cert.signer_indices() == [0, 2, 3]  # the nil voter is no signer
+    verify_certificate(cert, CHAIN_ID, vals)   # 30 of 40 still > 2/3
+    assert matches_commit(cert, commit) and attests_commit(cert, commit)
+
+
+# ------------------------------------------------------- verify / attest
+
+def test_verify_rejects_forgeries():
+    vals, _, _ = _fixture()
+    cert = _cert(1)
+    with pytest.raises(ErrCertInvalid, match="chain"):
+        verify_certificate(cert, "other-chain", vals)
+    other_vals, _ = _CACHE.setdefault("tri", _bls_valset(3, b"tri"))
+    with pytest.raises(ErrCertInvalid):  # n_vals/valset_hash mismatch
+        verify_certificate(cert, CHAIN_ID, other_vals)
+    bad = dataclasses.replace(cert, valset_hash=b"\x00" * 32)
+    with pytest.raises(ErrCertInvalid, match="valset_hash"):
+        verify_certificate(bad, CHAIN_ID, vals)
+    bad = dataclasses.replace(cert, block_id=BlockID())
+    with pytest.raises(ErrCertInvalid, match="nil block"):
+        verify_certificate(bad, CHAIN_ID, vals)
+    # a VALID G2 point that is not the sum of these votes: height 2's
+    # aggregate pasted onto height 1's certificate — the one pairing
+    # product catches it
+    bad = dataclasses.replace(cert, agg_sig=_cert(2).agg_sig)
+    with pytest.raises(ErrCertInvalid, match="pairing"):
+        verify_certificate(bad, CHAIN_ID, vals)
+
+
+def test_matches_and_attests_pin_the_exact_commit():
+    vals, _, commits = _fixture()
+    cert = _cert(1)
+    commit = commits[1]
+    assert matches_commit(cert, commit) and attests_commit(cert, commit)
+    # a perturbed timestamp is a DIFFERENT commit (the header's commit
+    # hash would differ) — the certificate must not stand in for it
+    warped = copy.deepcopy(commit)
+    warped.signatures[2].timestamp = cmttime.Timestamp(1_800_000_000, 0)
+    assert not matches_commit(cert, warped)
+    # a mauled signature keeps the metadata (matches) but changes the
+    # signature SUM — attests must fail, or a bad commit could hide
+    # behind an honest certificate while the per-vote path rejects it
+    mauled = copy.deepcopy(commit)
+    mauled.signatures[0].signature = commit.signatures[1].signature
+    assert matches_commit(cert, mauled)
+    assert not attests_commit(cert, mauled)
+    assert not matches_commit(cert, None)
+
+
+# ----------------------------------------------------------------- store
+
+def test_store_roundtrip_heights_missing_prune():
+    store = CertStore(MemDB())
+    for h in (1, 2, 3, 5, 8):
+        store.put(dataclasses.replace(_cert(1), height=h))
+    assert store.count() == 5
+    assert store.heights() == [1, 2, 3, 5, 8]
+    assert store.has(5) and not store.has(4)
+    assert store.get(3).height == 3
+    assert store.get_raw(2) == store.get(2).encode()
+    assert store.get(99) is None and store.get_raw(99) is None
+    assert store.missing_in(1, 10, limit=100) == [4, 6, 7, 9, 10]
+    assert store.missing_in(1, 10, limit=2) == [4, 6]  # bounded batches
+    assert store.prune(5) == 3  # heights 1..3 go with the blocks
+    assert store.heights() == [5, 8]
+    assert store.prune(5) == 0  # idempotent
+
+
+def test_store_quarantines_corrupt_and_truncated(tmp_path):
+    """Bitrot under the CRC guard and a truncated-but-checksummed value
+    both quarantine (delete + count) instead of serving or crashing —
+    consumers see a miss and run the classic path."""
+    from cometbft_tpu.libs import diskchaos
+
+    path = os.path.join(str(tmp_path), "certs.db")
+    db = open_db("sqlite", path, checksum=True)
+    store = CertStore(db)
+    store.put(_cert(1))
+    store.put(dataclasses.replace(_cert(1), height=2))
+    diskchaos.arm("db.read", "bitrot", count=1)
+    try:
+        assert store.get(1) is None
+    finally:
+        diskchaos.disarm("db.read")
+    assert store.quarantined == 1
+    assert store.get(1) is None          # deleted, not resurrected
+    assert store.heights() == [2]        # scans resume past the hole
+    # a value that passes the CRC but fails the codec quarantines too
+    db.set(_key(3), _cert(1).encode()[:-4])
+    assert store.get(3) is None
+    assert store.quarantined == 2
+    assert not store.has(3)
+    db.close()
+
+
+def test_store_survives_restart(tmp_path):
+    path = os.path.join(str(tmp_path), "certs.db")
+    db = open_db("sqlite", path, checksum=True)
+    CertStore(db).put(_cert(1))
+    db.close()
+    store = CertStore(open_db("sqlite", path, checksum=True))
+    vals, _, _ = _fixture()
+    cert = store.get(1)
+    assert cert == _cert(1)
+    verify_certificate(cert, CHAIN_ID, vals)  # bytes, not just shape
+    store.close()
+
+
+# ---------------------------------------------------------------- pruner
+
+def test_pruner_prunes_certs_with_block_retain():
+    """The cert store follows the block retain height exactly — never
+    ahead of it (a served cert must always have its block's commit
+    next to it), never behind (pruned range, pruned certs)."""
+    from cometbft_tpu.state.pruner import Pruner
+
+    from tests.test_blocksync import build_chain
+
+    async def main():
+        _, _, state_store, block_store = await build_chain(10)
+        cert_store = CertStore(MemDB())
+        for h in range(1, 11):
+            cert_store.put(dataclasses.replace(_cert(1), height=h))
+        p = Pruner(state_store, block_store, cert_store=cert_store,
+                   interval=0.02)
+        p.set_application_block_retain_height(6)
+        blocks, _ = p.prune_once()
+        assert blocks == 5
+        assert p.certs_pruned == 5
+        assert cert_store.heights() == list(range(6, 11))
+        # a second pass with no retain movement prunes nothing more
+        p.prune_once()
+        assert p.certs_pruned == 5
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------------- plane
+
+class _StubStores:
+    """block_store + state_store face over a commit dict (the plane only
+    touches load_block_commit/load_seen_commit/base/height and
+    load_validators)."""
+
+    def __init__(self, commits, vals):
+        self.commits = dict(commits)
+        self.vals = vals
+
+    def load_block_commit(self, h):
+        return self.commits.get(h)
+
+    def load_seen_commit(self, h):
+        return None
+
+    def base(self):
+        return min(self.commits, default=1)
+
+    def height(self):
+        return max(self.commits, default=0)
+
+    def load_validators(self, h):
+        return self.vals
+
+
+def _make_plane(commits=None, vals=None, **kw):
+    from cometbft_tpu.cert.plane import CertPlane
+
+    if vals is None:
+        vals, _, fix_commits = _fixture()
+        commits = fix_commits if commits is None else commits
+    stores = _StubStores(commits or {}, vals)
+    return CertPlane(CertStore(MemDB()), stores, stores, CHAIN_ID, **kw)
+
+
+def test_plane_event_driven_production_no_polling():
+    """Production rides the EventBus NewBlock feed: each published
+    commit certifies with zero poll ticks — the regression this test
+    exists for is a silent fall-back to store polling."""
+    from cometbft_tpu.types.event_bus import EventBus
+
+    async def main():
+        vals, _, commits = _fixture()
+        bus = EventBus()
+        plane = _make_plane(event_bus=bus, backfill=False)
+        await plane.start()
+        try:
+            for h in (1, 2, 3):
+                await bus.publish_event_new_block(
+                    SimpleNamespace(header=SimpleNamespace(height=h)),
+                    None, None)
+            for _ in range(50):
+                if plane.store.count() == 3:
+                    break
+                await asyncio.sleep(0.01)
+            assert plane.store.count() == 3
+            assert plane.bus_events == 3
+            assert plane.produced == 3
+            assert plane.poll_ticks == 0  # the invariant
+            for h in (1, 2, 3):
+                verify_certificate(plane.store.get(h), CHAIN_ID, vals)
+        finally:
+            await plane.stop()
+        h = plane.health()
+        assert h["certified_heights"] == 3 and h["poll_ticks"] == 0
+
+    asyncio.run(main())
+
+
+def test_plane_backfill_fills_the_retained_range():
+    """A plane starting over an already-grown chain (enabled late, or
+    restarted with a wiped cert db) converges via the bounded backfill
+    worker — still without polling, the bus stays the production path."""
+    from cometbft_tpu.types.event_bus import EventBus
+
+    async def main():
+        bus = EventBus()
+        plane = _make_plane(event_bus=bus, backfill=True, backfill_batch=2,
+                            poll_interval=0.01)
+        await plane.start()
+        try:
+            for _ in range(200):
+                if plane.store.count() == 3:
+                    break
+                await asyncio.sleep(0.01)
+            assert plane.store.count() == 3
+            assert plane.backfilled == 3
+            assert plane.poll_ticks == 0
+        finally:
+            await plane.stop()
+
+    asyncio.run(main())
+
+
+def test_plane_certify_height_is_idempotent_and_counts():
+    vals, _, commits = _fixture()
+    plane = _make_plane()
+    assert plane.certify_height(1)
+    assert plane.certify_height(1)          # prior cert short-circuits
+    assert plane.produced == 1
+    assert not plane.certify_height(0)      # no height zero
+    assert not plane.certify_height(50)     # no commit material
+    # uncertifiable (ed25519) sets are counted and skipped, not errors
+    from cometbft_tpu.crypto import ed25519
+    ed_vals = ValidatorSet([
+        Validator.new(ed25519.gen_priv_key().pub_key(), 10)
+        for _ in range(4)])
+    ed_plane = _make_plane(commits=commits, vals=ed_vals)
+    assert not ed_plane.certify_height(2)
+    assert ed_plane.uncertifiable == 1
+    # serving counts; a missing height serves None uncounted
+    assert plane.serve(1) == plane.store.get_raw(1)
+    assert plane.serve(50) is None
+    assert plane.served == 1
+
+
+# ------------------------------------------------------------- blocksync
+
+def test_blocksync_cert_proves_and_falls_back():
+    """_cert_proves is the window fast-path: a held certificate that
+    names the synced block, attests its commit, and verifies, skips the
+    per-vote stage; every failure is counted and falls through — no
+    peer ban, no verdict."""
+    from cometbft_tpu.blocksync.reactor import BlocksyncReactor
+
+    vals, _, commits = _fixture()
+    plane = _make_plane()
+    r = BlocksyncReactor(None, None, active=False, cert_plane=plane)
+
+    cert = _cert(1)
+    r._held_certs[1] = cert
+    assert r._cert_proves(CHAIN_ID, vals, 1, cert.block_id, commits[1])
+    assert r.cert_heights == 1 and plane.verified == 1
+    assert 1 not in r._held_certs  # consumed either way
+
+    # forged aggregate: counted, classic path takes over
+    forged = dataclasses.replace(_cert(2), agg_sig=_cert(1).agg_sig)
+    r._held_certs[2] = forged
+    assert not r._cert_proves(CHAIN_ID, vals, 2, forged.block_id, commits[2])
+    assert r.certs_rejected == 1 and plane.verify_failures == 1
+
+    # cert for a different block than the one being synced
+    other = _cert(3)
+    r._held_certs[3] = other
+    wrong_id = commits[1].block_id
+    assert not r._cert_proves(CHAIN_ID, vals, 3, wrong_id, commits[3])
+    assert r.certs_rejected == 2
+
+    # no held cert: silent False, nothing counted
+    assert not r._cert_proves(CHAIN_ID, vals, 4, commits[1].block_id,
+                              commits[1])
+    assert r.certs_rejected == 2 and r.cert_heights == 1
+
+
+def test_blocksync_cert_messages_roundtrip():
+    from cometbft_tpu.blocksync.messages import (
+        CertRequest,
+        CertResponse,
+        NoCertResponse,
+        decode,
+        encode,
+    )
+
+    req = CertRequest(height=42)
+    assert decode(encode(req)) == req
+    resp = CertResponse(height=1, cert=_cert(1).encode())
+    back = decode(encode(resp))
+    assert back == resp
+    assert CommitCertificate.decode(back.cert) == _cert(1)
+    assert decode(encode(NoCertResponse(height=7))) == NoCertResponse(7)
+
+
+# ---------------------------------------------------------- light client
+
+def test_light_forged_cert_only_falls_back_never_accepts():
+    """The bit-identical guarantee, adversarial side: a primary serving
+    forged certificates over an ed25519 chain changes NOTHING about the
+    verdict — every hop falls back to classic verification and lands on
+    the same trusted head as a cert-free control client."""
+    from cometbft_tpu.light import client as light
+    from cometbft_tpu.light.provider import MemProvider
+    from cometbft_tpu.light.store import LightStore
+
+    from tests.light_harness import LightChain
+
+    async def main():
+        chain = LightChain("light-chain", 6)
+        now = cmttime.Timestamp(chain.blocks[6].header.time.seconds + 5, 0)
+
+        def client(primary):
+            return light.Client(
+                "light-chain",
+                light.TrustOptions(period_ns=3600 * 10**9, height=1,
+                                   hash_=chain.blocks[1].hash()),
+                primary, [MemProvider("light-chain", chain.blocks, name="w")],
+                LightStore(MemDB()))
+
+        forger = MemProvider("light-chain", chain.blocks, name="p")
+        for h, lb in chain.blocks.items():
+            commit = lb.commit
+            real = _cert(1)
+            # structurally perfect for THIS commit, garbage aggregate:
+            # the deepest-reaching forgery (matches_commit holds, the
+            # sum check is what stands between it and acceptance)
+            idxs = [i for i, cs in enumerate(commit.signatures)
+                    if cs.block_id_flag == BlockIDFlag.COMMIT]
+            ts_ns = [commit.signatures[i].timestamp.unix_ns() for i in idxs]
+            signers = copy.deepcopy(real.signers)
+            forger.certs[h] = dataclasses.replace(
+                real, chain_id="light-chain", height=h,
+                round_=commit.round_, block_id=commit.block_id,
+                valset_hash=lb.validator_set.hash(),
+                n_vals=len(commit.signatures),
+                ts_base=cmttime.Timestamp(min(ts_ns) // 10**9,
+                                          min(ts_ns) % 10**9),
+                ts_deltas=[t - min(ts_ns) for t in ts_ns])
+
+        c = client(forger)
+        await c.initialize(now)
+        lb = await c.verify_light_block_at_height(6, now)
+        assert lb.header.height == 6
+        assert c.cert_hits == 0
+        assert c.cert_fallbacks >= 1      # it tried, it fell back, counted
+        assert forger.cert_requests >= 1
+
+        control = client(MemProvider("light-chain", chain.blocks, name="c"))
+        await control.initialize(now)
+        clb = await control.verify_light_block_at_height(6, now)
+        assert clb.header.hash() == lb.header.hash()  # identical verdicts
+        assert control.last_trusted_height() == c.last_trusted_height()
+
+    asyncio.run(main())
+
+
+@pytest.mark.slow
+def test_light_cert_short_circuit_bit_identical():
+    """Positive side over a real all-BLS chain: certificates decide the
+    hops (cert_hits, zero fallbacks) and the client lands on exactly
+    the head a cert-free control client lands on."""
+    from cometbft_tpu.light import client as light
+    from cometbft_tpu.light.provider import MemProvider
+    from cometbft_tpu.light.store import LightStore
+
+    from tests.light_harness import LightChain
+
+    async def main():
+        chain = LightChain("light-chain", 4, key_scheme="bls12381")
+        now = cmttime.Timestamp(chain.blocks[4].header.time.seconds + 5, 0)
+
+        primary = MemProvider("light-chain", chain.blocks, name="p")
+        for h, lb in chain.blocks.items():
+            cert = build_certificate("light-chain", chain.valsets[h],
+                                     lb.commit)
+            assert cert is not None
+            primary.certs[h] = cert
+
+        def client(p):
+            return light.Client(
+                "light-chain",
+                light.TrustOptions(period_ns=3600 * 10**9, height=1,
+                                   hash_=chain.blocks[1].hash()),
+                p, [MemProvider("light-chain", chain.blocks, name="w")],
+                LightStore(MemDB()))
+
+        c = client(primary)
+        await c.initialize(now)
+        lb = await c.verify_light_block_at_height(4, now)
+        assert c.cert_hits >= 1
+        assert c.cert_fallbacks == 0
+
+        control = client(MemProvider("light-chain", chain.blocks, name="c"))
+        await control.initialize(now)
+        clb = await control.verify_light_block_at_height(4, now)
+        assert clb.header.hash() == lb.header.hash()
+        assert control.last_trusted_height() == c.last_trusted_height()
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------------------- live net
+
+@pytest.mark.slow
+def test_plane_certifies_a_real_bls_net():
+    """End to end against real node stores: a 4-validator all-BLS net
+    commits a few heights; the plane certifies every one from the
+    node's own block store and each certificate verifies against the
+    genesis valset."""
+    from tests.net_harness import make_net
+
+    async def main():
+        net = await make_net(4, chain_id=CHAIN_ID, key_scheme="bls12381")
+        await net.start()
+        try:
+            await net.wait_for_height(3, timeout=300.0)
+        finally:
+            await net.stop()
+        node = net.nodes[0]
+        vals = ValidatorSet([
+            Validator.new(p.pub_key(), 10) for p in net.privs])
+
+        class _Vals:
+            def load_validators(self, h):
+                return vals
+
+        from cometbft_tpu.cert.plane import CertPlane
+
+        plane = CertPlane(CertStore(MemDB()), node.block_store,
+                          _Vals(), CHAIN_ID)
+        head = node.block_store.height()
+        assert head >= 3
+        for h in range(1, head + 1):
+            assert plane.certify_height(h), f"height {h} uncertified"
+            verify_certificate(plane.store.get(h), CHAIN_ID, vals)
+        assert plane.produced == head
+        assert plane.health()["certified_heights"] == head
+
+    asyncio.run(main())

@@ -153,6 +153,71 @@ def test_storage_fault_perturbations_are_legal_and_roundtrip():
     assert any(p.partition(":")[0] == "disk-fault" for p in PERTURBATIONS)
 
 
+def test_cert_backfill_perturbation_is_legal_and_roundtrips():
+    """cert-backfill (runner.py: kill, wipe the commit-certificate store,
+    respawn mid-fleet, assert the backfill worker re-certifies on
+    /metrics) is a first-class matrix cell — legal only on an all-BLS
+    net, because certificates only exist on all-BLS validator sets."""
+    m = Manifest(key_type="bls12381", nodes={
+        "a": NodeManifest(perturb=["cert-backfill"]),
+        "b": NodeManifest(),
+        "c": NodeManifest(),
+        "d": NodeManifest(),
+    })
+    m.validate()
+    assert Manifest.from_toml(m.to_toml()) == m
+    # an ed25519 net carrying cert-backfill is a misconfiguration the
+    # manifest must refuse loudly, never run into zero-cert silence
+    with pytest.raises(ValueError, match="bls12381"):
+        Manifest(nodes={
+            "a": NodeManifest(perturb=["cert-backfill"]),
+        }).validate()
+    with pytest.raises(ValueError, match="key_type"):
+        Manifest(key_type="rsa", nodes={"a": NodeManifest()}).validate()
+    from cometbft_tpu.e2e.generator import (
+        PERTURBATIONS,
+        RESPAWN_PERTURBATIONS,
+    )
+
+    assert "cert-backfill" in PERTURBATIONS
+    assert "cert-backfill" in RESPAWN_PERTURBATIONS
+    # the generator flips any net that draws it to the BLS scheme
+    for m2 in generate_manifests(7, 200):
+        for nd in m2.nodes.values():
+            if any(p.partition(":")[0] == "cert-backfill"
+                   for p in nd.perturb):
+                assert m2.key_type == "bls12381", m2.name
+
+
+def test_runner_setup_materializes_bls_keys(tmp_path):
+    """A bls12381 manifest must materialize BLS privval keys and a
+    genesis whose validators decode back as BLS — the substrate the
+    cert-backfill perturbation (and the cert plane itself) stands on."""
+    import json
+
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.e2e.runner import setup
+    from cometbft_tpu.privval.file_pv import FilePV
+    from cometbft_tpu.types.genesis import GenesisDoc
+
+    m = Manifest(name="bls-net", key_type="bls12381",
+                 nodes={"node0": NodeManifest(), "node1": NodeManifest()})
+    net = setup(m, str(tmp_path / "net"), base_port=32700)
+    cfg = Config.load(net.homes[0])
+    pv = FilePV.load(cfg.priv_validator_key_path(),
+                     cfg.priv_validator_state_path())
+    assert pv.priv_key.type_() == "bls12381"
+    with open(cfg.genesis_path()) as f:
+        gdoc = GenesisDoc.from_json(f.read())
+    assert all(v.pub_key.type_() == "bls12381" for v in gdoc.validators)
+    assert gdoc.consensus_params.validator.pub_key_types == ["bls12381"]
+    # the key file round-trips through JSON with the BLS type tags
+    with open(cfg.priv_validator_key_path()) as f:
+        doc = json.load(f)
+    assert doc["pub_key"]["type"] == "cometbft/PubKeyBls12_381"
+    assert doc["priv_key"]["type"] == "cometbft/PrivKeyBls12_381"
+
+
 def test_runner_setup_materializes_manifest(tmp_path):
     from cometbft_tpu.config import Config
     from cometbft_tpu.e2e.runner import setup

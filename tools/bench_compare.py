@@ -103,6 +103,14 @@ TRACKED: dict[str, tuple[str, float]] = {
     # section-prefixed like the mesh keys.
     "bls_aggregate_verify_ms_10k": (LOWER, 50.0),
     "bls.bls_aggregate_verify_ms_10k": (LOWER, 50.0),
+    # commit-certificate verify at 10k validators (bench_cert): the full
+    # consumer path — decode-shaped cert, bitmap tally, sign-bytes
+    # reconstruction, signer-pubkey aggregation, ONE pairing. Same wide
+    # threshold and O(n)-host-share caveats as the bls headline above;
+    # a multiple-of-itself jump means the certificate stopped being a
+    # single-pairing object. Bare and cert.-prefixed like the bls keys.
+    "cert_verify_ms_10k": (LOWER, 50.0),
+    "cert.cert_verify_ms_10k": (LOWER, 50.0),
     # consensus-WAL fsync p99 (bench_storage): the disk floor under
     # every committed height. Wide threshold — absolute fsync latency is
     # a property of the bench host's disk — but a multiple-of-itself
@@ -210,6 +218,14 @@ INFORMATIONAL = {
                                   "CONTRACT is the geometric bound "
                                   "asserted in tests; the value below "
                                   "the bound is a hash artifact",
+    # cert-plane transport companion to the enforced cert_verify_ms_10k:
+    # bytes are exact by construction (one bit per validator + fixed
+    # header), so a change is a WIRE-FORMAT change, reviewed as such —
+    # informational so a deliberate codec evolution doesn't fail CI
+    "cert.serve_bytes_per_commit": "exact encoded certificate size at "
+                                   "10k validators: changes only with "
+                                   "the wire format itself, reviewed as "
+                                   "a codec change rather than enforced",
 }
 
 
