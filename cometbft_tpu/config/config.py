@@ -132,6 +132,13 @@ class CryptoConfig:
     # device memory; one row is reserved for the padding identity).
     # Must fit a uint16 index: [64, 65536]
     wire_table_rows: int = 16384
+    # derive the ed25519 challenge k = SHA-512(R||A||M) mod L ON DEVICE
+    # (ops/challenge.py): the wire carries only R/s plus per-lane
+    # (prefix-id, suffix) descriptors against a resident prefix table
+    # (~66-82 B/sig vs 98), with per-lane and whole-batch host-k
+    # fallbacks that never change a verdict. Off = every batch ships
+    # host-computed k words (the pre-device-challenge protocol)
+    wire_device_challenge: bool = True
     # --- BLS12-381 aggregate-signature scheme (crypto/bls12381.py) ---
     # the third verify-plane scheme: 48 B G1 pubkeys, 96 B G2 sigs,
     # aggregate commit verify (one pairing-product check per commit) and

@@ -118,6 +118,11 @@ def configure(crypto_cfg) -> None:
         enabled=crypto_cfg.wire_indexed_sends,
         rows=crypto_cfg.wire_table_rows,
     )
+    from cometbft_tpu.ops import challenge
+
+    challenge.configure(
+        enabled=crypto_cfg.wire_device_challenge,
+    )
     from cometbft_tpu.crypto import bls12381
 
     bls12381.set_enabled(crypto_cfg.bls_enabled)

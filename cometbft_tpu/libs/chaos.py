@@ -7,6 +7,15 @@ fallback -> re-probe). Sites live on the device-dispatch seams:
 
   ed25519.dispatch   the ed25519 transfer+kernel dispatch worker
   ed25519.fetch      the ed25519 device->host payload fetch
+  ed25519.challenge  the on-device challenge derivation (ops/challenge.py
+                     derive program): a fault degrades the batch to
+                     host-computed k, `corrupt` perturbs one device-derived
+                     k word so the recheck plane must flip the lane back —
+                     counted, never a verdict change
+  dispatch.doublebuf the two-slot in-flight gate (ops/dispatch.DoubleBuffer)
+                     acquired before each batch's h2d: a fault degrades the
+                     fault domain to serialized single-buffer dispatch until
+                     its breaker re-closes — overlap lost, verdicts untouched
   sr25519.dispatch   the sr25519 transfer+kernel dispatch worker
   sr25519.fetch      the sr25519 device->host payload fetch
   pallas.trace       inside the Pallas gate, before the fused-kernel call
@@ -78,6 +87,8 @@ _MESH_SITES = tuple(
 SITES = (
     "ed25519.dispatch",
     "ed25519.fetch",
+    "ed25519.challenge",
+    "dispatch.doublebuf",
     "sr25519.dispatch",
     "sr25519.fetch",
     "pallas.trace",
@@ -255,6 +266,14 @@ def fire(site: str) -> None:
             "(RESOURCE_EXHAUSTED)")
     raise ChaosPermanentError(
         f"chaos: injected permanent Mosaic failure at {site}")
+
+
+def should_corrupt(site: str) -> bool:
+    """Consume one `corrupt` firing at a value-perturbation site (e.g. the
+    device-derived challenge words at ed25519.challenge, where there is no
+    fetched mask to flip — the caller perturbs its own payload). True when
+    the site was armed with `corrupt` and a firing was consumed."""
+    return _take(site, want_corrupt=True) is not None
 
 
 def corrupt_mask(site: str, payload):

@@ -42,9 +42,22 @@ Stage categories (the attribution model):
   queue      submit->dispatch wait in the verify scheduler
   stage      host staging: structural checks, hashing, packing
   transfer   host->device bytes (staged words, pubkey coordinate tables)
+  challenge  challenge derivation (device SHA-512+Barrett, or the host-k
+             fallback rungs of ops/challenge.py)
   compute    device dispatch / host-oracle verification
   fetch      device->host result bytes (reduced-fetch headers, payloads)
   resolve    mask decode, integrity checks, host re-checks, slicing
+
+Overlap model (double-buffered dispatch): with two in-flight slots per
+fault domain (ops/dispatch.DoubleBuffer) batch N's host->device transfer
+runs WHILE batch N-1's kernel computes on another pool thread. Summing
+both wall intervals would double-count the overlapped nanoseconds — the
+transfer wasn't pipeline cost, it was hidden behind compute. So a
+finishing transfer span bills only the part of its self time that did
+NOT intersect device-busy (compute/challenge) intervals on OTHER
+threads; the intersected part accumulates separately and is surfaced as
+`h2d_overlap_us` / `h2d_overlap_fraction` = overlap/(transfer+overlap)
+— the measured did-the-double-buffer-actually-overlap number.
 
 Span parenting uses a contextvars.ContextVar, so nesting is correct per
 thread AND per asyncio task with no explicit plumbing; `wrap_ctx()` hands
@@ -65,7 +78,37 @@ from typing import Any, Callable, Optional
 # Stage categories counted by the attribution model. Spans with any other
 # cat ("sched", "consensus", "sync", "mempool", "device", ...) appear in
 # the trace but never in stage shares — they are containers, not stages.
-STAGES = ("queue", "stage", "transfer", "compute", "fetch", "resolve")
+STAGES = ("queue", "stage", "transfer", "challenge", "compute", "fetch",
+          "resolve")
+
+# device-busy categories for the h2d overlap model: a transfer span's
+# nanoseconds that intersect one of these on ANOTHER thread bill as
+# overlap, not transfer
+_BUSY_CATS = ("challenge", "compute")
+
+# finished busy intervals kept for the overlap window: must cover every
+# transfer that could have overlapped a compute that already finished —
+# a handful of in-flight batches, so a small ring is plenty
+_BUSY_KEEP = 64
+
+
+def _union_overlap_ns(t0: int, t1: int, intervals) -> int:
+    """|[t0, t1] ∩ union(intervals)| in ns (intervals may overlap each
+    other; they are clipped, merged, then summed)."""
+    clipped = sorted((max(t0, a), min(t1, b)) for a, b in intervals
+                     if b > t0 and a < t1)
+    total = 0
+    cur_a = cur_b = None
+    for a, b in clipped:
+        if cur_b is None or a > cur_b:
+            if cur_b is not None:
+                total += cur_b - cur_a
+            cur_a, cur_b = a, b
+        elif b > cur_b:
+            cur_b = b
+    if cur_b is not None:
+        total += cur_b - cur_a
+    return total
 
 _enabled = False  # module-global fast path: read before anything else
 
@@ -192,6 +235,12 @@ class Tracer:
         self._attr_rows = 0
         self._attr_tx = 0
         self._attr_rx = 0
+        # h2d overlap model state: live device-busy spans (id -> (tid,
+        # t0)), recently finished busy intervals (tid, t0, t1), and the
+        # overlap accumulator (transfer ns hidden behind compute)
+        self._open_busy: dict[int, tuple[int, int]] = {}
+        self._done_busy: deque = deque(maxlen=_BUSY_KEEP)
+        self._attr_overlap = 0
 
     # ------------------------------------------------------------- spans
 
@@ -201,7 +250,13 @@ class Tracer:
         # the new span instead of leaking it into the parent's uncovered
         # gap (per-batch coverage is an acceptance number)
         t0 = self._clock()
-        return Span(next(self._ids), _current.get(), name, cat, attrs, t0)
+        s = Span(next(self._ids), _current.get(), name, cat, attrs, t0)
+        if cat in _BUSY_CATS:
+            # register live device-busy work for the overlap model; only
+            # busy cats pay the lock here, the common span stays lock-free
+            with self._lock:
+                self._open_busy[s.id] = (s.tid, t0)
+        return s
 
     def _finish(self, span: Span) -> None:
         # ring write FIRST, before t1 is read: the Span object itself
@@ -236,7 +291,26 @@ class Tracer:
             with self._lock:
                 span.t1 = self._clock()
                 dur = 0 if instant else max(0, span.t1 - span.t0)
-                self._attr_ns[span.cat] += max(0, dur - span._covered)
+                self_ns = max(0, dur - span._covered)
+                if span.cat in _BUSY_CATS:
+                    self._open_busy.pop(span.id, None)
+                    if dur:
+                        self._done_busy.append((span.tid, span.t0, span.t1))
+                elif span.cat == "transfer" and dur:
+                    # overlapped h2d bills as overlap, not transfer: the
+                    # busy set is live spans (busy through our t1) plus
+                    # recently finished intervals, other threads only
+                    ivals = [(b0, span.t1)
+                             for (btid, b0) in self._open_busy.values()
+                             if btid != span.tid]
+                    ivals.extend(
+                        (b0, b1) for (btid, b0, b1) in self._done_busy
+                        if btid != span.tid)
+                    ov = min(self_ns,
+                             _union_overlap_ns(span.t0, span.t1, ivals))
+                    self._attr_overlap += ov
+                    self_ns -= ov
+                self._attr_ns[span.cat] += self_ns
                 self._attr_rows += rows
                 self._attr_tx += span.bytes_tx
                 self._attr_rx += span.bytes_rx
@@ -315,7 +389,8 @@ class Tracer:
         with self._lock:
             ns = dict(self._attr_ns)
             rows, tx, rx = self._attr_rows, self._attr_tx, self._attr_rx
-        return _attribution_dict(ns, rows, tx, rx)
+            overlap = self._attr_overlap
+        return _attribution_dict(ns, rows, tx, rx, overlap)
 
     def reset_attribution(self) -> None:
         with self._lock:
@@ -323,6 +398,9 @@ class Tracer:
             self._attr_rows = 0
             self._attr_tx = 0
             self._attr_rx = 0
+            self._attr_overlap = 0
+            self._open_busy.clear()
+            self._done_busy.clear()
 
     # ----------------------------------------------------------- reading
 
@@ -530,12 +608,17 @@ def reset_attribution() -> None:
 # --------------------------------------------------------- the model
 
 
-def _attribution_dict(ns: dict, rows: int, tx: int, rx: int) -> dict:
+def _attribution_dict(ns: dict, rows: int, tx: int, rx: int,
+                      overlap_ns: int = 0) -> dict:
     total = sum(ns.get(s, 0) for s in STAGES)
     shares = {
         s: (round(ns.get(s, 0) / total, 4) if total else 0.0)
         for s in STAGES
     }
+    # overlap is transfer time hidden behind compute on another thread:
+    # already excluded from the transfer bill (and from total — it was
+    # not pipeline cost), reported as the did-we-overlap fraction
+    h2d = ns.get("transfer", 0) + overlap_ns
     return {
         "stage_us": {s: round(ns.get(s, 0) / 1e3, 1) for s in STAGES},
         "stage_share": shares,
@@ -545,6 +628,10 @@ def _attribution_dict(ns: dict, rows: int, tx: int, rx: int) -> dict:
         "wire_rx_bytes": rx,
         "bytes_per_sig_tx": round(tx / rows, 2) if rows else None,
         "bytes_per_sig_rx": round(rx / rows, 2) if rows else None,
+        "h2d_overlap_us": round(overlap_ns / 1e3, 1),
+        # 6 decimals: a real-but-thin overlap (host-heavy boxes dilute the
+        # denominator with pubkey-staging wall time) must not read as 0.0
+        "h2d_overlap_fraction": round(overlap_ns / h2d, 6) if h2d else 0.0,
     }
 
 
@@ -557,16 +644,30 @@ def attribution_of(spans: list[dict]) -> dict:
     fails if the share math drifts."""
     by_id = {r["id"]: r for r in spans}
     covered: dict[int, int] = {}
+    # the offline overlap model sees every busy interval up front
+    busy_by_tid: dict[int, list[tuple[int, int]]] = {}
+    for r in spans:
+        if r["cat"] in _BUSY_CATS and r["dur_ns"]:
+            busy_by_tid.setdefault(r["tid"], []).append(
+                (r["t0_ns"], r["t0_ns"] + r["dur_ns"]))
     # children finish before parents, so a single pass over spans sorted
     # by END time ascending propagates coverage bottom-up
     order = sorted(spans, key=lambda r: r["t0_ns"] + r["dur_ns"])
     ns = {s: 0 for s in STAGES}
-    rows = tx = rx = 0
+    rows = tx = rx = overlap = 0
     for r in order:
         counted = r["cat"] in STAGES
         cov = covered.get(r["id"], 0)
         if counted:
-            ns[r["cat"]] += max(0, r["dur_ns"] - cov)
+            self_ns = max(0, r["dur_ns"] - cov)
+            if r["cat"] == "transfer" and r["dur_ns"]:
+                ivals = [iv for tid, lst in busy_by_tid.items()
+                         if tid != r["tid"] for iv in lst]
+                ov = min(self_ns, _union_overlap_ns(
+                    r["t0_ns"], r["t0_ns"] + r["dur_ns"], ivals))
+                overlap += ov
+                self_ns -= ov
+            ns[r["cat"]] += self_ns
             n = r["attrs"].get("sig_rows", 0)
             rows += n if isinstance(n, int) else 0
             tx += r.get("bytes_tx", 0)
@@ -575,7 +676,7 @@ def attribution_of(spans: list[dict]) -> dict:
         if pid is not None and pid in by_id:
             covered[pid] = covered.get(pid, 0) + (
                 r["dur_ns"] if counted else cov)
-    return _attribution_dict(ns, rows, tx, rx)
+    return _attribution_dict(ns, rows, tx, rx, overlap)
 
 
 # ----------------------------------------------------------- exporters

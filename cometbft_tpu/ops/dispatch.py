@@ -419,6 +419,111 @@ def reset_supervision() -> None:
     """Forget breakers/counters (tests; a fresh process state)."""
     with _registry_lock:
         _supervisors.clear()
+    with _doublebuf_lock:
+        _doublebufs.clear()
+
+
+# ---------------------------------------------------------------------------
+# double-buffered dispatch gate
+# ---------------------------------------------------------------------------
+
+
+def _release_once(fn):
+    lock = threading.Lock()
+    state = {"done": False}
+
+    def release() -> None:
+        with lock:
+            if state["done"]:
+                return
+            state["done"] = True
+        fn()
+
+    return release
+
+
+class DoubleBuffer:
+    """Two-slot in-flight gate per fault domain — the dispatch-side half of
+    the StagingPool double-buffer contract (ops/limbs.py). A batch acquires
+    a slot BEFORE its h2d transfer and releases it as soon as its verify
+    dispatch is enqueued (the slot is scoped inside the dispatch closure,
+    never held to batch resolution — an abandoned thunk must not wedge the
+    gate), so with two slots batch N's host->device transfer overlaps
+    batch N-1's compute while batch N+2 queues behind the gate: bounded
+    in-flight staging, overlap by construction, no unbounded donated-buffer
+    growth.
+
+    Fault seam: chaos site `dispatch.doublebuf` fires at acquire. An
+    injected fault (a poisoned donated buffer) records against the domain's
+    `doublebuf.<domain>` supervisor and degrades the gate to SERIALIZED
+    single-buffer dispatch (one batch in flight end-to-end) while the
+    breaker is not admitting — overlap lost, verdicts untouched — and the
+    normal half-open schedule restores double-buffering. acquire() never
+    raises: a buffer-gate fault must degrade, not fail the batch."""
+
+    def __init__(self, domain: str, slots: int = 2) -> None:
+        self.domain = domain
+        self.slots = slots
+        self._sem = threading.BoundedSemaphore(slots)
+        self._serial = threading.Lock()
+        self._lock = threading.Lock()
+        self.acquires = 0
+        self.waits = 0
+        self.degraded = 0
+
+    def acquire(self):
+        """Block until a slot is free; returns a one-shot release callable
+        (safe to call from any thread, extra calls are no-ops)."""
+        from cometbft_tpu.libs import chaos
+
+        sup = supervisor(f"doublebuf.{self.domain}")
+        degraded = False
+        try:
+            chaos.fire("dispatch.doublebuf")
+            if sup.breaker.allow():
+                sup.breaker.record_success()
+            else:
+                degraded = True
+        except Exception as exc:  # noqa: BLE001 - injected/poisoned buffer
+            sup.record_op_failure(exc)
+            degraded = True
+        with self._lock:
+            self.acquires += 1
+            if degraded:
+                self.degraded += 1
+        if degraded:
+            self._serial.acquire()
+            return _release_once(self._serial.release)
+        if not self._sem.acquire(blocking=False):
+            with self._lock:
+                self.waits += 1
+            self._sem.acquire()
+        return _release_once(self._sem.release)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"slots": self.slots, "acquires": self.acquires,
+                    "waits": self.waits, "degraded": self.degraded}
+
+
+_doublebuf_lock = threading.Lock()
+_doublebufs: dict[str, DoubleBuffer] = {}
+
+
+def doublebuffer(domain: str = "dev0") -> DoubleBuffer:
+    """The per-fault-domain dispatch gate (single-chip kernels use dev0;
+    the mesh keys one per chip)."""
+    with _doublebuf_lock:
+        db = _doublebufs.get(domain)
+        if db is None:
+            db = DoubleBuffer(domain)
+            _doublebufs[domain] = db
+        return db
+
+
+def doublebuffer_stats() -> dict:
+    with _doublebuf_lock:
+        return {d: db.stats() for d, db in _doublebufs.items()}
 
 
 def _mesh_health() -> dict:
@@ -482,6 +587,18 @@ def health_snapshot() -> dict:
             "wire": _residency.stats(),
             "pubkey_cache": _ek.cache_stats(),
             "staging_pool": _limbs.POOL.stats(),
+            # the dispatch-side half of the double-buffer contract:
+            # per-fault-domain slot acquires/waits/degraded counts
+            "doublebuf": doublebuffer_stats(),
+        }
+        # device-challenge plane (ops/challenge.py): plans, per-lane
+        # device/host split, degradation reasons, prefix-table churn
+        from cometbft_tpu.ops import challenge as _challenge
+
+        snap["staging"]["challenge"] = {
+            "enabled": _challenge.enabled(),
+            "counters": _challenge.stats(),
+            "tables": _challenge.table_stats(),
         }
     except Exception:  # noqa: BLE001 - health must render even mid-import
         pass

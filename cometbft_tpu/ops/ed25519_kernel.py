@@ -152,6 +152,23 @@ def _pallas_available() -> bool:
     return _use_pallas
 
 
+_donate_staging: bool | None = None
+
+
+def _donate_ok() -> bool:
+    """Donate the staged wire block through the challenge-derive program
+    only on TPU: the identity pass-through output aliases the h2d buffer
+    straight into the verify dispatch. CPU jit donation is unsupported
+    (XLA warns and copies on every batch)."""
+    global _donate_staging
+    if _donate_staging is None:
+        try:
+            _donate_staging = jax.devices()[0].platform == "tpu"
+        except Exception:  # noqa: BLE001
+            _donate_staging = False
+    return _donate_staging
+
+
 # Serializes jit dispatch (and therefore tracing) across ALL curve kernels
 # and threads — see ops/dispatch.py for why the Pallas constant swap makes
 # this mandatory.
@@ -249,6 +266,39 @@ def _integrity_parts_expr(mask, allok, rw, sw, kw, expected):
 # in-flight batch, freed at resolution) and the host-side StagingPool
 # reuse underneath it.
 _integrity_parts = jax.jit(_integrity_parts_expr)
+
+
+def _integrity_parts_arrs_expr(mask, allok, expected, *arrs):
+    """_integrity_parts_expr generalized over arbitrary checksummed array
+    sets: the device-challenge wire is a flat block (+ optional fallback-k
+    scatter arrays), not three fixed r/s/k planes, and the checksummed set
+    differs per degradation rung. Same header/payload contract."""
+    chk = _device_checksum_expr(arrs)
+    ok = chk == expected.astype(jnp.uint32)
+    payload = jnp.concatenate([mask, ~mask, ok[None]])
+    tok = chk ^ jnp.where(allok & ok, OK_MAGIC, _BAD_MAGIC)
+    return jnp.stack([tok, ~tok]), payload
+
+
+_integrity_parts_arrs = jax.jit(_integrity_parts_arrs_expr)
+
+
+class _LateExpected:
+    """Host staging checksum resolved ON THE TRANSFER POOL: the
+    device-challenge dispatch closure picks its degradation rung (device
+    derive vs host-batch k) inside the closure, and each rung checksums a
+    different array set — so the expected value decode_header compares
+    against is a cell the closure fills before the header can be fetched
+    (the same late-binding contract as _LateOkA). int(cell) is what
+    decode_header and resolve_batches consume."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: int):
+        self.value = value
+
+    def __int__(self) -> int:
+        return int(self.value)
 
 
 def decode_header(header: np.ndarray, expected) -> str:
@@ -605,10 +655,14 @@ def _gather_coords(dev_u, idx):
 
 
 def _stage_gather(cache: "PubKeyCache", pubs: list[bytes], bucket: int,
-                  put_key: str = "", device=None
-                  ) -> tuple[np.ndarray, tuple, str, int]:
+                  put_key: str = "", device=None, want_enc: bool = False
+                  ) -> tuple:
     """(ok_a (N,), (ax, ay, az, at) device arrays (20, bucket), send
-    path, pubkey-staging wire bytes).
+    path, pubkey-staging wire bytes). With want_enc the tuple gains the
+    (8, bucket) resident pubkey-encoding words between a_dev and path —
+    served only by the indexed path (None otherwise), since only the
+    residency tables keep raw key bytes on device; a None enc is one of
+    the device-challenge degradation rungs (non-resident A).
 
     Indexed path first (ops/residency.py): when the batch's keys fit the
     device-resident validator table, the wire carries a 2-byte uint16
@@ -628,8 +682,11 @@ def _stage_gather(cache: "PubKeyCache", pubs: list[bytes], bucket: int,
     from cometbft_tpu.ops import residency as _residency
 
     got = _residency.stage(cache, pubs, bucket, put_key=put_key,
-                           device=device)
+                           device=device, want_enc=want_enc)
     if got is not None:
+        if want_enc:
+            ok_a, a_dev, enc_dev, staging_tx = got
+            return ok_a, a_dev, enc_dev, "indexed", staging_tx
         ok_a, a_dev, staging_tx = got
         return ok_a, a_dev, "indexed", staging_tx
     uniq = list(dict.fromkeys(pubs))
@@ -658,7 +715,10 @@ def _stage_gather(cache: "PubKeyCache", pubs: list[bytes], bucket: int,
     _linkmodel.tunnel().observe_transfer(
         idx.nbytes, _time.perf_counter() - t0)
     _trace.add_bytes(tx=idx.nbytes)
-    return ok_a, _gather_coords(dev_u, idx_dev), "full", idx.nbytes
+    a_dev = _gather_coords(dev_u, idx_dev)
+    if want_enc:
+        return ok_a, a_dev, None, "full", idx.nbytes
+    return ok_a, a_dev, "full", idx.nbytes
 
 
 _default_cache = PubKeyCache()
@@ -740,19 +800,14 @@ def _challenge_words(r_rows, pub_rows, msgs, mlens, pre_ok) -> np.ndarray:
     return k_words
 
 
-def stage_batch(
-    pubs: list[bytes], msgs: list[bytes], sigs: list[bytes], bucket: int,
-    out: np.ndarray | None = None,
-) -> tuple[np.ndarray, list[bytes], np.ndarray, np.ndarray, np.ndarray]:
-    """Host staging shared by the single-chip and mesh paths: structural
-    checks (lengths, s < L — never reach the device), SHA-512 challenges,
-    packed-word arrays padded to `bucket`, batch-minor (8, bucket) uint32.
-    Returns (pre_ok, safe_pubs, r_words, s_words, k_words).
-
-    All batch-axis numpy: vectorized length/s<L checks, one hashvec batch
-    call for the challenges, r/s/k packed in place into `out` — a leased
-    (3, 8, bucket) StagingPool block (limbs.POOL) — when given, else fresh
-    arrays (mesh/bench callers that keep the words)."""
+def _structural_stage(
+    pubs: list[bytes], sigs: list[bytes],
+) -> tuple[np.ndarray, list[bytes], np.ndarray, np.ndarray]:
+    """The host-side structural checks every staging path shares (lengths,
+    s < L — never reach the device), with placeholder substitution for the
+    failing rows. Returns (pre_ok, safe_pubs, sig_rows, pub_rows) — the
+    row matrices feed challenge computation (host or the device fallback
+    lanes) and the word packing."""
     n = len(sigs)
     ok_len = np.fromiter(map(len, sigs), np.int64, n) == 64
     ok_len &= np.fromiter(map(len, pubs), np.int64, n) == 32
@@ -779,7 +834,36 @@ def stage_batch(
         sig_rows[bad, 32:] = 0
         safe_pubs = [p if pre_ok[i] else _ID_ENC32
                      for i, p in enumerate(safe_pubs)]
+    return pre_ok, safe_pubs, sig_rows, pub_rows
 
+
+def stage_batch(
+    pubs: list[bytes], msgs: list[bytes], sigs: list[bytes], bucket: int,
+    out: np.ndarray | None = None,
+) -> tuple[np.ndarray, list[bytes], np.ndarray, np.ndarray, np.ndarray]:
+    """Host staging shared by the single-chip and mesh paths: structural
+    checks (lengths, s < L — never reach the device), SHA-512 challenges,
+    packed-word arrays padded to `bucket`, batch-minor (8, bucket) uint32.
+    Returns (pre_ok, safe_pubs, r_words, s_words, k_words).
+
+    All batch-axis numpy: vectorized length/s<L checks, one hashvec batch
+    call for the challenges, r/s/k packed in place into `out` — a leased
+    (3, 8, bucket) StagingPool block (limbs.POOL) — when given, else fresh
+    arrays (mesh/bench callers that keep the words). This is the
+    host-challenge path; the device-challenge twin (verify_batch_async's
+    ops/challenge.py branch) stages the same structural rows but ships
+    descriptors instead of k words."""
+    pre_ok, safe_pubs, sig_rows, pub_rows = _structural_stage(pubs, sigs)
+    r_words, s_words, k_words = _pack_host_words(
+        pre_ok, sig_rows, pub_rows, msgs, bucket, out=out)
+    return pre_ok, safe_pubs, r_words, s_words, k_words
+
+
+def _pack_host_words(pre_ok, sig_rows, pub_rows, msgs, bucket,
+                     out=None) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-challenge word packing: SHA-512 challenges plus the r/s/k
+    planes, identity-padded to `bucket`."""
+    n = sig_rows.shape[0]
     mlens = np.fromiter(map(len, msgs), np.int64, n)
     k_rows = _challenge_words(
         sig_rows[:, :32], pub_rows, msgs, mlens, pre_ok)
@@ -796,7 +880,28 @@ def stage_batch(
         r_words[0, n:] = 1
         s_words[:, n:] = 0
         k_words[:, n:] = 0
-    return pre_ok, safe_pubs, r_words, s_words, k_words
+    return r_words, s_words, k_words
+
+
+def _pack_device_block(sig_rows: np.ndarray, bucket: int, plan,
+                       block: np.ndarray) -> None:
+    """Pack a leased FLAT block for the device-challenge wire: R words,
+    s words (word-major (8, bucket) planes, identity-padded), then the
+    descriptor stream (challenge.fill_stream). No k words — that is the
+    point."""
+    n = sig_rows.shape[0]
+    sig_u4 = sig_rows.view("<u4")
+    rw = block[:8 * bucket].reshape(8, bucket)
+    sw = block[8 * bucket:16 * bucket].reshape(8, bucket)
+    rw[:, :n] = sig_u4[:, :8].T
+    sw[:, :n] = sig_u4[:, 8:].T
+    if bucket > n:
+        rw[:, n:] = 0
+        rw[0, n:] = 1
+        sw[:, n:] = 0
+    from cometbft_tpu.ops import challenge as _challenge
+
+    _challenge.fill_stream(block, bucket, plan)
 
 
 def verify_batch(
@@ -930,7 +1035,11 @@ def supervised_device_thunk(scheme: str, sup, submit_fn, fetch_site: str,
     is the host staging checksum the header is decoded against; `lease` is
     the StagingPool block backing the staged words, returned to the pool
     once the batch resolves (the _redo retry re-reads it, so release waits
-    for resolution, not dispatch)."""
+    for resolution, not dispatch). The DoubleBuffer in-flight slot is NOT
+    released here: the dispatch closure scopes it (acquire before h2d,
+    release in a finally after the verify dispatch), so an abandoned thunk
+    — a caller that takes device_parts() and never resolves, exactly like
+    an unreleased pool block — can never leak a slot and wedge the gate."""
     # wrap_ctx carries the caller's trace context onto the pool thread so
     # the dispatch's transfer/compute spans land inside this batch's tree
     fut = _xfer_pool().submit(_trace.wrap_ctx(sup.run), submit_fn)
@@ -1067,13 +1176,28 @@ def verify_batch_async(
     cache = cache or _default_cache
 
     b = bucket_size(n)
-    block = L.POOL.lease(b)
     # sig_rows: THE attribution row-counting site for this batch (one
     # stage span per dispatched batch; everything else is informational)
     with _trace.span("ed25519.stage", cat="stage", sig_rows=n, lanes=b,
                      hash_rung=_staging_rung()):
-        pre_ok, safe_pubs, r_words, s_words, k_words = stage_batch(
-            pubs, msgs, sigs, b, out=block)
+        pre_ok, safe_pubs, sig_rows, pub_rows = _structural_stage(pubs, sigs)
+        plan = None
+        if _dispatch.device_allowed():
+            try:
+                from cometbft_tpu.ops import challenge as _challenge
+
+                plan = _challenge.plan_batch(msgs, pre_ok)
+            except Exception:  # noqa: BLE001 - planning never breaks staging
+                plan = None
+        if plan is None:
+            block = L.POOL.lease(b)
+            r_words, s_words, k_words = _pack_host_words(
+                pre_ok, sig_rows, pub_rows, msgs, b, out=block)
+        else:
+            from cometbft_tpu.ops import challenge as _challenge
+
+            block = L.POOL.lease_flat(_challenge.block_words(b, plan.var))
+            _pack_device_block(sig_rows, b, plan, block)
     rows = (safe_pubs, list(msgs), list(sigs))
     info = (oracle.verify_zip215, "ed25519", recheck_groups)
     sup = _dispatch.supervisor("device")
@@ -1081,58 +1205,207 @@ def verify_batch_async(
     if not _dispatch.device_allowed():
         L.POOL.release(block)
         return make_host_thunk(n, pre_ok, rows, info)
-    expected = np.uint32(_host_checksum(r_words, s_words, k_words))
     ok_cell = _LateOkA(n)
 
-    def _transfer_and_dispatch():
+    if plan is None:
+        expected = np.uint32(_host_checksum(r_words, s_words, k_words))
+
+        def _transfer_and_dispatch():
+            from cometbft_tpu.libs import chaos
+            from cometbft_tpu.ops import residency as _residency
+
+            chaos.fire("ed25519.dispatch")
+            # pubkey staging rides the transfer pool too (reduced-send
+            # pipeline): the caller thread never blocks on the index/table
+            # round trip, so host staging of batch N+1 overlaps batch N's
+            # transfers instead of serializing behind the tunnel RTT. A
+            # staging failure here feeds the supervisor/breaker exactly
+            # like a dispatch failure (the batch lands on the host oracle).
+            with _trace.span("ed25519.stage_pubkeys", cat="transfer",
+                             lanes=b):
+                ok_a, a_dev, path, staging_tx = _stage_gather(
+                    cache, safe_pubs, b)
+            ok_cell.value = ok_a
+            # in-flight slot, scoped to h2d THROUGH the verify dispatch
+            # (a _redo retry or an abandoned thunk can never leak it):
+            # batch N's h2d overlaps batch N-1's compute, batch N+1
+            # queues until a slot frees
+            with _trace.span("ed25519.slot", cat="queue", lanes=b):
+                rel = _dispatch.doublebuffer(
+                    f"dev{default_device_index()}").acquire()
+            try:
+                with _trace.span("ed25519.h2d", cat="transfer",
+                                 lanes=b) as sp:
+                    t0 = _time.perf_counter()
+                    # ONE transfer for the whole (3, 8, B) staged block —
+                    # the r/s/k planes were three separate puts (three
+                    # tunnel round trips) before the reduced-send
+                    # protocol; the planes are sliced apart on device
+                    # where the copy is HBM-cheap. Blocking before t1
+                    # keeps the link-model sample honest (async dispatch
+                    # would record enqueue time, not wire time); the
+                    # verify dispatch below needs the words resident
+                    # anyway, and this thread is the transfer pool —
+                    # blocking it is the design.
+                    dev_block = jnp.asarray(block)
+                    jax.block_until_ready(dev_block)
+                    nbytes = block.nbytes
+                    _linkmodel.tunnel().observe_transfer(
+                        nbytes, _time.perf_counter() - t0)
+                    sp.add_bytes(tx=nbytes)
+                _residency.record_send(path, staging_tx + nbytes, sigs=n)
+                rw, sw, kw = dev_block[0], dev_block[1], dev_block[2]
+                with _trace.span("ed25519.dispatch", cat="compute", lanes=b,
+                                 device=default_device_index()):
+                    mask, allok = _dispatch_verify(a_dev, rw, sw, kw)
+                    parts = _integrity_parts(
+                        mask, allok, rw, sw, kw, expected)
+            finally:
+                rel()
+            _count_device_batch("ed25519", b)
+            return parts
+
+        # The host->device copy blocks the calling thread for the wire time
+        # (~45 ms/MB through the axon tunnel), so it runs on a small pool:
+        # the caller can stage batch i+1 while batch i's bytes are in
+        # flight, and parallel puts multiplex the tunnel.
+        return supervised_device_thunk(
+            "ed25519", sup, _transfer_and_dispatch, "ed25519.fetch",
+            n, pre_ok, ok_cell, rows, info, expected=expected, lease=block)
+
+    # ---- device-challenge path: the wire carries R/s + descriptors; k is
+    # derived on-chip (ops/challenge.py) with per-lane host fallbacks for
+    # the Plan's ineligible lanes, and a whole-batch host-k rung when the
+    # derive itself fails or A is not table-resident.
+    fb_lanes = np.flatnonzero(pre_ok & ~plan.eligible)
+    fb = 0
+    fkw = fidx = None
+    if fb_lanes.size:
+        with _trace.span("ed25519.challenge", cat="challenge",
+                         lanes=int(fb_lanes.size), rung="lane_fallback"):
+            mlens_fb = np.fromiter((len(msgs[i]) for i in fb_lanes),
+                                   np.int64, fb_lanes.size)
+            k_fb = _challenge_words(
+                np.ascontiguousarray(sig_rows[fb_lanes, :32]),
+                np.ascontiguousarray(pub_rows[fb_lanes]),
+                [msgs[i] for i in fb_lanes], mlens_fb,
+                np.ones(fb_lanes.size, dtype=bool))
+            fb = bucket_size(int(fb_lanes.size))
+            # pad by repeating the last real lane: the device scatter is
+            # idempotent, so the repeated index just rewrites the same
+            # value
+            fidx = np.full(fb, int(fb_lanes[-1]), dtype=np.int32)
+            fidx[:fb_lanes.size] = fb_lanes
+            fkw = np.tile(k_fb[-1:].T, (1, fb)).astype(np.uint32)
+            fkw[:, :fb_lanes.size] = k_fb.T
+    expected_cell = _LateExpected(
+        _host_checksum(block, fkw, fidx) if fb else _host_checksum(block))
+
+    def _transfer_and_dispatch_dc():
         from cometbft_tpu.libs import chaos
-        from cometbft_tpu.ops import residency as _residency
 
         chaos.fire("ed25519.dispatch")
-        # pubkey staging rides the transfer pool too (reduced-send
-        # pipeline): the caller thread never blocks on the index/table
-        # round trip, so host staging of batch N+1 overlaps batch N's
-        # transfers instead of serializing behind the tunnel RTT. A
-        # staging failure here feeds the supervisor/breaker exactly
-        # like a dispatch failure (the batch lands on the host oracle).
-        with _trace.span("ed25519.stage_pubkeys", cat="transfer",
-                         lanes=b):
-            ok_a, a_dev, path, staging_tx = _stage_gather(
-                cache, safe_pubs, b)
+        with _trace.span("ed25519.stage_pubkeys", cat="transfer", lanes=b):
+            ok_a, a_dev, enc_dev, path, staging_tx = _stage_gather(
+                cache, safe_pubs, b, want_enc=True)
         ok_cell.value = ok_a
+        with _trace.span("ed25519.slot", cat="queue", lanes=b):
+            rel = _dispatch.doublebuffer(
+                f"dev{default_device_index()}").acquire()
+        try:
+            return _challenge_rungs_and_dispatch(a_dev, enc_dev, path,
+                                                 staging_tx)
+        finally:
+            rel()
+
+    def _challenge_rungs_and_dispatch(a_dev, enc_dev, path, staging_tx):
+        from cometbft_tpu.libs import chaos
+        from cometbft_tpu.ops import challenge as _challenge
+        from cometbft_tpu.ops import residency as _residency
+
         with _trace.span("ed25519.h2d", cat="transfer", lanes=b) as sp:
             t0 = _time.perf_counter()
-            # ONE transfer for the whole (3, 8, B) staged block — the
-            # r/s/k planes were three separate puts (three tunnel round
-            # trips) before the reduced-send protocol; the planes are
-            # sliced apart on device where the copy is HBM-cheap.
-            # Blocking before t1 keeps the link-model sample honest
-            # (async dispatch would record enqueue time, not wire time);
-            # the verify dispatch below needs the words resident anyway,
-            # and this thread is the transfer pool — blocking it is the
-            # design.
             dev_block = jnp.asarray(block)
-            jax.block_until_ready(dev_block)
-            nbytes = block.nbytes
+            fkw_dev = fidx_dev = None
+            if fb:
+                fkw_dev = jnp.asarray(fkw)
+                fidx_dev = jnp.asarray(fidx)
+                jax.block_until_ready((dev_block, fkw_dev, fidx_dev))
+                nbytes = block.nbytes + fkw.nbytes + fidx.nbytes
+            else:
+                jax.block_until_ready(dev_block)
+                nbytes = block.nbytes
             _linkmodel.tunnel().observe_transfer(
                 nbytes, _time.perf_counter() - t0)
             sp.add_bytes(tx=nbytes)
         _residency.record_send(path, staging_tx + nbytes, sigs=n)
-        rw, sw, kw = dev_block[0], dev_block[1], dev_block[2]
+        kw = None
+        if enc_dev is not None:
+            sup_ch = _dispatch.supervisor(_challenge.SITE)
+
+            def _derive():
+                chaos.fire(_challenge.SITE)
+                run = _challenge.derive_fn(
+                    b, plan.var, plan.plen, plan.tlen, fb, _donate_ok())
+                args = (dev_block, enc_dev, plan.dev_tab)
+                if fb:
+                    args = args + (fkw_dev, fidx_dev)
+                with _trace.span("ed25519.challenge", cat="challenge",
+                                 lanes=b, device=default_device_index()):
+                    with _dispatch_lock:
+                        return run(*args)
+
+            try:
+                dev_out, kw = sup_ch.run(_derive)
+                if chaos.should_corrupt(_challenge.SITE):
+                    # perturbed device k: the failing lane must be caught
+                    # by the recheck plane, never reported as invalid
+                    kw = kw.at[0, 0].add(np.uint32(1))
+            except (_dispatch.DeviceUnavailable, _dispatch.DeviceOpFailed):
+                kw = None
+                _challenge.count("derive_failed")
+        else:
+            _challenge.count("enc_not_resident")
+        if kw is None:
+            # whole-batch host-k rung: compute k here on the transfer
+            # pool, re-upload the block (a donated derive may have
+            # consumed the first transfer) and the k plane
+            with _trace.span("ed25519.challenge", cat="challenge", lanes=b,
+                             rung="host_fallback"):
+                mlens = np.fromiter(map(len, msgs), np.int64, n)
+                k_rows = _challenge_words(
+                    sig_rows[:, :32], pub_rows, msgs, mlens, pre_ok)
+                kw_host = np.zeros((8, b), dtype=np.uint32)
+                kw_host[:, :n] = k_rows.T
+            t0 = _time.perf_counter()
+            dev_out = jnp.asarray(block)
+            kw = jnp.asarray(kw_host)
+            jax.block_until_ready((dev_out, kw))
+            fb_bytes = block.nbytes + kw_host.nbytes
+            _linkmodel.tunnel().observe_transfer(
+                fb_bytes, _time.perf_counter() - t0)
+            _trace.add_bytes(tx=fb_bytes)
+            _residency.record_send(path, fb_bytes)
+            expected_cell.value = _host_checksum(block, kw_host)
+            chk_arrs = (dev_out, kw)
+            _challenge.count("batch_host_fallback")
+        elif fb:
+            chk_arrs = (dev_out, fkw_dev, fidx_dev)
+        else:
+            chk_arrs = (dev_out,)
+        rw = dev_out[:8 * b].reshape(8, b)
+        sw = dev_out[8 * b:16 * b].reshape(8, b)
         with _trace.span("ed25519.dispatch", cat="compute", lanes=b,
                          device=default_device_index()):
             mask, allok = _dispatch_verify(a_dev, rw, sw, kw)
-            parts = _integrity_parts(mask, allok, rw, sw, kw, expected)
+            parts = _integrity_parts_arrs(
+                mask, allok, np.uint32(int(expected_cell)), *chk_arrs)
         _count_device_batch("ed25519", b)
         return parts
 
-    # The host->device copy blocks the calling thread for the wire time
-    # (~45 ms/MB through the axon tunnel), so it runs on a small pool:
-    # the caller can stage batch i+1 while batch i's bytes are in flight,
-    # and parallel puts multiplex the tunnel.
     return supervised_device_thunk(
-        "ed25519", sup, _transfer_and_dispatch, "ed25519.fetch",
-        n, pre_ok, ok_cell, rows, info, expected=expected, lease=block)
+        "ed25519", sup, _transfer_and_dispatch_dc, "ed25519.fetch",
+        n, pre_ok, ok_cell, rows, info, expected=expected_cell, lease=block)
 
 
 def resolve_batches(thunks) -> list[np.ndarray]:

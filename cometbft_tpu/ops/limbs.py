@@ -110,41 +110,63 @@ class StagingPool:
     a block stays leased for its batch's full flight — so the steady
     state holds two blocks per bucket (batch N in transfer/compute while
     batch N+1 stages), which is why warm() preallocates pairs and
-    MAX_FREE_PER_BUCKET is sized above 2."""
+    MAX_FREE_PER_SHAPE is sized above 2. The dispatch-side half of the
+    contract is ops/dispatch.DoubleBuffer: two in-flight slots per fault
+    domain, so batch N's h2d overlaps batch N-1's compute.
 
-    MAX_FREE_PER_BUCKET = 4
+    The free list is keyed by full block shape: the classic path leases
+    (3, 8, bucket) r/s/k planes, the device-challenge path
+    (ops/challenge.py) leases flat 1-D word blocks via lease_flat —
+    release() routes either kind home by its shape."""
+
+    MAX_FREE_PER_SHAPE = 4
 
     def __init__(self) -> None:
-        self._free: dict[int, list[np.ndarray]] = {}
+        self._free: dict[tuple, list[np.ndarray]] = {}
         self._lock = threading.Lock()
         self.leases = 0
         self.reuses = 0
 
-    def lease(self, bucket: int) -> np.ndarray:
+    def _lease_shape(self, shape: tuple) -> np.ndarray:
         with self._lock:
             self.leases += 1
-            free = self._free.get(bucket)
+            free = self._free.get(shape)
             if free:
                 self.reuses += 1
                 return free.pop()
-        return np.empty((3, 8, bucket), dtype=np.uint32)
+        return np.empty(shape, dtype=np.uint32)
+
+    def lease(self, bucket: int) -> np.ndarray:
+        return self._lease_shape((3, 8, bucket))
+
+    def lease_flat(self, nwords: int) -> np.ndarray:
+        """A flat (nwords,) uint32 block — the device-challenge wire
+        layout (R words, s words, descriptor stream)."""
+        return self._lease_shape((nwords,))
 
     def release(self, block: np.ndarray | None) -> None:
         if block is None:
             return
         with self._lock:
-            free = self._free.setdefault(block.shape[2], [])
-            if len(free) < self.MAX_FREE_PER_BUCKET:
+            free = self._free.setdefault(block.shape, [])
+            if len(free) < self.MAX_FREE_PER_SHAPE:
                 free.append(block)
+
+    def _warm_shape(self, shape: tuple, pairs: int) -> None:
+        with self._lock:
+            free = self._free.setdefault(shape, [])
+            while len(free) < min(pairs, self.MAX_FREE_PER_SHAPE):
+                free.append(np.empty(shape, dtype=np.uint32))
 
     def warm(self, bucket: int, pairs: int = 2) -> None:
         """Preallocate `pairs` blocks for a bucket so the first flushes
         of the double-buffered steady state never allocate on the hot
         path (scheduler warmup calls this along the bucket ladder)."""
-        with self._lock:
-            free = self._free.setdefault(bucket, [])
-            while len(free) < min(pairs, self.MAX_FREE_PER_BUCKET):
-                free.append(np.empty((3, 8, bucket), dtype=np.uint32))
+        self._warm_shape((3, 8, bucket), pairs)
+
+    def warm_flat(self, nwords: int, pairs: int = 2) -> None:
+        """warm() for the device-challenge flat blocks."""
+        self._warm_shape((nwords,), pairs)
 
     def stats(self) -> dict:
         with self._lock:

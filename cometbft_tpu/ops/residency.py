@@ -184,14 +184,20 @@ def _init_table_fn():
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def init(cap: int):
-        """Fresh (20, cap) x 4 coordinate table built ON DEVICE (no
-        wire bytes): every row the extended identity (X=0, Y=1, Z=1,
-        T=0) — the padding encoding for BOTH schemes (ed25519's y=1
-        point and the ristretto identity decode to the same extended
-        coords)."""
+        """Fresh (20, cap) x 4 coordinate table plus the (8, cap)
+        compressed-encoding plane, built ON DEVICE (no wire bytes):
+        every row the extended identity (X=0, Y=1, Z=1, T=0) — the
+        padding encoding for BOTH schemes (ed25519's y=1 point and the
+        ristretto identity decode to the same extended coords). The enc
+        plane holds each row's 32 raw key bytes as 8 LE uint32 words
+        (identity: y=1 -> word0=1) — the A half of the on-device
+        challenge preimage SHA-512(R||A||M), so the device-challenge
+        path (ops/challenge.py) never re-ships key bytes it already has
+        resident as coordinates."""
         zero = jnp.zeros((20, cap), jnp.int32)
         one = zero.at[0, :].set(1)
-        return zero, one, one, zero
+        enc = jnp.zeros((8, cap), jnp.uint32).at[0, :].set(1)
+        return zero, one, one, zero, enc
 
     return init
 
@@ -202,12 +208,25 @@ def _scatter_fn():
     jnp = _jnp()
 
     @jax.jit
-    def scatter(tx, ty, tz, tt, idx, vals):
+    def scatter(tx, ty, tz, tt, te, idx, vals, enc):
         i = idx.astype(jnp.int32)
         return (tx.at[:, i].set(vals[0]), ty.at[:, i].set(vals[1]),
-                tz.at[:, i].set(vals[2]), tt.at[:, i].set(vals[3]))
+                tz.at[:, i].set(vals[2]), tt.at[:, i].set(vals[3]),
+                te.at[:, i].set(enc))
 
     return scatter
+
+
+@functools.lru_cache(maxsize=1)
+def _gather_enc_fn():
+    jax = _jax()
+    jnp = _jnp()
+
+    @jax.jit
+    def gather(te, idx):
+        return jnp.take(te, idx.astype(jnp.int32), axis=1)
+
+    return gather
 
 
 class _NoRoom(Exception):
@@ -339,21 +358,28 @@ class KeyTable:
         vals[1, 0, :] = 1  # Y = 1
         vals[2, 0, :] = 1  # Z = 1
         vals[:, :, :len(missing)] = coords.transpose(1, 2, 0)
+        # the compressed-encoding plane rides the same delta: the rows'
+        # raw 32 key bytes as 8 LE words (identity word0=1 padding)
+        enc = np.zeros((8, db), dtype=np.uint32)
+        enc[0, :] = 1
+        enc[:, :len(missing)] = np.frombuffer(
+            b"".join(missing), dtype=np.uint8).reshape(-1, 32).view("<u4").T
         idx = np.full(db, self.id_row, dtype=np.int32)
         idx[:len(missing)] = rows
-        expected = EK._host_checksum(vals)
+        expected = EK._host_checksum(vals, enc)
         dev = self._build()
         scatter = _scatter_fn()
         for attempt in (1, 2):
             t0 = _time.perf_counter()
             vals_dev = self._put(vals)
+            enc_dev = self._put(enc)
             idx_dev = self._put(idx)
-            _jax().block_until_ready((vals_dev, idx_dev))
-            nbytes = vals.nbytes + idx.nbytes
+            _jax().block_until_ready((vals_dev, enc_dev, idx_dev))
+            nbytes = vals.nbytes + enc.nbytes + idx.nbytes
             _linkmodel.tunnel().observe_transfer(
                 nbytes, _time.perf_counter() - t0)
             _trace.add_bytes(tx=nbytes)
-            got = int(np.asarray(EK._device_checksum((vals_dev,))))
+            got = int(np.asarray(EK._device_checksum((vals_dev, enc_dev))))
             if got == expected:
                 break
             self.counters["checksum_retries"] += 1
@@ -362,14 +388,15 @@ class KeyTable:
                 raise RuntimeError(
                     "validator-table delta upload corrupted twice; "
                     "refusing to cache a poisoned row")
-        self._dev = tuple(scatter(*dev, idx_dev, vals_dev))
+        self._dev = tuple(scatter(*dev, idx_dev, vals_dev, enc_dev))
         for i, key in enumerate(missing):
             self._rows[key] = rows[i]
             self._ok[key] = bool(ok[i])
         self.counters["delta_updates"] += 1
         self.counters["delta_rows"] += len(missing)
-        record_send(path, vals.nbytes + idx.nbytes)
-        return vals.nbytes + idx.nbytes
+        nbytes = vals.nbytes + enc.nbytes + idx.nbytes
+        record_send(path, nbytes)
+        return nbytes
 
     # --------------------------------------------------------- epoch pins
 
@@ -429,11 +456,14 @@ class KeyTable:
     # ------------------------------------------------------------ staging
 
     def stage(self, pubs: list[bytes], bucket: int,
-              announced: dict | None = None):
+              announced: dict | None = None, want_enc: bool = False):
         """The indexed send: (ok_a (N,), (ax, ay, az, at) device arrays
-        (20, bucket), index-vector wire bytes). Unseen keys delta-insert
-        first (counted separately); raises _NoRoom when the batch cannot
-        fit, which returns the caller to the full-key path."""
+        (20, bucket), index-vector wire bytes) — plus, with want_enc,
+        the (8, bucket) gathered compressed-encoding words between the
+        coords and the byte count (the device-challenge path's A rows).
+        Unseen keys delta-insert first (counted separately); raises
+        _NoRoom when the batch cannot fit, which returns the caller to
+        the full-key path."""
         from cometbft_tpu.libs import linkmodel as _linkmodel
         from cometbft_tpu.libs import trace as _trace
         from cometbft_tpu.ops import ed25519_kernel as EK
@@ -470,7 +500,10 @@ class KeyTable:
         _linkmodel.tunnel().observe_transfer(
             idx.nbytes, _time.perf_counter() - t0)
         _trace.add_bytes(tx=idx.nbytes)
-        return ok_a, EK._gather_coords(dev, idx_dev), idx.nbytes
+        coords = EK._gather_coords(dev[:4], idx_dev)
+        if want_enc:
+            return ok_a, coords, _gather_enc_fn()(dev[4], idx_dev), idx.nbytes
+        return ok_a, coords, idx.nbytes
 
     def stats(self) -> dict:
         with self._lock:
@@ -562,11 +595,11 @@ def table_for(cache, put_key: str = "", device=None) -> KeyTable | None:
 
 
 def stage(cache, pubs: list[bytes], bucket: int, put_key: str = "",
-          device=None):
+          device=None, want_enc: bool = False):
     """Try the reduced-send indexed path for a batch. Returns
-    (ok_a, a_dev, index_bytes) or None when the full-key path must
-    serve (disabled, untagged cache, capacity overflow, or a failed
-    delta upload)."""
+    (ok_a, a_dev, index_bytes) — or (ok_a, a_dev, enc_dev, index_bytes)
+    with want_enc — or None when the full-key path must serve (disabled,
+    untagged cache, capacity overflow, or a failed delta upload)."""
     if not _cfg["enabled"]:
         return None
     tbl = table_for(cache, put_key=put_key, device=device)
@@ -576,7 +609,8 @@ def stage(cache, pubs: list[bytes], bucket: int, put_key: str = "",
     with _reg_lock:
         announced = dict(_announced.get(scheme, {}))
     try:
-        return tbl.stage(pubs, bucket, announced=announced)
+        return tbl.stage(pubs, bucket, announced=announced,
+                         want_enc=want_enc)
     except _NoRoom:
         return None
     except Exception:  # noqa: BLE001 - degraded, never a wrong verdict

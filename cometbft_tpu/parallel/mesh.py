@@ -502,31 +502,44 @@ class VerifyMesh:
             else:
                 ok_a, host_arrs = self._host_coords(
                     ops["cache"](), safe_pubs, b)
-        with _trace.span(f"{scheme}.h2d", cat="transfer", lanes=b,
-                         device=chip.index) as sp:
-            t0 = _time.perf_counter()
-            rwd = jax.device_put(rw, chip.device)
-            swd = jax.device_put(sw, chip.device)
-            kwd = jax.device_put(kw, chip.device)
-            nbytes = rw.nbytes + sw.nbytes + kw.nbytes
-            if host_arrs is not None:
-                a_dev = tuple(
-                    jax.device_put(a, chip.device) for a in host_arrs)
-                nbytes += sum(a.nbytes for a in host_arrs)
-            jax.block_until_ready((rwd, swd, kwd) + tuple(a_dev))
-            _linkmodel.tunnel().observe_transfer(
-                nbytes, _time.perf_counter() - t0)
-            sp.add_bytes(tx=nbytes)
-        try:
-            from cometbft_tpu.ops import residency as _residency
+        # per-fault-domain in-flight gate: each chip holds its own two
+        # slots, so shard N's h2d overlaps shard N-1's compute ON THE
+        # SAME CHIP while a third shard queues — and a chip degraded to
+        # single-buffer (chaos / device trouble) serializes only its own
+        # fault domain, never its mesh siblings
+        from cometbft_tpu.ops import dispatch as _dispatchmod
 
-            _residency.record_send(send_path, staging_tx + nbytes, sigs=n)
-        except Exception:  # noqa: BLE001 - accounting must not break shards
-            pass
-        with _trace.span(f"{scheme}.dispatch", cat="compute", lanes=b,
+        with _trace.span(f"{scheme}.slot", cat="queue", lanes=b,
                          device=chip.index):
-            with KERNEL_DISPATCH_LOCK:
-                mask_dev, _allok = ops["kernel"](*a_dev, rwd, swd, kwd)
+            rel = _dispatchmod.doublebuffer(f"dev{chip.index}").acquire()
+        try:
+            with _trace.span(f"{scheme}.h2d", cat="transfer", lanes=b,
+                             device=chip.index) as sp:
+                t0 = _time.perf_counter()
+                rwd = jax.device_put(rw, chip.device)
+                swd = jax.device_put(sw, chip.device)
+                kwd = jax.device_put(kw, chip.device)
+                nbytes = rw.nbytes + sw.nbytes + kw.nbytes
+                if host_arrs is not None:
+                    a_dev = tuple(
+                        jax.device_put(a, chip.device) for a in host_arrs)
+                    nbytes += sum(a.nbytes for a in host_arrs)
+                jax.block_until_ready((rwd, swd, kwd) + tuple(a_dev))
+                _linkmodel.tunnel().observe_transfer(
+                    nbytes, _time.perf_counter() - t0)
+                sp.add_bytes(tx=nbytes)
+            try:
+                from cometbft_tpu.ops import residency as _residency
+
+                _residency.record_send(send_path, staging_tx + nbytes, sigs=n)
+            except Exception:  # noqa: BLE001 - accounting must not break shards
+                pass
+            with _trace.span(f"{scheme}.dispatch", cat="compute", lanes=b,
+                             device=chip.index):
+                with KERNEL_DISPATCH_LOCK:
+                    mask_dev, _allok = ops["kernel"](*a_dev, rwd, swd, kwd)
+        finally:
+            rel()
         with _trace.span(f"{scheme}.d2h", cat="fetch",
                          device=chip.index) as sp:
             mask = np.asarray(mask_dev)

@@ -789,6 +789,16 @@ class VerifyScheduler:
             # double-buffer pair per rung: the first real flushes must
             # not allocate staging blocks on the hot path
             _limbs.POOL.warm(b)
+            try:
+                from cometbft_tpu.ops import challenge as _challenge
+
+                if _challenge.enabled():
+                    # worst-case flat wire block for the device-challenge
+                    # path (smaller vars warm organically on first use)
+                    _limbs.POOL.warm_flat(
+                        _challenge.block_words(b, _challenge.MAX_VAR))
+            except Exception:  # noqa: BLE001 - warmup is best-effort
+                pass
             # identity-point rows: pub = the identity encoding, s = 0 —
             # structurally valid, decompress trivially, verify cheap
             pubs = [EK._ID_ENC32] * b
@@ -872,7 +882,9 @@ class VerifyScheduler:
     def planning_bytes_per_sig() -> float:
         """The live wire cost of one signature used for flush planning:
         the reduced-send accounting's measured rate (ops/residency.py —
-        the number PR 6's trace attribution also records), falling back
+        the number PR 6's trace attribution also records; with device
+        challenge derivation on, the measured steady state is ~66-82
+        B/sig because the k plane never crosses the wire), falling back
         to the rolling attribution model, then to the pre-reduced-send
         96 B/sig constant only when the process has not sent a single
         batch yet."""

@@ -75,13 +75,89 @@ class TestDirectionAwareCompare:
         assert bc.compare(worse, old)["verdict"] == "pass"
 
     def test_wire_bound_metrics_never_fail(self):
-        old = _record()
-        worse = _record(stream_sigs_per_s=10_000.0)  # -90%, wire-bound
+        old = _record(blocksync_blocks_per_s=30.0)
+        worse = _record(blocksync_blocks_per_s=3.0)  # -90%, wire-bound
         v = bc.compare(old, worse)
         assert v["verdict"] == "pass"
-        row = v["metrics"]["stream_sigs_per_s"]
+        row = v["metrics"]["blocksync_blocks_per_s"]
         assert row["verdict"] == "info"
         assert "wire-bound" in row["why_info"]
+
+    def test_stream_sigs_promoted_to_enforced_higher_better(self):
+        """stream_sigs_per_s graduated from WIRE_BOUND (ISSUE 20): with
+        device-side challenge derivation the stream is no longer
+        send-bound, so a drop past 50% FAILS, the same delta as an
+        improvement passes, and the verdict row carries the promotion
+        rationale (why) so a failing run explains its own contract."""
+        assert "stream_sigs_per_s" not in bc.WIRE_BOUND
+        old = _record()  # stream_sigs_per_s=100_000
+        worse = _record(stream_sigs_per_s=40_000.0)  # -60% vs 50%
+        v = bc.compare(old, worse)
+        assert v["verdict"] == "fail"
+        assert "stream_sigs_per_s" in v["regressions"]
+        row = v["metrics"]["stream_sigs_per_s"]
+        assert row["direction"] == bc.HIGHER
+        assert "promoted from wire-bound" in row["why"]
+        assert bc.compare(worse, old)["verdict"] == "pass"
+        # within the wide threshold: tunnel RTT wiggle still tolerated
+        v2 = bc.compare(old, _record(stream_sigs_per_s=60_000.0))  # -40%
+        assert v2["metrics"]["stream_sigs_per_s"]["verdict"] == "pass"
+
+    def test_stream_sentinel_self_test_case(self):
+        """--self-test contract on a stream-shaped record: an injected
+        stream-throughput regression is flagged; the identical snapshot
+        and the improvement direction are not."""
+        rec = _record()
+        worse, metric, pct = bc.inject_regression(
+            rec, metric="stream_sigs_per_s")
+        assert metric == "stream_sigs_per_s" and pct > 50.0
+        assert worse["detail"]["stream_sigs_per_s"] < 100_000.0  # HIGHER
+        caught = bc.compare(rec, worse)
+        assert caught["verdict"] == "fail"
+        assert metric in caught["regressions"]
+        assert bc.compare(rec, rec)["verdict"] == "pass"
+        assert bc.compare(worse, rec)["verdict"] == "pass"
+
+
+class TestAbsoluteWireBounds:
+    """The device-challenge wire-format ceiling: steady-state bytes/sig
+    must stay <= 82 in any snapshot that shows device-derived lanes —
+    an ABSOLUTE bound on the new snapshot, not a relative diff."""
+
+    def test_bound_fails_over_ceiling_with_evidence(self):
+        new = _record(wire={"steady_state_bytes_per_sig": 91.0},
+                      challenge={"lanes_device": 1024.0})
+        v = bc.compare(_record(), new)
+        assert v["verdict"] == "fail"
+        assert "bound:wire.steady_state_bytes_per_sig" in v["regressions"]
+        row = v["bounds"]["wire.steady_state_bytes_per_sig"]
+        assert row["verdict"] == "fail"
+        assert row["ceiling"] == 82.0
+        assert "82 B/sig" in row["why"]
+
+    def test_bound_passes_at_or_under_ceiling(self):
+        new = _record(wire={"steady_state_bytes_per_sig": 76.0},
+                      challenge={"lanes_device": 1024.0})
+        v = bc.compare(_record(), new)
+        assert v["verdict"] == "pass"
+        assert v["bounds"]["wire.steady_state_bytes_per_sig"][
+            "verdict"] == "pass"
+
+    def test_bound_disarmed_without_device_challenge_evidence(self):
+        """A knob-off run (or a pre-knob baseline) legitimately rides the
+        98 B/sig host-k format — the bound must report info, not fail."""
+        for challenge in ({}, {"lanes_device": 0.0}):
+            new = _record(wire={"steady_state_bytes_per_sig": 98.0},
+                          challenge=challenge)
+            v = bc.compare(_record(), new)
+            assert v["verdict"] == "pass"
+            row = v["bounds"]["wire.steady_state_bytes_per_sig"]
+            assert row["verdict"] == "info"
+            assert "disarmed" in row["why_info"]
+
+    def test_bound_absent_metric_is_silent(self):
+        v = bc.compare(_record(), _record())
+        assert "bounds" not in v
 
     def test_within_threshold_passes(self):
         v = bc.compare(_record(), dict(_record(), value=700_000.0))  # -12.5%

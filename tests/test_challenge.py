@@ -1,0 +1,296 @@
+"""Device-challenge equality tests: the lane-pair device SHA-512 and the
+device Barrett reduction (ops/challenge.py) must be bit-for-bit identical
+to the hashvec host twins — RFC 8032 challenge inputs, every padded
+block-count group, ragged/boundary lengths, and a randomized 10k-row
+sweep — plus the prefix/tail table contract (content keying, LRU + plan
+protection, checksummed sync, snapshot immutability) and the planner's
+degradation ladder. Tier-1-safe: JAX_PLATFORMS=cpu runs everything on
+the forced-host platform; on real hardware the same programs ride the
+TPU/XLA rungs unchanged."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.libs.prefixrows import PrefixedMsg
+from cometbft_tpu.ops import challenge, hashvec
+from cometbft_tpu.ops import limbs as _limbs
+
+_RFC8032 = [
+    (  # TEST 1: empty message
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e0652249015"
+        "55fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (  # TEST 2: one byte
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69d"
+        "a085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (  # TEST 3: two bytes
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3a"
+        "c18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+def _rows(datas: list[bytes]) -> np.ndarray:
+    ln = len(datas[0])
+    return np.frombuffer(b"".join(datas), dtype=np.uint8).reshape(
+        len(datas), ln) if ln else np.zeros((len(datas), 0), dtype=np.uint8)
+
+
+def test_rfc8032_challenge_inputs_device():
+    ell = hashvec.L_ED25519
+    for pub, m, sig in _RFC8032:
+        d = bytes.fromhex(sig)[:32] + bytes.fromhex(pub) + bytes.fromhex(m)
+        datas = [d] * 9  # one padded-block group per vector
+        got = challenge.sha512_rows_device(_rows(datas))
+        want = hashlib.sha512(d).digest()
+        for i in range(9):
+            assert got[i].tobytes() == want
+        words = challenge.reduce512_mod_l_device(got)
+        k = int.from_bytes(want, "little") % ell
+        for i in range(9):
+            assert words[i].tobytes() == k.to_bytes(32, "little")
+
+
+def test_sha512_device_block_boundaries():
+    """Padding edges: every padded-block-count group (1/2/3 blocks) and
+    the lengths straddling the 1->2 and 2->3 boundaries."""
+    for ln in (0, 1, 63, 111, 112, 113, 127, 128, 129, 239, 240, 241):
+        rows = np.arange(16 * max(ln, 1), dtype=np.uint64).astype(
+            np.uint8).reshape(16, -1)[:, :ln]
+        rows = np.ascontiguousarray(rows)
+        got = challenge.sha512_rows_device(rows)
+        host = hashvec.sha512_rows(rows)
+        assert got.tobytes() == host.tobytes(), ln
+        for i in range(16):
+            assert got[i].tobytes() == \
+                hashlib.sha512(rows[i].tobytes()).digest(), ln
+
+
+def test_reduce512_mod_l_device_edges():
+    ell = hashvec.L_ED25519
+    edge_vals = [0, 1, ell - 1, ell, ell + 1, 2 * ell, 3 * ell - 1,
+                 (1 << 252), (1 << 512) - 1, (ell << 256) + ell - 1]
+    rng = np.random.default_rng(0xBA44E77)
+    vals = edge_vals + [int.from_bytes(rng.bytes(64), "little")
+                        for _ in range(64)]
+    digests = np.frombuffer(
+        b"".join(v.to_bytes(64, "little") for v in vals),
+        dtype=np.uint8).reshape(len(vals), 64)
+    words = challenge.reduce512_mod_l_device(digests)
+    host = hashvec.reduce512_mod_l(digests)
+    assert words.tobytes() == host.tobytes()
+    for i, v in enumerate(vals):
+        assert words[i].tobytes() == (v % ell).to_bytes(32, "little"), i
+
+
+def test_sha512_device_randomized_sweep():
+    """10k-row bit-for-bit sweep against the host ladder, one compile
+    per block group (uniform row length per group — the commit shape)."""
+    rng = np.random.default_rng(0xD5A512)
+    total = 0
+    for ln in (96, 122, 180, 230):
+        n = 2500
+        rows = rng.integers(0, 256, size=(n, ln), dtype=np.uint8)
+        got = challenge.sha512_rows_device(rows)
+        host = hashvec.sha512_rows(rows)
+        assert got.tobytes() == host.tobytes(), ln
+        kd = challenge.reduce512_mod_l_device(got)
+        kh = hashvec.reduce512_mod_l(host)
+        assert kd.tobytes() == kh.tobytes(), ln
+        total += n
+    assert total == 10000
+
+
+# ------------------------------------------------------- prefix/tail table
+
+
+def test_prefix_table_content_keying_and_eviction():
+    tab = challenge.PrefixTable("t0")
+    r0 = tab.ensure(b"prefix-a", b"tail")
+    assert tab.ensure(b"prefix-a", b"tail") == r0  # content hit
+    r1 = tab.ensure(b"prefix-b", b"tail")
+    assert r1 != r0
+    assert tab.ensure(b"x" * (challenge.PREFIX_CAP + 1), b"") is None
+    st = tab.stats()
+    assert st["inserts"] == 2 and st["hits"] == 1 and st["rows"] == 2
+
+
+def test_prefix_table_lru_eviction_respects_plan_protection():
+    tab = challenge.PrefixTable("t1")
+    rows = {}
+    for i in range(challenge.TABLE_ROWS):
+        rows[i] = tab.ensure(b"p%06d" % i, b"")
+    assert tab.stats()["rows"] == challenge.TABLE_ROWS
+    # protecting every row starves eviction: the new content must miss
+    assert tab.ensure(b"fresh", b"", protect=set(rows.values())) is None
+    # unprotected: the LRU row (the oldest insert) is evicted
+    r = tab.ensure(b"fresh", b"", protect={rows[i] for i in range(1, 8)})
+    assert r == rows[0]
+    assert tab.stats()["evictions"] == 1
+
+
+def test_prefix_table_sync_snapshot_is_immutable():
+    tab = challenge.PrefixTable("t2")
+    tab.ensure(b"alpha", b"T")
+    snap1 = tab.sync()
+    assert snap1 is not None
+    got = np.asarray(snap1)[0, :6].tobytes()
+    assert got == b"alphaT"
+    # a later insert + sync must not mutate the captured snapshot
+    tab.ensure(b"beta-longer", b"T")
+    snap2 = tab.sync()
+    assert np.asarray(snap1)[1].sum() == 0
+    assert np.asarray(snap2)[1, :12].tobytes() == b"beta-longerT"
+
+
+# ---------------------------------------------------------------- planning
+
+
+def _vote_batch(n: int, var_ts: bool = True):
+    """A vote-flush-shaped batch: one shared prefix object, per-lane
+    timestamp-ish variable bytes, a common chain-id tail."""
+    prefix = b"\x08\x02\x11" + b"H" * 100  # ~103 B shared sign-bytes head
+    tail = b"\x32\x09chain-xyz"
+    msgs = []
+    for i in range(n):
+        ts = b"\x2a\x0c" + i.to_bytes(6, "big") + b"\x00\x00\x00\x00"
+        msgs.append(PrefixedMsg(prefix, ts + tail))
+    return msgs
+
+
+def test_plan_batch_vote_shape_and_fill_stream():
+    challenge.reset()
+    msgs = _vote_batch(32)
+    pre_ok = np.ones(32, dtype=bool)
+    plan = challenge.plan_batch(msgs, pre_ok, put_key="plantest")
+    assert plan is not None
+    assert plan.n_eligible == 32 and plan.n_fallback == 0
+    # the common chain-id trailer factored into the table row, off the wire
+    assert plan.tlen >= len(b"\x32\x09chain-xyz")
+    assert plan.var <= challenge.MAX_VAR
+    assert plan.plen == 103
+    bucket = 32
+    block = np.zeros(challenge.block_words(bucket, plan.var),
+                     dtype=np.uint32)
+    challenge.fill_stream(block, bucket, plan)
+    sb = block[16 * bucket:].view(np.uint8)
+    desc = sb[:2 * bucket].view("<u2")
+    assert all(int(d) & 0x8000 for d in desc[:32])
+    vb = sb[2 * bucket:2 * bucket + bucket * plan.var].reshape(
+        bucket, plan.var)
+    for i in range(32):
+        suffix = msgs[i].suffix
+        assert vb[i].tobytes() == suffix[:plan.var]
+
+
+def test_plan_batch_degradation_reasons():
+    challenge.reset()
+    msgs = _vote_batch(16)
+    ok = np.ones(16, dtype=bool)
+    challenge.configure(enabled=False)
+    try:
+        assert challenge.plan_batch(msgs, ok) is None
+    finally:
+        challenge.configure(enabled=True)
+    # too-small batches stay on the classic path
+    assert challenge.plan_batch(msgs[:2], ok[:2]) is None
+    # fully-divergent suffixes blow MAX_VAR: no plan
+    rng = np.random.default_rng(3)
+    ragged = [PrefixedMsg(b"P" * 40, rng.bytes(60)) for _ in range(16)]
+    assert challenge.plan_batch(ragged, ok) is None
+    # oversize messages: no plan
+    big = [PrefixedMsg(b"P" * 300, b"s" * 8) for _ in range(16)]
+    assert challenge.plan_batch(big, ok) is None
+    st = challenge.stats()
+    assert st.get("plan_disabled") and st.get("plan_small")
+    assert st.get("plan_oversize_var") and st.get("plan_oversize")
+
+
+def test_plan_batch_breaker_open_degrades():
+    from cometbft_tpu.ops import dispatch
+
+    dispatch.reset_supervision()
+    challenge.reset()
+    try:
+        sup = dispatch.supervisor(challenge.SITE)
+        sup.breaker.record_failure(dispatch.PERMANENT)
+        assert not sup.breaker.peek()
+        assert challenge.plan_batch(
+            _vote_batch(16), np.ones(16, dtype=bool)) is None
+        assert challenge.stats().get("plan_breaker_open")
+    finally:
+        dispatch.reset_supervision()
+
+
+def test_plan_batch_mixed_lanes_fall_back_per_lane():
+    challenge.reset()
+    msgs = _vote_batch(24)
+    msgs[5] = PrefixedMsg(b"other-prefix!", b"odd-suffix-here")  # nonconform
+    msgs[9] = b"a plain bytes message....."
+    pre_ok = np.ones(24, dtype=bool)
+    pre_ok[11] = False  # structurally bad lane: neither device nor fallback
+    plan = challenge.plan_batch(msgs, pre_ok, put_key="mixed")
+    assert plan is not None
+    assert not plan.eligible[5] and not plan.eligible[9]
+    assert not plan.eligible[11]
+    assert plan.n_eligible == 21
+    assert plan.n_fallback == 2  # lanes 5 and 9 (live but nonconforming)
+
+
+# --------------------------------------------- the derive program end-to-end
+
+
+def test_derive_fn_matches_host_challenges():
+    """The full device pipeline — descriptor decode, table gather,
+    message assembly, SHA-512, Barrett — against host challenge words,
+    with per-lane fallback scatter and padding lanes zeroed."""
+    challenge.reset()
+    import jax.numpy as jnp
+
+    n, bucket = 24, 32
+    rng = np.random.default_rng(0xDE51)
+    msgs = _vote_batch(n)
+    msgs[7] = PrefixedMsg(b"weird", b"nonconforming-suffix-length")
+    pre_ok = np.ones(n, dtype=bool)
+    plan = challenge.plan_batch(msgs, pre_ok, put_key="derive")
+    assert plan is not None and plan.n_fallback == 1
+    sigs = rng.integers(0, 256, size=(n, 32), dtype=np.uint8)  # R encodings
+    pubs = rng.integers(0, 256, size=(n, 32), dtype=np.uint8)
+    block = np.zeros(challenge.block_words(bucket, plan.var),
+                     dtype=np.uint32)
+    rw = _limbs.bytes_to_words(sigs)  # (n, 8)
+    block[:8 * bucket].reshape(8, bucket)[:, :n] = rw.T
+    challenge.fill_stream(block, bucket, plan)
+    aw = np.zeros((8, bucket), dtype=np.uint32)
+    aw[:, :n] = _limbs.bytes_to_words(pubs).T
+    # host fallback words for the nonconforming lane, padded to 2
+    fb_lanes = np.flatnonzero(pre_ok & ~plan.eligible)
+    fkw_rows = hashvec.sha512_mod_l_words(
+        [sigs[i].tobytes() + pubs[i].tobytes() + bytes(msgs[i])
+         for i in fb_lanes])
+    fb = 2
+    fidx = np.full(fb, fb_lanes[-1], dtype=np.int32)
+    fidx[:len(fb_lanes)] = fb_lanes
+    fkw = np.tile(fkw_rows[-1:].T, (1, fb)).astype(np.uint32)
+    fkw[:, :len(fb_lanes)] = fkw_rows.T
+    run = challenge.derive_fn(bucket, plan.var, plan.plen, plan.tlen,
+                              fb, False)
+    _, kw = run(jnp.asarray(block), jnp.asarray(aw), plan.dev_tab,
+                jnp.asarray(fkw), jnp.asarray(fidx))
+    kw = np.asarray(kw)  # (8, bucket)
+    want = hashvec.sha512_mod_l_words(
+        [sigs[i].tobytes() + pubs[i].tobytes() + bytes(msgs[i])
+         for i in range(n)])
+    for i in range(n):
+        assert kw[:, i].tobytes() == want[i].tobytes(), i
+    for i in range(n, bucket):  # padding lanes stay zero (happy header)
+        assert not kw[:, i].any(), i

@@ -550,3 +550,128 @@ class TestBlocksyncUnderChaos:
         assert m.fallback_verifies.value("ed25519") >= fb0 + 4
         assert D.supervisor("device").breaker.state == D.OPEN
         assert crypto_batch.resolve_backend() == "cpu"
+
+
+# ------------------------------------------------- device-challenge chaos
+
+
+def _dc_batch(n: int = 8):
+    """A batch the challenge planner accepts (one dominant (0, mlen)
+    combo) with two bad lanes: a wrong-s signature (device math must
+    reject it) and a ragged row (structural pre_ok=False)."""
+    privs = [ed25519.gen_priv_key() for _ in range(n)]
+    pubs = [p.pub_key().bytes_() for p in privs]
+    msgs = [b"dcchaos-%d" % i for i in range(n)]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    sigs[2] = sigs[2][:32] + sigs[3][32:]  # wrong s for this R
+    sigs[4] = b"\x01" * 63                 # ragged length
+    return pubs, msgs, sigs
+
+
+class TestDeviceChallengeChaos:
+    """Chaos routing for the ed25519.challenge and dispatch.doublebuf
+    sites (device-side challenge derivation + the two-slot dispatch
+    gate). Contract: every injected fault lands on a counted degradation
+    rung — host-k fallback, breaker-planned host path, serialized
+    dispatch — and the verdict mask is bit-identical to the
+    host-challenge reference on every rung."""
+
+    def _reference(self, pubs, msgs, sigs):
+        from cometbft_tpu.ops import challenge
+
+        challenge.configure(enabled=False)
+        try:
+            return EK.verify_batch(pubs, msgs, sigs)
+        finally:
+            challenge.configure(enabled=True)
+
+    def test_transient_exhausts_retries_then_batch_host_fallback(self):
+        from cometbft_tpu.ops import challenge
+
+        challenge.reset_stats()
+        pubs, msgs, sigs = _dc_batch()
+        ok_ref, mask_ref = self._reference(pubs, msgs, sigs)
+        chaos.arm("ed25519.challenge", "transient", count=3)
+        ok, mask = EK.verify_batch(pubs, msgs, sigs)
+        assert (ok, mask) == (ok_ref, mask_ref)
+        assert [i for i, g in enumerate(mask) if not g] == [2, 4]
+        st = challenge.stats()
+        assert st["derive_failed"] == 1
+        assert st["batch_host_fallback"] == 1
+        assert chaos.fired("ed25519.challenge") == 3  # retried, then fell
+
+    def test_permanent_derive_failure_host_fallback_not_wrong_verdict(self):
+        from cometbft_tpu.ops import challenge
+
+        challenge.reset_stats()
+        pubs, msgs, sigs = _dc_batch()
+        ok_ref, mask_ref = self._reference(pubs, msgs, sigs)
+        chaos.arm("ed25519.challenge", "permanent", count=1)
+        ok, mask = EK.verify_batch(pubs, msgs, sigs)
+        assert (ok, mask) == (ok_ref, mask_ref)
+        assert challenge.stats()["batch_host_fallback"] == 1
+        # the failure fed the challenge-site supervisor, not the device one
+        assert D.supervisor("ed25519.challenge").breaker._consecutive >= 1
+        assert D.supervisor("device").breaker._consecutive == 0
+
+    def test_open_challenge_breaker_plans_host_path(self):
+        """With the challenge breaker open the planner refuses up front
+        (plan_breaker_open): the batch stages the classic r/s/k block and
+        still verifies on device — same verdicts, no derive attempted."""
+        from cometbft_tpu.ops import challenge
+
+        challenge.reset_stats()
+        sup = D.supervisor("ed25519.challenge")
+        for _ in range(3):  # failure_threshold from the fixture
+            sup.record_op_failure(RuntimeError("poisoned derive"))
+        assert not sup.breaker.peek()
+        pubs, msgs, sigs = _dc_batch()
+        ok_ref, mask_ref = self._reference(pubs, msgs, sigs)
+        ok, mask = EK.verify_batch(pubs, msgs, sigs)
+        assert (ok, mask) == (ok_ref, mask_ref)
+        st = challenge.stats()
+        assert st["plan_breaker_open"] >= 1
+        assert st.get("batch_host_fallback", 0) == 0  # never reached derive
+
+    def test_corrupt_device_k_caught_by_recheck_plane(self):
+        """A perturbed device-derived k makes one valid lane fail the
+        curve check; the host-oracle recheck flips it back and counts the
+        disagreement — the reported mask never changes."""
+        from cometbft_tpu.ops import challenge
+
+        challenge.reset_stats()
+        m = _metrics()
+        before = m.mask_oracle_disagreement.value()
+        pubs, msgs, sigs = _batch(8)
+        chaos.arm("ed25519.challenge", "corrupt", count=1)
+        ok, mask = EK.verify_batch(pubs, msgs, sigs)
+        assert ok and all(mask)
+        assert m.mask_oracle_disagreement.value() >= before + 1
+        assert challenge.stats()["lanes_device"] >= 8  # stayed on the rung
+
+    def test_doublebuf_fault_degrades_to_serialized_dispatch(self):
+        """An injected buffer-gate fault must degrade (serialized
+        single-buffer dispatch, counted) — never fail the batch."""
+        pubs, msgs, sigs = _dc_batch()
+        ok_ref, mask_ref = self._reference(pubs, msgs, sigs)
+        chaos.arm("dispatch.doublebuf", "transient", count=1)
+        ok, mask = EK.verify_batch(pubs, msgs, sigs)
+        assert (ok, mask) == (ok_ref, mask_ref)
+        stats = D.doublebuffer_stats()
+        assert sum(s["degraded"] for s in stats.values()) == 1
+        assert D.supervisor(
+            "doublebuf.dev0").breaker._consecutive >= 1
+
+    def test_abandoned_thunks_never_wedge_the_slot_gate(self):
+        """Regression: the in-flight slot is scoped to the dispatch
+        closure, so callers that take device_parts() and never resolve a
+        batch (or drop the thunk entirely) cannot leak slots and deadlock
+        the two-slot gate."""
+        pubs, msgs, sigs = _batch(8)
+        for _ in range(5):  # > 2x slots: a leak would wedge on the 3rd
+            t = EK.verify_batch_async(pubs, msgs, sigs)
+            t.device_parts()  # taken, deliberately never resolved
+        ok, mask = EK.verify_batch(pubs, msgs, sigs)  # leaked slots -> hang
+        assert ok and all(mask)
+        db = D.doublebuffer(f"dev{EK.default_device_index()}")
+        assert db.stats()["acquires"] >= 6  # every batch rode the gate

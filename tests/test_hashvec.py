@@ -223,16 +223,25 @@ def test_smoke_uniform_commit_takes_vectorized_path():
         items.append((oracle.public_key_from_seed(seed), msg,
                       oracle.sign(seed, msg)))
     pubs, msgs, sigs = map(list, zip(*items))
+    from cometbft_tpu.ops import challenge
+
     hashvec.reset_stats()
+    challenge.reset_stats()
     K.reset_fetch_stats()
     ok, mask = K.verify_batch(pubs, msgs, sigs)
     assert ok and all(mask)
     st = hashvec.stats()
     counted = sum(v for k, v in st.items() if k.startswith("sha512_"))
-    assert counted >= 16  # challenges went through the hashvec ladder
-    if hashvec.native_available():
-        # with the SIMD core present, auto mode must pick it, not serial
-        assert st.get("sha512_native_rows", 0) >= 16
+    dev_lanes = challenge.stats().get("lanes_device", 0)
+    if dev_lanes >= 16:
+        # device-challenge rung (default): k derived on-chip — the host
+        # hashvec ladder is legitimately idle for this batch
+        pass
+    else:
+        assert counted >= 16  # challenges went through the hashvec ladder
+        if hashvec.native_available():
+            # with the SIMD core present, auto mode picks it, not serial
+            assert st.get("sha512_native_rows", 0) >= 16
     # bucket-ladder discipline survives the kernel signature change
     for shape in K.dispatched_shapes():
         assert (shape <= K._POW2_CAP and shape & (shape - 1) == 0

@@ -249,6 +249,26 @@ class TestShrinkGrow:
         assert m.device_batches.value("ed25519") == db0 + 1
         assert cmtmetrics.mesh_metrics().mesh_fallback_total.value() >= 1
 
+    def test_chip_kill_mid_flush_leaves_every_dispatch_slot_free(
+            self, monkeypatch):
+        """Zero lost futures AND zero lost slots: after a chip dies
+        mid-flush and its shard redispatches over the survivors, every
+        per-chip DoubleBuffer gate must be back at full capacity — a slot
+        leaked by the dying shard would serialize that fault domain
+        forever and wedge a later half-open regrow."""
+        _stub_kernels(monkeypatch)
+        vm = _mesh(4)
+        D.configure(failure_threshold=1)
+        chaos.arm("ed25519.dispatch.dev2", "permanent")
+        pubs, msgs, sigs = _sign_n(32)
+        assert vm.verify("ed25519", pubs, msgs, sigs, klass="sync").all()
+        assert vm.health()["live"] == 3
+        stats = D.doublebuffer_stats()
+        assert stats  # the surviving shards rode their per-chip gates
+        for dom in stats:
+            db = D.doublebuffer(dom)
+            assert db._sem._value == db.slots  # all slots released
+
     def test_fallback_ladder_reaches_cpu_when_everything_is_dead(
             self, monkeypatch):
         _stub_kernels(monkeypatch)

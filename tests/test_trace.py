@@ -264,6 +264,139 @@ class TestAttribution:
         assert attr["total_us"] == 0.0 and attr["rows"] == 0
 
 
+# ------------------------------------------------------ h2d overlap model
+
+
+class TestOverlapModel:
+    """Double-buffered dispatch: batch N's h2d runs while batch N-1
+    computes on another pool thread. The overlapped nanoseconds must bill
+    ONCE (as overlap), never twice (transfer + compute)."""
+
+    def test_overlapped_h2d_bills_as_overlap_live(self):
+        clk = FakeClock()
+        _arm(clock=clk)
+        started, release = threading.Event(), threading.Event()
+
+        def worker():
+            with trace.span("ed25519.dispatch", cat="compute"):
+                started.set()
+                release.wait(5)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        assert started.wait(5)
+        clk.tick(10_000)  # compute alone: 10us
+        with trace.span("ed25519.h2d", cat="transfer") as sp:
+            clk.tick(5_000)  # transfer fully inside the live compute
+            sp.add_bytes(tx=640)
+        release.set()
+        t.join(5)
+        attr = trace.attribution()
+        # the 5us of h2d hidden behind the other thread's compute bills
+        # as overlap; the transfer stage itself cost nothing extra
+        assert attr["stage_us"]["transfer"] == 0.0
+        assert attr["h2d_overlap_us"] == 5.0
+        assert attr["h2d_overlap_fraction"] == 1.0
+        assert attr["stage_us"]["compute"] == 15.0
+        assert attr["total_us"] == 15.0  # not 20: no double count
+        assert attr["wire_tx_bytes"] == 640  # bytes still counted
+
+    def test_same_thread_compute_never_counts_as_overlap(self):
+        clk = FakeClock()
+        _arm(clock=clk)
+        with trace.span("dispatch", cat="compute"):
+            clk.tick(10_000)
+        with trace.span("h2d", cat="transfer"):
+            clk.tick(5_000)
+        attr = trace.attribution()
+        assert attr["h2d_overlap_us"] == 0.0
+        assert attr["stage_us"]["transfer"] == 5.0
+
+    def test_challenge_stage_is_busy_for_overlap(self):
+        clk = FakeClock()
+        _arm(clock=clk)
+        started, release = threading.Event(), threading.Event()
+
+        def worker():
+            with trace.span("ed25519.challenge", cat="challenge"):
+                started.set()
+                release.wait(5)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        assert started.wait(5)
+        with trace.span("h2d", cat="transfer"):
+            clk.tick(4_000)
+        release.set()
+        t.join(5)
+        attr = trace.attribution()
+        assert attr["h2d_overlap_us"] == 4.0
+        assert attr["stage_us"]["transfer"] == 0.0
+        assert attr["stage_us"]["challenge"] == 4.0
+
+    def test_attribution_of_overlap_golden_replay(self):
+        """Golden replay of the offline model: a two-thread span list
+        with a partially overlapped transfer must produce exactly this
+        attribution — any drift in the overlap math fails here."""
+        mk = dict(parent_id=None, bytes_tx=0, bytes_rx=0, attrs={})
+        spans = [
+            # thread 1: batch N-1 computing 0..12us
+            {**mk, "id": 1, "trace_id": 1, "name": "dispatch",
+             "cat": "compute", "t0_ns": 0, "dur_ns": 12_000, "tid": 1},
+            # thread 2: batch N's h2d 5..15us — 7us hidden, 3us exposed
+            {**mk, "id": 2, "trace_id": 2, "name": "h2d",
+             "cat": "transfer", "t0_ns": 5_000, "dur_ns": 10_000,
+             "tid": 2, "bytes_tx": 960, "attrs": {"sig_rows": 10}},
+        ]
+        got = trace.attribution_of(spans)
+        assert got["stage_us"]["transfer"] == 3.0
+        assert got["stage_us"]["compute"] == 12.0
+        assert got["h2d_overlap_us"] == 7.0
+        assert got["h2d_overlap_fraction"] == 0.7
+        assert got["total_us"] == 15.0
+        assert got["rows"] == 10
+        assert got["bytes_per_sig_tx"] == 96.0
+
+    def test_attribution_of_merges_busy_union(self):
+        """Two overlapping busy intervals on other threads union before
+        intersecting — a transfer covered by both bills its overlap once."""
+        mk = dict(parent_id=None, bytes_tx=0, bytes_rx=0, attrs={})
+        spans = [
+            {**mk, "id": 1, "trace_id": 1, "name": "c1", "cat": "compute",
+             "t0_ns": 0, "dur_ns": 8_000, "tid": 1},
+            {**mk, "id": 2, "trace_id": 2, "name": "c2", "cat": "challenge",
+             "t0_ns": 6_000, "dur_ns": 8_000, "tid": 3},
+            {**mk, "id": 3, "trace_id": 3, "name": "h2d", "cat": "transfer",
+             "t0_ns": 2_000, "dur_ns": 10_000, "tid": 2},
+        ]
+        got = trace.attribution_of(spans)
+        # transfer [2,12] ∩ union([0,8] ∪ [6,14]) = [2,12] -> all 10us
+        assert got["h2d_overlap_us"] == 10.0
+        assert got["stage_us"]["transfer"] == 0.0
+        assert got["h2d_overlap_fraction"] == 1.0
+
+    def test_live_and_replay_agree_on_overlap(self):
+        clk = FakeClock()
+        _arm(clock=clk)
+        started, release = threading.Event(), threading.Event()
+
+        def worker():
+            with trace.span("dispatch", cat="compute"):
+                started.set()
+                release.wait(5)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        assert started.wait(5)
+        with trace.span("h2d", cat="transfer"):
+            clk.tick(3_000)
+        release.set()
+        t.join(5)
+        attr = trace.attribution()
+        replay = trace.attribution_of(trace.snapshot())
+        assert replay == {k: v for k, v in attr.items() if k != "enabled"}
+
+
 # ----------------------------------------------------------- slow capture
 
 
